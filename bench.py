@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Benchmark: train-step throughput for the BASELINE config-#1 shape.
+
+2nd-order FM, k=8, Criteo-like batches (39 features/example), logistic loss,
+sparse Adagrad — the full jitted train step (gather → fused (Σv)²−Σv²
+scorer with hand-written VJP → dedup → sparse scatter update), measured on
+whatever chips are visible and reported per chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "examples/sec/chip", "vs_baseline": N}
+vs_baseline is against the BASELINE.json north-star ≥500k examples/sec/chip.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fast_tffm_tpu.models import Batch, FMModel
+from fast_tffm_tpu.trainer import init_state, make_train_step
+
+BASELINE_EXAMPLES_PER_SEC_PER_CHIP = 500_000.0
+
+
+def make_batch(rng, batch_size, nnz, vocab):
+    return Batch(
+        labels=jnp.asarray(rng.integers(0, 2, size=(batch_size,)).astype(np.float32)),
+        ids=jnp.asarray(rng.integers(0, vocab, size=(batch_size, nnz)).astype(np.int32)),
+        vals=jnp.asarray(np.abs(rng.normal(size=(batch_size, nnz)).astype(np.float32)) + 0.1),
+        fields=jnp.zeros((batch_size, nnz), jnp.int32),
+        weights=jnp.ones((batch_size,), jnp.float32),
+    )
+
+
+def main():
+    batch_size = 16384
+    nnz = 39  # Criteo field count
+    vocab = 1 << 20
+    warmup, iters = 5, 30
+
+    model = FMModel(vocabulary_size=vocab, factor_num=8, order=2)
+    state = init_state(model, jax.random.key(0))
+    step = make_train_step(model, learning_rate=0.01)
+
+    rng = np.random.default_rng(0)
+    batches = [make_batch(rng, batch_size, nnz, vocab) for _ in range(8)]
+
+    for i in range(warmup):
+        state, loss = step(state, batches[i % len(batches)])
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for i in range(iters):
+        state, loss = step(state, batches[i % len(batches)])
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    n_chips = jax.device_count()
+    value = batch_size * iters / dt / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "train examples/sec/chip (2nd-order FM, k=8, nnz=39, vocab=1M)",
+                "value": round(value, 1),
+                "unit": "examples/sec/chip",
+                "vs_baseline": round(value / BASELINE_EXAMPLES_PER_SEC_PER_CHIP, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,183 @@
+// Native libsvm/libffm batch parser for fast_tffm_tpu.
+//
+// In-kind replacement for the reference's FmParser C++ TensorFlow op
+// (renyi533/fast_tffm :: cc/ parser kernel: libsvm text -> labels, feature
+// ids, values, row offsets, with optional feature-id hashing).  Rather than
+// a TF op, this is a plain C ABI consumed through ctypes
+// (fast_tffm_tpu/data/native.py), producing the framework's padded dense
+// batch directly into caller-allocated NumPy buffers.
+//
+// Contract (must stay bit-identical with the Python reference parser in
+// fast_tffm_tpu/data/libsvm.py):
+//   * line grammar: "label feat:val ..." or "label field:feat:val ..."
+//   * labels <= 0 map to 0.0, otherwise 1.0
+//   * hashing: 64-bit FNV-1a over the raw feature token bytes, mod vocab
+//   * padding: ids/vals/fields zero-filled beyond each row's nnz
+//
+// Build: csrc/Makefile -> fast_tffm_tpu/data/_libsvm_parser.so
+
+#include <cctype>
+#include <cerrno>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+constexpr uint64_t kFnvOffset = 0xcbf29ce484222325ULL;
+constexpr uint64_t kFnvPrime = 0x100000001b3ULL;
+
+inline uint64_t fnv1a64(const char* data, int64_t len) {
+  uint64_t h = kFnvOffset;
+  for (int64_t i = 0; i < len; ++i) {
+    h = (h ^ static_cast<uint8_t>(data[i])) * kFnvPrime;
+  }
+  return h;
+}
+
+inline bool is_space(char c) { return c == ' ' || c == '\t' || c == '\r'; }
+
+// Error codes mirrored in data/native.py.
+enum ErrorCode {
+  kOk = 0,
+  kEmptyLine = 1,
+  kBadLabel = 2,
+  kBadToken = 3,
+  kIdOutOfRange = 4,
+  kRowTooWide = 5,
+};
+
+}  // namespace
+
+extern "C" {
+
+// Exposed for cross-checking the hash against the Python implementation.
+uint64_t fm_fnv1a64(const char* data, int64_t len) { return fnv1a64(data, len); }
+
+// Scan a NUL-terminated buffer of newline-separated lines; report the line
+// count (blank lines skipped) and the widest row's nnz.
+void fm_parse_shape(const char* buf, int64_t* n_lines, int64_t* widest) {
+  int64_t lines = 0, wide = 0;
+  const char* p = buf;
+  while (*p) {
+    const char* eol = strchr(p, '\n');
+    const char* end = eol ? eol : p + strlen(p);
+    // Count whitespace-separated tokens on the line.
+    int64_t toks = 0;
+    const char* q = p;
+    while (q < end) {
+      while (q < end && is_space(*q)) ++q;
+      if (q >= end) break;
+      ++toks;
+      while (q < end && !is_space(*q)) ++q;
+    }
+    if (toks > 0) {
+      ++lines;
+      if (toks - 1 > wide) wide = toks - 1;
+    }
+    p = eol ? eol + 1 : end;
+  }
+  *n_lines = lines;
+  *widest = wide;
+}
+
+// Parse into caller-allocated buffers.  Returns an ErrorCode; on error,
+// *error_line holds the (0-based, blank-skipped) offending line index.
+//
+//   labels: float32[n]      ids: int64[n*width]   vals: float32[n*width]
+//   fields: int32[n*width]  nnz: int32[n]
+int32_t fm_parse(const char* buf, int64_t n, int64_t width,
+                 int64_t vocabulary_size, int32_t hash_feature_id,
+                 float* labels, int64_t* ids, float* vals, int32_t* fields,
+                 int32_t* nnz, int64_t* error_line) {
+  memset(ids, 0, sizeof(int64_t) * n * width);
+  memset(vals, 0, sizeof(float) * n * width);
+  memset(fields, 0, sizeof(int32_t) * n * width);
+  memset(nnz, 0, sizeof(int32_t) * n);
+
+  const char* p = buf;
+  int64_t li = 0;
+  while (*p && li < n) {
+    const char* eol = strchr(p, '\n');
+    const char* end = eol ? eol : p + strlen(p);
+    const char* q = p;
+    while (q < end && is_space(*q)) ++q;
+    if (q >= end) {  // blank line: skip without consuming a row
+      p = eol ? eol + 1 : end;
+      continue;
+    }
+    // Label token.
+    char* after = nullptr;
+    errno = 0;
+    float y = strtof(q, &after);
+    if (after == q || errno != 0 || (after < end && !is_space(*after)) ) {
+      *error_line = li;
+      return kBadLabel;
+    }
+    labels[li] = y <= 0.0f ? 0.0f : 1.0f;
+    q = after;
+    // Feature tokens.
+    int64_t m = 0;
+    while (q < end) {
+      while (q < end && is_space(*q)) ++q;
+      if (q >= end) break;
+      const char* tok = q;
+      while (q < end && !is_space(*q)) ++q;
+      const char* tok_end = q;
+      // Split on ':' — one colon (feat:val) or two (field:feat:val).
+      const char* c1 = static_cast<const char*>(
+          memchr(tok, ':', tok_end - tok));
+      if (!c1 || c1 == tok || c1 + 1 >= tok_end) {
+        *error_line = li;
+        return kBadToken;
+      }
+      const char* c2 = static_cast<const char*>(
+          memchr(c1 + 1, ':', tok_end - (c1 + 1)));
+      const char* feat_begin;
+      const char* feat_end;
+      int64_t field = 0;
+      const char* val_begin;
+      if (c2) {
+        if (c2 + 1 >= tok_end) { *error_line = li; return kBadToken; }
+        char* fend = nullptr;
+        errno = 0;
+        field = strtoll(tok, &fend, 10);
+        if (fend != c1 || errno != 0) { *error_line = li; return kBadToken; }
+        feat_begin = c1 + 1;
+        feat_end = c2;
+        val_begin = c2 + 1;
+      } else {
+        feat_begin = tok;
+        feat_end = c1;
+        val_begin = c1 + 1;
+      }
+      int64_t fid;
+      if (hash_feature_id) {
+        fid = static_cast<int64_t>(
+            fnv1a64(feat_begin, feat_end - feat_begin) %
+            static_cast<uint64_t>(vocabulary_size));
+      } else {
+        char* iend = nullptr;
+        errno = 0;
+        fid = strtoll(feat_begin, &iend, 10);
+        if (iend != feat_end || errno != 0) { *error_line = li; return kBadToken; }
+        if (fid < 0 || fid >= vocabulary_size) { *error_line = li; return kIdOutOfRange; }
+      }
+      char* vend = nullptr;
+      errno = 0;
+      float v = strtof(val_begin, &vend);
+      if (vend != tok_end || errno != 0) { *error_line = li; return kBadToken; }
+      if (m >= width) { *error_line = li; return kRowTooWide; }
+      ids[li * width + m] = fid;
+      vals[li * width + m] = v;
+      fields[li * width + m] = static_cast<int32_t>(field);
+      ++m;
+    }
+    nnz[li] = static_cast<int32_t>(m);
+    ++li;
+    p = eol ? eol + 1 : end;
+  }
+  return kOk;
+}
+
+}  // extern "C"

@@ -1,0 +1,14 @@
+#!/usr/bin/env python
+"""Entry script with the reference's CLI shape:
+
+    python fast_tffm.py {train,predict,dist_train,dist_predict} <cfg>
+
+(see fast_tffm_tpu/cli.py; `renyi533/fast_tffm` :: fast_tffm.py).
+"""
+
+import sys
+
+from fast_tffm_tpu.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,14 @@
+"""fast_tffm_tpu: a TPU-native factorization-machine training framework.
+
+Built from scratch on JAX/XLA/Pallas with the capabilities of
+`renyi533/fast_tffm` (TF-1.x + custom C++ ops): train/predict entrypoints
+driven by an INI config, libsvm input with optional feature-id hashing,
+fused arbitrary-order FM scoring kernels with hand-written backward passes,
+sparse Adagrad with L2 regularization, and row-sharded embedding tables
+across a TPU device mesh (the reference's `vocabulary_block_num`
+parameter-server sharding, redone as `jax.sharding` + collectives).
+"""
+
+__version__ = "0.1.0"
+
+from fast_tffm_tpu.ops.fm import fm_score  # noqa: F401

@@ -1,0 +1,98 @@
+"""Checkpoint save/restore for train state.
+
+Capability parity with the reference's `tf.train.Saver` → `model_file`
+(`renyi533/fast_tffm` :: local/dist trainer save + predictor restore).
+Format: a single .npz holding the sparse table, Adagrad accumulators,
+flattened dense params, and the step counter.  Restore is
+mesh-shape-agnostic: arrays are loaded on host and re-placed with whatever
+shardings the caller supplies (SURVEY.md §5: "restore-compatible across
+mesh shapes").
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+
+from fast_tffm_tpu.optim import AdagradState
+from fast_tffm_tpu.trainer import TrainState
+
+__all__ = ["save_checkpoint", "restore_checkpoint", "latest_step"]
+
+
+def save_checkpoint(path: str, state: TrainState) -> None:
+    """Atomically write ``state`` to ``path`` (.npz)."""
+    flat = {
+        "table": np.asarray(state.table),
+        "table_accum": np.asarray(state.table_opt.accum),
+        "step": np.asarray(state.step),
+    }
+    dense_leaves, dense_def = jax.tree.flatten(state.dense)
+    acc_leaves, _ = jax.tree.flatten(state.dense_opt.accum)
+    for i, (p, a) in enumerate(zip(dense_leaves, acc_leaves)):
+        flat[f"dense_{i}"] = np.asarray(p)
+        flat[f"dense_accum_{i}"] = np.asarray(a)
+    tmp = path + ".tmp"
+    dirpart = os.path.dirname(path)
+    if dirpart:
+        os.makedirs(dirpart, exist_ok=True)
+    with open(tmp, "wb") as f:
+        np.savez(f, **flat)
+    os.replace(tmp, path)
+
+
+def restore_checkpoint(path: str, like: TrainState) -> TrainState:
+    """Load ``path`` into the structure (and shardings) of ``like``.
+
+    ``like`` supplies the dense pytree structure and the target placement:
+    each loaded array is device_put with the corresponding array's sharding,
+    so a checkpoint written on one mesh restores onto another (or onto a
+    single device).
+    """
+    with np.load(path) as z:
+        table = z["table"]
+        table_accum = z["table_accum"]
+        step = z["step"]
+        dense_leaves, dense_def = jax.tree.flatten(like.dense)
+        new_dense = [z[f"dense_{i}"] for i in range(len(dense_leaves))]
+        new_accum = [z[f"dense_accum_{i}"] for i in range(len(dense_leaves))]
+
+    if table.shape[0] != like.table.shape[0]:
+        # Mesh-shape change ⇒ different vocab padding; re-pad with init rows.
+        v = min(table.shape[0], like.table.shape[0])
+        host_table = np.asarray(like.table)
+        host_accum = np.asarray(like.table_opt.accum)
+        host_table[:v] = table[:v]
+        host_accum[:v] = table_accum[:v]
+        table, table_accum = host_table, host_accum
+
+    def put(arr, target):
+        return jax.device_put(np.asarray(arr), target.sharding)
+
+    return TrainState(
+        table=put(table, like.table),
+        table_opt=AdagradState(put(table_accum, like.table_opt.accum)),
+        dense=jax.tree.unflatten(
+            dense_def, [put(a, t) for a, t in zip(new_dense, dense_leaves)]
+        ),
+        dense_opt=AdagradState(
+            jax.tree.unflatten(
+                dense_def,
+                [put(a, t) for a, t in zip(new_accum, jax.tree.leaves(like.dense_opt.accum))],
+            )
+        ),
+        step=put(step, like.step),
+    )
+
+
+def latest_step(path: str) -> int | None:
+    """Step stored in a checkpoint, or None if absent/unreadable."""
+    if not os.path.exists(path):
+        return None
+    try:
+        with np.load(path) as z:
+            return int(z["step"])
+    except Exception:
+        return None

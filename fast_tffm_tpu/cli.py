@@ -1,0 +1,59 @@
+"""CLI dispatcher — the reference's fast_tffm.py entry surface.
+
+`renyi533/fast_tffm` :: fast_tffm.py: positional mode + cfg path
+(`python fast_tffm.py {train,predict,dist_train,dist_predict} <cfg>
+[job_name task_index]`).  The job_name/task_index pair is accepted for CLI
+compatibility but ignored with a notice: under single-program SPMD there is
+no per-task launch — one process drives the whole mesh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from fast_tffm_tpu.config import load_config
+
+MODES = ("train", "predict", "dist_train", "dist_predict")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fast_tffm",
+        description="TPU-native factorization machine trainer (fast_tffm capabilities)",
+    )
+    ap.add_argument("mode", choices=MODES)
+    ap.add_argument("config", help="INI config file (see sample.cfg)")
+    ap.add_argument("legacy", nargs="*", help="ignored job_name/task_index (TF-1.x compat)")
+    ap.add_argument("--resume", action="store_true", help="resume training from model_file")
+    args = ap.parse_args(argv)
+
+    cfg = load_config(args.config)
+    if args.legacy:
+        print(
+            f"note: ignoring legacy cluster args {args.legacy!r} — the SPMD mesh "
+            "replaces ps/worker tasks (one launch drives all devices)",
+            file=sys.stderr,
+        )
+
+    if args.mode == "train":
+        from fast_tffm_tpu.train import train
+
+        train(cfg, resume=args.resume)
+    elif args.mode == "dist_train":
+        from fast_tffm_tpu.train import dist_train
+
+        dist_train(cfg, resume=args.resume)
+    elif args.mode == "predict":
+        from fast_tffm_tpu.predict import predict
+
+        predict(cfg)
+    else:
+        from fast_tffm_tpu.predict import dist_predict
+
+        dist_predict(cfg)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

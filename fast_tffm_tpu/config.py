@@ -1,0 +1,159 @@
+"""INI config schema honoring the reference's key vocabulary.
+
+Capability parity with `renyi533/fast_tffm` :: sample.cfg + the
+ConfigParser reads inside its train/predict modules: General (factor_num,
+vocabulary_size, vocabulary_block_num, hash_feature_id, model_file), Train
+(files, epoch_num, batch_size, learning_rate, init_value_range,
+factor_lambda, bias_lambda, ...), Predict (input + score path).  New,
+TPU-specific keys are additive: [General] model/order/num_fields for the
+model zoo, [Distributed] data_parallel/row_parallel for the mesh (the
+reference's ps_hosts/worker_hosts cluster section has no meaning under
+single-program SPMD — vocabulary_block_num maps to row_parallel).
+"""
+
+from __future__ import annotations
+
+import configparser
+import dataclasses
+
+
+@dataclasses.dataclass
+class Config:
+    # [General]
+    model: str = "fm"  # fm | ffm | deepfm
+    factor_num: int = 8
+    order: int = 2
+    num_fields: int = 0  # required for ffm/deepfm
+    hidden_dims: tuple[int, ...] = (400, 400, 400)  # deepfm MLP head
+    vocabulary_size: int = 1 << 20
+    vocabulary_block_num: int = 1  # reference key; default row_parallel
+    hash_feature_id: bool = False
+    model_file: str = "model.ckpt"
+    # [Train]
+    train_files: tuple[str, ...] = ()
+    weight_files: tuple[float, ...] = ()  # per-file example weights
+    validation_files: tuple[str, ...] = ()
+    epoch_num: int = 1
+    batch_size: int = 1024
+    max_nnz: int = 0  # 0 = infer from first batch file scan
+    learning_rate: float = 0.01
+    init_value_range: float = 0.01
+    factor_lambda: float = 0.0
+    bias_lambda: float = 0.0
+    init_accumulator_value: float = 0.1
+    thread_num: int = 1  # host-side parse workers (reference: queue threads)
+    queue_size: int = 8  # prefetch depth
+    log_every: int = 100
+    save_every_epochs: int = 1
+    # [Predict]
+    predict_files: tuple[str, ...] = ()
+    score_path: str = "scores.txt"
+    # [Distributed]
+    data_parallel: int = 0  # 0 = all devices / row_parallel
+    row_parallel: int = 0  # 0 = vocabulary_block_num
+
+    def validate(self) -> "Config":
+        if self.model not in ("fm", "ffm", "deepfm"):
+            raise ValueError(f"unknown model {self.model!r}")
+        if self.model in ("ffm", "deepfm") and self.num_fields <= 0:
+            raise ValueError(f"{self.model} requires num_fields > 0")
+        if self.model == "fm" and self.order < 2:
+            raise ValueError("order must be >= 2")
+        if self.vocabulary_size <= 0 or self.batch_size <= 0:
+            raise ValueError("vocabulary_size and batch_size must be positive")
+        return self
+
+
+def _split(s: str) -> tuple[str, ...]:
+    return tuple(x for x in (t.strip() for t in s.replace(",", " ").split()) if x)
+
+
+def load_config(path: str) -> Config:
+    """Parse an INI file into a validated Config."""
+    ini = configparser.ConfigParser()
+    with open(path) as f:
+        ini.read_file(f)
+    cfg = Config()
+
+    def get(section, key, conv, default):
+        if ini.has_option(section, key):
+            raw = ini.get(section, key)
+            return conv(raw)
+        return default
+
+    g = "General"
+    cfg.model = get(g, "model", str, cfg.model).lower()
+    cfg.factor_num = get(g, "factor_num", int, cfg.factor_num)
+    cfg.order = get(g, "order", int, cfg.order)
+    cfg.num_fields = get(g, "num_fields", int, cfg.num_fields)
+    cfg.hidden_dims = get(
+        g, "hidden_dims", lambda s: tuple(int(x) for x in _split(s)), cfg.hidden_dims
+    )
+    cfg.vocabulary_size = get(g, "vocabulary_size", int, cfg.vocabulary_size)
+    cfg.vocabulary_block_num = get(g, "vocabulary_block_num", int, cfg.vocabulary_block_num)
+    cfg.hash_feature_id = get(g, "hash_feature_id", ini._convert_to_boolean, cfg.hash_feature_id)
+    cfg.model_file = get(g, "model_file", str, cfg.model_file)
+
+    t = "Train"
+    cfg.train_files = get(t, "train_files", _split, cfg.train_files)
+    cfg.weight_files = get(
+        t, "weight_files", lambda s: tuple(float(x) for x in _split(s)), cfg.weight_files
+    )
+    cfg.validation_files = get(t, "validation_files", _split, cfg.validation_files)
+    cfg.epoch_num = get(t, "epoch_num", int, cfg.epoch_num)
+    cfg.batch_size = get(t, "batch_size", int, cfg.batch_size)
+    cfg.max_nnz = get(t, "max_nnz", int, cfg.max_nnz)
+    cfg.learning_rate = get(t, "learning_rate", float, cfg.learning_rate)
+    cfg.init_value_range = get(t, "init_value_range", float, cfg.init_value_range)
+    cfg.factor_lambda = get(t, "factor_lambda", float, cfg.factor_lambda)
+    cfg.bias_lambda = get(t, "bias_lambda", float, cfg.bias_lambda)
+    cfg.init_accumulator_value = get(
+        t, "init_accumulator_value", float, cfg.init_accumulator_value
+    )
+    cfg.thread_num = get(t, "thread_num", int, cfg.thread_num)
+    cfg.queue_size = get(t, "queue_size", int, cfg.queue_size)
+    cfg.log_every = get(t, "log_every", int, cfg.log_every)
+    cfg.save_every_epochs = get(t, "save_every_epochs", int, cfg.save_every_epochs)
+
+    p = "Predict"
+    cfg.predict_files = get(p, "predict_files", _split, cfg.predict_files)
+    cfg.score_path = get(p, "score_path", str, cfg.score_path)
+
+    d = "Distributed"
+    cfg.data_parallel = get(d, "data_parallel", int, cfg.data_parallel)
+    cfg.row_parallel = get(d, "row_parallel", int, cfg.row_parallel)
+
+    return cfg.validate()
+
+
+def build_model(cfg: Config):
+    """Instantiate the configured model (the reference's graph-builder role)."""
+    from fast_tffm_tpu.models import DeepFMModel, FFMModel, FMModel
+
+    if cfg.model == "fm":
+        return FMModel(
+            vocabulary_size=cfg.vocabulary_size,
+            factor_num=cfg.factor_num,
+            order=cfg.order,
+            init_value_range=cfg.init_value_range,
+            factor_lambda=cfg.factor_lambda,
+            bias_lambda=cfg.bias_lambda,
+        )
+    if cfg.model == "ffm":
+        return FFMModel(
+            vocabulary_size=cfg.vocabulary_size,
+            num_fields=cfg.num_fields,
+            factor_num=cfg.factor_num,
+            init_value_range=cfg.init_value_range,
+            factor_lambda=cfg.factor_lambda,
+            bias_lambda=cfg.bias_lambda,
+        )
+    return DeepFMModel(
+        vocabulary_size=cfg.vocabulary_size,
+        num_fields=cfg.num_fields,
+        factor_num=cfg.factor_num,
+        hidden_dims=cfg.hidden_dims,
+        init_value_range=cfg.init_value_range,
+        factor_lambda=cfg.factor_lambda,
+        bias_lambda=cfg.bias_lambda,
+    )

@@ -1,0 +1,3 @@
+from fast_tffm_tpu.data.hashing import hash_feature_id  # noqa: F401
+from fast_tffm_tpu.data.libsvm import ParsedBatch, parse_lines, pad_batch  # noqa: F401
+from fast_tffm_tpu.data.pipeline import batch_stream, line_stream  # noqa: F401

@@ -1,0 +1,46 @@
+"""Stateless feature-id hashing into a fixed vocabulary.
+
+Capability parity with the reference's `hash_feature_id=true` path
+(`renyi533/fast_tffm` :: cc/ FmParser kernel hashes raw ids into
+``[0, vocabulary_size)`` at parse time).  AUC parity does not require the
+reference's exact hash (SURVEY.md §7 "Hash compatibility"); what matters is
+cross-run stability and a good collision rate at huge vocabularies, so we
+use 64-bit FNV-1a over the raw token bytes — trivially reimplementable in
+the C++ parser (csrc/libsvm_parser.cpp) so both parsers agree bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+FNV_OFFSET = 0xCBF29CE484222325
+FNV_PRIME = 0x100000001B3
+_MASK = 0xFFFFFFFFFFFFFFFF
+
+
+def fnv1a64(token: bytes) -> int:
+    """64-bit FNV-1a of a byte string."""
+    h = FNV_OFFSET
+    for b in token:
+        h = ((h ^ b) * FNV_PRIME) & _MASK
+    return h
+
+
+def hash_feature_id(token: str | bytes, vocabulary_size: int) -> int:
+    """Map a raw feature token to a stable id in [0, vocabulary_size)."""
+    if isinstance(token, str):
+        token = token.encode("utf-8")
+    return fnv1a64(token) % vocabulary_size
+
+
+def hash_feature_ids_np(ids: np.ndarray, vocabulary_size: int) -> np.ndarray:
+    """Vectorized FNV-1a over the decimal byte representation of integer ids.
+
+    Matches ``hash_feature_id(str(i).encode(), vocab)`` element-wise — the
+    contract shared with the C++ parser.
+    """
+    return np.fromiter(
+        (hash_feature_id(str(int(i)), vocabulary_size) for i in ids.ravel()),
+        dtype=np.int64,
+        count=ids.size,
+    ).reshape(ids.shape)

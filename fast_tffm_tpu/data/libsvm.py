@@ -1,0 +1,155 @@
+"""libsvm / libffm text parsing into padded, static-shape batches.
+
+TPU-native replacement for the reference's FmParser C++ op
+(`renyi533/fast_tffm` :: cc/ parser kernel: batch of libsvm lines →
+labels, flat feature ids, flat values, per-row offsets).  Two deliberate
+departures, both TPU-first:
+
+* output is a *padded dense* ``[batch, max_nnz]`` batch rather than flat
+  CSR — XLA wants static shapes, and zero-valued padding is exactly neutral
+  in the FM kernels (see ops/fm.py);
+* field ids are parsed too (``field:feature:value`` libffm syntax) so the
+  same parser feeds FFM.
+
+A C++ implementation of the same contract lives in csrc/libsvm_parser.cpp
+(loaded via ctypes in data/native.py); this module is the reference
+implementation and fallback.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from fast_tffm_tpu.data.hashing import hash_feature_id
+
+__all__ = ["ParsedBatch", "parse_lines", "pad_batch"]
+
+
+@dataclasses.dataclass
+class ParsedBatch:
+    """A padded, static-shape batch — the framework's narrow waist.
+
+    Attributes:
+      labels:  [batch] float32 in {0, 1} (reference accepts 0/1 and ±1;
+               −1 is mapped to 0).
+      ids:     [batch, max_nnz] int64 feature ids (0-padded).
+      vals:    [batch, max_nnz] float32 feature values (0-padded; padding is
+               identified by vals == 0, never by ids).
+      fields:  [batch, max_nnz] int32 field ids (0-padded; all-zero for plain
+               libsvm input).
+      nnz:     [batch] int32 true per-row nonzero counts (the CSR row-splits
+               equivalent, kept for diagnostics/oracles).
+    """
+
+    labels: np.ndarray
+    ids: np.ndarray
+    vals: np.ndarray
+    fields: np.ndarray
+    nnz: np.ndarray
+
+    @property
+    def batch_size(self) -> int:
+        return int(self.labels.shape[0])
+
+    @property
+    def max_nnz(self) -> int:
+        return int(self.ids.shape[1])
+
+
+def _parse_label(tok: str) -> float:
+    y = float(tok)
+    return 0.0 if y <= 0.0 else 1.0
+
+
+def parse_lines(
+    lines: list[str],
+    *,
+    vocabulary_size: int,
+    hash_feature_id_flag: bool = False,
+    max_nnz: int | None = None,
+) -> ParsedBatch:
+    """Parse libsvm/libffm text lines into a ParsedBatch.
+
+    Line grammar:  ``label tok tok ...`` where tok is ``feat:val`` (libsvm)
+    or ``field:feat:val`` (libffm).  Malformed tokens raise ValueError with
+    the offending line — the reference's parser likewise rejects bad input
+    rather than silently skipping.
+    """
+    n = len(lines)
+    labels = np.zeros((n,), np.float32)
+    per_row: list[tuple[list[int], list[float], list[int]]] = []
+    widest = 0
+    for li, line in enumerate(lines):
+        toks = line.split()
+        if not toks:
+            raise ValueError(f"empty line at index {li}")
+        try:
+            labels[li] = _parse_label(toks[0])
+        except ValueError as e:
+            raise ValueError(f"bad label {toks[0]!r} at line {li}") from e
+        ids_, vals_, flds_ = [], [], []
+        for tok in toks[1:]:
+            parts = tok.split(":")
+            try:
+                if len(parts) == 2:
+                    fld, feat, val = 0, parts[0], float(parts[1])
+                elif len(parts) == 3:
+                    fld, feat, val = int(parts[0]), parts[1], float(parts[2])
+                else:
+                    raise ValueError(tok)
+            except ValueError as e:
+                raise ValueError(f"bad token {tok!r} at line {li}") from e
+            if hash_feature_id_flag:
+                fid = hash_feature_id(feat, vocabulary_size)
+            else:
+                fid = int(feat)
+                if not 0 <= fid < vocabulary_size:
+                    raise ValueError(
+                        f"feature id {fid} out of range [0, {vocabulary_size}) "
+                        f"at line {li} (set hash_feature_id = True for raw tokens)"
+                    )
+            ids_.append(fid)
+            vals_.append(val)
+            flds_.append(fld)
+        per_row.append((ids_, vals_, flds_))
+        widest = max(widest, len(ids_))
+
+    width = max_nnz if max_nnz is not None else max(widest, 1)
+    ids = np.zeros((n, width), np.int64)
+    vals = np.zeros((n, width), np.float32)
+    fields = np.zeros((n, width), np.int32)
+    nnz = np.zeros((n,), np.int32)
+    for li, (ids_, vals_, flds_) in enumerate(per_row):
+        if len(ids_) > width:
+            raise ValueError(
+                f"line {li} has {len(ids_)} features > max_nnz={width}"
+            )
+        m = len(ids_)
+        ids[li, :m] = ids_
+        vals[li, :m] = vals_
+        fields[li, :m] = flds_
+        nnz[li] = m
+    return ParsedBatch(labels=labels, ids=ids, vals=vals, fields=fields, nnz=nnz)
+
+
+def pad_batch(batch: ParsedBatch, batch_size: int) -> ParsedBatch:
+    """Pad a short tail batch up to ``batch_size`` rows with empty examples.
+
+    Padded rows have nnz == 0 and label 0; callers weight them out of the
+    loss with an example mask (vals are all-zero → score = 0).
+    """
+    n = batch.batch_size
+    if n == batch_size:
+        return batch
+    if n > batch_size:
+        raise ValueError(f"batch of {n} rows exceeds target {batch_size}")
+    pad = batch_size - n
+    return ParsedBatch(
+        labels=np.concatenate([batch.labels, np.zeros((pad,), np.float32)]),
+        ids=np.concatenate([batch.ids, np.zeros((pad, batch.max_nnz), np.int64)]),
+        vals=np.concatenate([batch.vals, np.zeros((pad, batch.max_nnz), np.float32)]),
+        fields=np.concatenate([batch.fields, np.zeros((pad, batch.max_nnz), np.int32)]),
+        nnz=np.concatenate([batch.nnz, np.zeros((pad,), np.int32)]),
+    )

@@ -1,0 +1,125 @@
+"""ctypes bindings for the native C++ libsvm parser.
+
+Loads ``_libsvm_parser.so`` (built by csrc/Makefile) and exposes the same
+``parse_lines`` contract as the pure-Python reference implementation in
+data/libsvm.py.  Mirrors the reference's py/fm_ops.py, which
+``tf.load_op_library``'d the compiled fm_ops.so — here the binding is plain
+ctypes because the op consumes host NumPy buffers, not graph tensors.
+
+If the shared library is absent (not built), ``load_native_parser`` returns
+None and callers fall back to the Python parser.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from fast_tffm_tpu.data.libsvm import ParsedBatch
+
+_SO_PATH = os.path.join(os.path.dirname(__file__), "_libsvm_parser.so")
+
+_ERRORS = {
+    1: "empty line",
+    2: "bad label",
+    3: "bad token",
+    4: "feature id out of range",
+    5: "row wider than max_nnz",
+}
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.fm_fnv1a64.restype = ctypes.c_uint64
+    lib.fm_fnv1a64.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.fm_parse_shape.restype = None
+    lib.fm_parse_shape.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.fm_parse.restype = ctypes.c_int32
+    lib.fm_parse.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int64,  # n
+        ctypes.c_int64,  # width
+        ctypes.c_int64,  # vocabulary_size
+        ctypes.c_int32,  # hash_feature_id
+        np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),  # labels
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),  # ids
+        np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),  # vals
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),  # fields
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),  # nnz
+        ctypes.POINTER(ctypes.c_int64),  # error_line
+    ]
+    return lib
+
+
+class NativeParser:
+    """Callable with the signature of ``libsvm.parse_lines``."""
+
+    def __init__(self, lib: ctypes.CDLL):
+        self._lib = lib
+
+    def fnv1a64(self, token: bytes) -> int:
+        return int(self._lib.fm_fnv1a64(token, len(token)))
+
+    def __call__(
+        self,
+        lines: list[str],
+        *,
+        vocabulary_size: int,
+        hash_feature_id_flag: bool = False,
+        max_nnz: int | None = None,
+    ) -> ParsedBatch:
+        buf = ("\n".join(lines)).encode("utf-8")
+        n_lines = ctypes.c_int64()
+        widest = ctypes.c_int64()
+        self._lib.fm_parse_shape(buf, ctypes.byref(n_lines), ctypes.byref(widest))
+        n = len(lines)
+        width = max_nnz if max_nnz is not None else max(int(widest.value), 1)
+        labels = np.zeros((n,), np.float32)
+        ids = np.zeros((n, width), np.int64)
+        vals = np.zeros((n, width), np.float32)
+        fields = np.zeros((n, width), np.int32)
+        nnz = np.zeros((n,), np.int32)
+        err_line = ctypes.c_int64(-1)
+        code = self._lib.fm_parse(
+            buf,
+            n,
+            width,
+            vocabulary_size,
+            1 if hash_feature_id_flag else 0,
+            labels,
+            ids,
+            vals,
+            fields,
+            nnz,
+            ctypes.byref(err_line),
+        )
+        if code != 0:
+            raise ValueError(
+                f"{_ERRORS.get(code, f'error {code}')} at line {err_line.value}"
+            )
+        return ParsedBatch(labels=labels, ids=ids, vals=vals, fields=fields, nnz=nnz)
+
+
+def load_native_parser() -> NativeParser | None:
+    """Load the C++ parser if built; None → caller uses the Python parser."""
+    if not os.path.exists(_SO_PATH):
+        return None
+    try:
+        return NativeParser(_bind(ctypes.CDLL(_SO_PATH)))
+    except OSError:
+        return None
+
+
+def best_parser():
+    """The fastest available parser honoring the parse_lines contract."""
+    native = load_native_parser()
+    if native is not None:
+        return native
+    from fast_tffm_tpu.data.libsvm import parse_lines
+
+    return parse_lines

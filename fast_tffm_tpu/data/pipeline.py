@@ -1,0 +1,113 @@
+"""Host-side input pipeline: files → line batches → ParsedBatch stream.
+
+Capability parity with the reference's queue-runner pipeline
+(`renyi533/fast_tffm` :: trainer module: filename queue → line reader →
+string batches → FmParser, with epoch_num / batch_size / per-file weights
+from the cfg).  TF queue runners don't exist in JAX; the TPU-idiomatic
+equivalent is a simple host-side generator (optionally double-buffered by
+the caller) feeding static-shape padded batches to the jitted step — input
+parsing is legitimately CPU work even on pods (SURVEY.md §3 item 1).
+
+File sharding for distributed data-parallel training: worker ``i`` of ``n``
+takes every ``n``-th *line block*, the analog of the reference's per-worker
+input file assignment.
+"""
+
+from __future__ import annotations
+
+import itertools
+from collections.abc import Iterator, Sequence
+
+import numpy as np
+
+from fast_tffm_tpu.data.libsvm import ParsedBatch, pad_batch
+
+__all__ = ["line_stream", "batch_stream"]
+
+
+def line_stream(
+    files: Sequence[str],
+    *,
+    epochs: int = 1,
+    shard_index: int = 0,
+    shard_count: int = 1,
+    weights: Sequence[float] | None = None,
+) -> Iterator[tuple[str, float]]:
+    """Yield (line, example_weight) over ``files`` for ``epochs`` passes.
+
+    ``weights`` gives a per-file example weight (reference: optional per-file
+    weight list aligned with the train file list); default 1.0.  Sharding is
+    round-robin by line index across the whole file list so workers get
+    near-equal, disjoint slices without coordination.
+    """
+    if weights is not None and len(weights) != len(files):
+        raise ValueError(
+            f"weights has {len(weights)} entries for {len(files)} files"
+        )
+    counter = itertools.count()
+    for _ in range(epochs):
+        for fi, path in enumerate(files):
+            w = 1.0 if weights is None else float(weights[fi])
+            with open(path, "r") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    if next(counter) % shard_count == shard_index:
+                        yield line, w
+
+
+def batch_stream(
+    files: Sequence[str],
+    *,
+    batch_size: int,
+    vocabulary_size: int,
+    hash_feature_id: bool = False,
+    max_nnz: int | None = None,
+    epochs: int = 1,
+    shard_index: int = 0,
+    shard_count: int = 1,
+    weights: Sequence[float] | None = None,
+    drop_remainder: bool = False,
+    parser=None,
+) -> Iterator[tuple[ParsedBatch, np.ndarray]]:
+    """Yield (ParsedBatch, example_weights[batch]) with static shapes.
+
+    A short final batch is zero-padded up to ``batch_size`` (padded rows get
+    weight 0 so the loss ignores them) unless ``drop_remainder``.
+
+    ``max_nnz`` fixes the feature-axis width across all batches — required
+    for a single XLA compilation.  If None, each batch is as wide as its
+    widest row (fine for eval, recompiles on width change under jit).
+
+    ``parser`` overrides the line parser (signature of
+    ``libsvm.parse_lines``); data/native.py passes the C++ implementation.
+    """
+    from fast_tffm_tpu.data.libsvm import parse_lines
+
+    parse = parser if parser is not None else parse_lines
+    stream = line_stream(
+        files,
+        epochs=epochs,
+        shard_index=shard_index,
+        shard_count=shard_count,
+        weights=weights,
+    )
+    while True:
+        chunk = list(itertools.islice(stream, batch_size))
+        if not chunk:
+            return
+        if len(chunk) < batch_size and drop_remainder:
+            return
+        lines = [c[0] for c in chunk]
+        w = np.asarray([c[1] for c in chunk], np.float32)
+        batch = parse(
+            lines,
+            vocabulary_size=vocabulary_size,
+            hash_feature_id_flag=hash_feature_id,
+            max_nnz=max_nnz,
+        )
+        if len(chunk) < batch_size:
+            batch = pad_batch(batch, batch_size)
+            w = np.concatenate([w, np.zeros((batch_size - len(chunk),), np.float32)])
+        yield batch, w

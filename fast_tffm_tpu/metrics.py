@@ -1,0 +1,61 @@
+"""Evaluation metrics and throughput accounting.
+
+The reference logs periodic step losses (and the BASELINE metric is
+examples/sec/chip + test AUC at convergence); this module supplies exact
+rank-based AUC and a small examples/sec meter for the train loop.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+__all__ = ["auc", "Throughput"]
+
+
+def auc(labels: np.ndarray, scores: np.ndarray, weights: np.ndarray | None = None) -> float:
+    """Exact ROC AUC via the rank statistic (ties get average rank)."""
+    labels = np.asarray(labels, np.float64)
+    scores = np.asarray(scores, np.float64)
+    if weights is not None:
+        keep = np.asarray(weights) > 0
+        labels, scores = labels[keep], scores[keep]
+    pos = labels > 0.5
+    n_pos = int(pos.sum())
+    n_neg = labels.size - n_pos
+    if n_pos == 0 or n_neg == 0:
+        return float("nan")
+    order = np.argsort(scores, kind="mergesort")
+    ranks = np.empty_like(scores)
+    ranks[order] = np.arange(1, scores.size + 1, dtype=np.float64)
+    # Average ranks over tied scores.
+    sorted_scores = scores[order]
+    i = 0
+    while i < sorted_scores.size:
+        j = i
+        while j + 1 < sorted_scores.size and sorted_scores[j + 1] == sorted_scores[i]:
+            j += 1
+        if j > i:
+            ranks[order[i : j + 1]] = 0.5 * (i + 1 + j + 1)
+        i = j + 1
+    return float((ranks[pos].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg))
+
+
+class Throughput:
+    """Examples/sec meter over a sliding window of steps."""
+
+    def __init__(self):
+        self._t0 = time.perf_counter()
+        self._examples = 0
+
+    def add(self, n: int):
+        self._examples += n
+
+    def rate(self) -> float:
+        dt = time.perf_counter() - self._t0
+        return self._examples / dt if dt > 0 else 0.0
+
+    def reset(self):
+        self._t0 = time.perf_counter()
+        self._examples = 0

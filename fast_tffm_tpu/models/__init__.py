@@ -1,0 +1,4 @@
+from fast_tffm_tpu.models.base import Batch, logistic_loss, masked_l2  # noqa: F401
+from fast_tffm_tpu.models.deepfm import DeepFMModel  # noqa: F401
+from fast_tffm_tpu.models.ffm import FFMModel  # noqa: F401
+from fast_tffm_tpu.models.fm import FMModel  # noqa: F401

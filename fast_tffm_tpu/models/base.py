@@ -1,0 +1,93 @@
+"""Model interface shared by FM / FFM / DeepFM.
+
+Every model owns one sparse parameter table ``[vocabulary_size, row_dim]``
+(the reference's block-partitioned embedding-parameter variable — bias and
+factors packed per row, `renyi533/fast_tffm` :: model-graph builder) plus an
+optional pytree of dense parameters (empty for FM/FFM; the MLP for DeepFM).
+
+The training loop is model-agnostic: it gathers rows for a batch, calls
+``score(rows, dense, batch)``, and routes row gradients into the sparse
+Adagrad path and dense gradients into the dense path.  Keeping the gather
+OUTSIDE the model is the same narrow waist the reference draws between its
+lookup and its scorer op — and it is what lets the parallel layer swap in a
+mesh-sharded gather without touching the models.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Protocol
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class Batch:
+    """Device-side mirror of data.libsvm.ParsedBatch (jnp arrays)."""
+
+    labels: jax.Array  # [B] f32
+    ids: jax.Array  # [B, N] i32
+    vals: jax.Array  # [B, N] f32 (0 = padding)
+    fields: jax.Array  # [B, N] i32
+    weights: jax.Array  # [B] f32 example weights (0 = padded row)
+
+    @staticmethod
+    def from_parsed(parsed, weights=None):
+        import numpy as np
+
+        w = np.ones_like(parsed.labels) if weights is None else weights
+        return Batch(
+            labels=jnp.asarray(parsed.labels),
+            ids=jnp.asarray(parsed.ids.astype(np.int32)),
+            vals=jnp.asarray(parsed.vals),
+            fields=jnp.asarray(parsed.fields),
+            weights=jnp.asarray(w),
+        )
+
+
+class Model(Protocol):
+    vocabulary_size: int
+
+    @property
+    def row_dim(self) -> int:
+        """Width of one sparse-table row."""
+        ...
+
+    def init_table(self, key: jax.Array) -> jax.Array:
+        """[vocabulary_size, row_dim] initial sparse table."""
+        ...
+
+    def init_dense(self, key: jax.Array):
+        """Dense parameter pytree ({} if none)."""
+        ...
+
+    def score(self, rows: jax.Array, dense, batch: Batch) -> jax.Array:
+        """[B] raw scores from gathered rows [B, N, row_dim]."""
+        ...
+
+    def regularization(self, rows: jax.Array, dense, batch: Batch) -> jax.Array:
+        """Scalar L2 penalty (reference: factor_lambda/bias_lambda terms)."""
+        ...
+
+
+def masked_l2(rows: jax.Array, vals: jax.Array, bias_lambda: float, factor_lambda: float):
+    """Reference-style L2 over the batch's gathered rows, col 0 = bias.
+
+    Padding slots (vals == 0) gather row 0 arbitrarily and must not be
+    penalized, hence the mask.  Duplicate occurrences are each penalized,
+    matching a per-batch ‖params‖² over the gathered (not deduped) rows.
+    """
+    mask = (vals != 0.0).astype(rows.dtype)[..., None]
+    masked = rows * mask
+    bias_term = jnp.sum(masked[..., 0] ** 2)
+    factor_term = jnp.sum(masked[..., 1:] ** 2)
+    return bias_lambda * bias_term + factor_lambda * factor_term
+
+
+def logistic_loss(scores: jax.Array, labels: jax.Array, weights: jax.Array) -> jax.Array:
+    """Weighted mean sigmoid cross-entropy (the reference's training loss)."""
+    # log(1 + e^{-yx}) in the stable log-sum-exp form.
+    per = jnp.maximum(scores, 0.0) - scores * labels + jnp.log1p(jnp.exp(-jnp.abs(scores)))
+    denom = jnp.maximum(jnp.sum(weights), 1.0)
+    return jnp.sum(per * weights) / denom

@@ -1,0 +1,80 @@
+"""DeepFM: shared-embedding FM + MLP head (BASELINE.json config #4).
+
+An extension target in the reference project's lineage, built natively: the
+FM half is the fused order-2 kernel over the shared embedding table; the
+deep half is a 3-layer MLP over the value-weighted embedding vectors of the
+example's (fixed-count) feature slots — dense XLA matmuls that land on the
+MXU.  Both halves read the SAME table rows, so one gather and one sparse
+Adagrad scatter serve both (the SparseCore-lookup + dense-XLA-MLP split in
+BASELINE.json's config #4).
+
+Requires a fixed slot count per example (max_nnz = field count, the Criteo
+shape); padding slots contribute zero embeddings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from fast_tffm_tpu.models.base import Batch, masked_l2
+from fast_tffm_tpu.ops.fm import fm_score
+
+
+@dataclasses.dataclass(frozen=True)
+class DeepFMModel:
+    vocabulary_size: int
+    num_fields: int  # fixed feature slots per example (= max_nnz)
+    factor_num: int = 8
+    hidden_dims: tuple[int, ...] = (400, 400, 400)  # 3-layer MLP head
+    init_value_range: float = 0.01
+    factor_lambda: float = 0.0
+    bias_lambda: float = 0.0
+
+    @property
+    def row_dim(self) -> int:
+        return 1 + self.factor_num
+
+    def init_table(self, key: jax.Array) -> jax.Array:
+        factors = jax.random.uniform(
+            key,
+            (self.vocabulary_size, self.factor_num),
+            minval=-self.init_value_range,
+            maxval=self.init_value_range,
+            dtype=jnp.float32,
+        )
+        bias = jnp.zeros((self.vocabulary_size, 1), jnp.float32)
+        return jnp.concatenate([bias, factors], axis=-1)
+
+    def init_dense(self, key: jax.Array):
+        dims = (self.num_fields * self.factor_num, *self.hidden_dims, 1)
+        params = {}
+        for li, (d_in, d_out) in enumerate(zip(dims[:-1], dims[1:])):
+            key, wk = jax.random.split(key)
+            # He init for the ReLU stack.
+            params[f"w{li}"] = jax.random.normal(wk, (d_in, d_out), jnp.float32) * jnp.sqrt(
+                2.0 / d_in
+            )
+            params[f"b{li}"] = jnp.zeros((d_out,), jnp.float32)
+        return params
+
+    def _mlp(self, dense, x: jax.Array) -> jax.Array:
+        n_layers = len(self.hidden_dims) + 1
+        for li in range(n_layers):
+            x = x @ dense[f"w{li}"] + dense[f"b{li}"]
+            if li < n_layers - 1:
+                x = jax.nn.relu(x)
+        return x[..., 0]  # [B]
+
+    def score(self, rows: jax.Array, dense, batch: Batch) -> jax.Array:
+        B, N = batch.vals.shape
+        fm_part = fm_score(rows, batch.vals, order=2)
+        emb = rows[..., 1:] * batch.vals[..., None]  # [B, N, k] value-weighted
+        deep_part = self._mlp(dense, emb.reshape(B, N * self.factor_num))
+        return fm_part + deep_part
+
+    def regularization(self, rows: jax.Array, dense, batch: Batch) -> jax.Array:
+        del dense  # reference regularizes only the FM parameters
+        return masked_l2(rows, batch.vals, self.bias_lambda, self.factor_lambda)

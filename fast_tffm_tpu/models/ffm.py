@@ -1,0 +1,76 @@
+"""Field-aware factorization machine (BASELINE.json config #3).
+
+An extension target named by the reference project's roadmap (FFM support in
+`renyi533/fast_tffm`'s lineage); built here natively.  Each feature i keeps
+one factor vector *per field*: score =
+
+    Σᵢ wᵢxᵢ + Σ_{i<j} ⟨v_{i, field_j}, v_{j, field_i}⟩ xᵢxⱼ
+
+Row layout [1 + num_fields·k]: col 0 bias, then the per-field factor blocks.
+
+TPU-first evaluation: the pairwise sum is re-associated into a field-pair
+tensor  T[a, b] = Σ_{i: fᵢ=a} z_i[b]  (z = v·x) so the double sum becomes
+
+    ½ (Σ_{a,b} ⟨T[a,b], T[b,a]⟩ − Σᵢ ⟨z_i[fᵢ], z_i[fᵢ]⟩)
+
+— one one-hot einsum (an MXU matmul) + elementwise math, instead of an
+O(N²) gather loop.  Padding (x=0) contributes z=0 and is exactly neutral.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from fast_tffm_tpu.models.base import Batch, masked_l2
+
+
+@dataclasses.dataclass(frozen=True)
+class FFMModel:
+    vocabulary_size: int
+    num_fields: int
+    factor_num: int = 4
+    init_value_range: float = 0.01
+    factor_lambda: float = 0.0
+    bias_lambda: float = 0.0
+
+    @property
+    def row_dim(self) -> int:
+        return 1 + self.num_fields * self.factor_num
+
+    def init_table(self, key: jax.Array) -> jax.Array:
+        factors = jax.random.uniform(
+            key,
+            (self.vocabulary_size, self.num_fields * self.factor_num),
+            minval=-self.init_value_range,
+            maxval=self.init_value_range,
+            dtype=jnp.float32,
+        )
+        bias = jnp.zeros((self.vocabulary_size, 1), jnp.float32)
+        return jnp.concatenate([bias, factors], axis=-1)
+
+    def init_dense(self, key: jax.Array):
+        return {}
+
+    def score(self, rows: jax.Array, dense, batch: Batch) -> jax.Array:
+        del dense
+        B, N = batch.vals.shape
+        F, k = self.num_fields, self.factor_num
+        bias = rows[..., 0]
+        v = rows[..., 1:].reshape(B, N, F, k)  # v[b, i, partner_field, :]
+        linear = jnp.sum(bias * batch.vals, axis=-1)
+        z = v * batch.vals[..., None, None]  # [B, N, F, k]
+        onehot = jax.nn.one_hot(batch.fields, F, dtype=z.dtype)  # [B, N, F]
+        # T[b, a, g, :] = Σ_{i: field_i = a} z[b, i, g, :]
+        T = jnp.einsum("bna,bngk->bagk", onehot, z)
+        cross = jnp.einsum("bagk,bgak->b", T, T)
+        # Diagonal (i == j) correction: z_i[f_i] per nonzero.
+        z_self = jnp.einsum("bnfk,bnf->bnk", z, onehot)
+        diag = jnp.sum(z_self * z_self, axis=(1, 2))
+        return linear + 0.5 * (cross - diag)
+
+    def regularization(self, rows: jax.Array, dense, batch: Batch) -> jax.Array:
+        del dense
+        return masked_l2(rows, batch.vals, self.bias_lambda, self.factor_lambda)

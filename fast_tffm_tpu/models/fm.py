@@ -1,0 +1,53 @@
+"""Factorization machine (arbitrary order) — the reference's core model.
+
+Row layout [1 + factor_num]: col 0 bias wᵢ, cols 1: factors vᵢ — the packed
+bias+factor parameter row of `renyi533/fast_tffm`'s model-graph builder.
+Scoring runs through the fused kernels in ops/fm.py (order 2: (Σv)²−Σv²
+trick; order ≥ 3: ANOVA DP), each with a hand-written VJP.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from fast_tffm_tpu.models.base import Batch, masked_l2
+from fast_tffm_tpu.ops.fm import fm_score
+
+
+@dataclasses.dataclass(frozen=True)
+class FMModel:
+    vocabulary_size: int
+    factor_num: int = 8
+    order: int = 2
+    init_value_range: float = 0.01  # reference cfg key: uniform factor init
+    factor_lambda: float = 0.0
+    bias_lambda: float = 0.0
+
+    @property
+    def row_dim(self) -> int:
+        return 1 + self.factor_num
+
+    def init_table(self, key: jax.Array) -> jax.Array:
+        factors = jax.random.uniform(
+            key,
+            (self.vocabulary_size, self.factor_num),
+            minval=-self.init_value_range,
+            maxval=self.init_value_range,
+            dtype=jnp.float32,
+        )
+        bias = jnp.zeros((self.vocabulary_size, 1), jnp.float32)
+        return jnp.concatenate([bias, factors], axis=-1)
+
+    def init_dense(self, key: jax.Array):
+        return {}
+
+    def score(self, rows: jax.Array, dense, batch: Batch) -> jax.Array:
+        del dense
+        return fm_score(rows, batch.vals, order=self.order)
+
+    def regularization(self, rows: jax.Array, dense, batch: Batch) -> jax.Array:
+        del dense
+        return masked_l2(rows, batch.vals, self.bias_lambda, self.factor_lambda)

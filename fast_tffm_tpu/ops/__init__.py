@@ -1,0 +1,6 @@
+from fast_tffm_tpu.ops.fm import (  # noqa: F401
+    anova_kernel,
+    fm_score,
+    fm_score_anova_raw,
+    fm_score_order2_raw,
+)

@@ -1,0 +1,95 @@
+"""Adagrad with a sparse, dedup-on-device update path.
+
+Capability parity with the reference's training update
+(`renyi533/fast_tffm` :: model-graph builder: `tf.train.AdagradOptimizer`
+whose sparse gradient path scatter-adds into the block-partitioned
+parameter variables).  Semantics mirror TF Adagrad:
+
+    accum += g²          (accum initialized to init_accumulator_value)
+    param -= lr * g / sqrt(accum)
+
+The sparse step is the BASELINE.json "dense-over-sparse optimizer step":
+gradients arrive per *gathered occurrence* ``[batch, nnz, D]``; occurrences
+of the same row id are summed on device (sort + segment-sum — static
+shapes, no `jnp.unique`), then a single gather→update→scatter touches each
+unique row exactly once.  Touching each row once matters: Adagrad is not
+linear in g (accum += g² must see the *summed* gradient, and duplicate
+scatter targets would race).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["AdagradState", "init_adagrad", "dense_adagrad_update", "sparse_adagrad_update", "dedup_rows"]
+
+
+class AdagradState(NamedTuple):
+    accum: Any  # pytree mirroring the tracked parameter pytree
+
+
+def init_adagrad(param, init_accumulator_value: float) -> AdagradState:
+    return AdagradState(
+        jax.tree.map(lambda p: jnp.full_like(p, init_accumulator_value), param)
+    )
+
+
+def dense_adagrad_update(param, state: AdagradState, grad, lr: float):
+    """Plain Adagrad over a parameter pytree (DeepFM's MLP head)."""
+    accum = jax.tree.map(lambda a, g: a + g * g, state.accum, grad)
+    new_param = jax.tree.map(
+        lambda p, g, a: p - lr * g / jnp.sqrt(a), param, grad, accum
+    )
+    return new_param, AdagradState(accum)
+
+
+def dedup_rows(ids: jax.Array, row_grads: jax.Array, num_rows: int):
+    """Sum per-occurrence row gradients over duplicate ids.
+
+    Args:
+      ids:       [M] int row ids (flattened batch×nnz), may repeat.
+      row_grads: [M, D] gradient per occurrence.
+      num_rows:  table row count V (used as the drop sentinel).
+
+    Returns:
+      (uids [M], gsum [M, D]): unique ids with their summed gradients in the
+      leading segments; trailing slots carry the sentinel id ``num_rows``
+      (out of range → scattered with mode='drop') and zero gradients.
+    """
+    m = ids.shape[0]
+    order = jnp.argsort(ids)
+    sid = ids[order]
+    sg = row_grads[order]
+    is_new = jnp.concatenate([jnp.ones((1,), bool), sid[1:] != sid[:-1]])
+    seg = jnp.cumsum(is_new) - 1  # [M] segment index per occurrence
+    gsum = jax.ops.segment_sum(sg, seg, num_segments=m)
+    uids = jax.ops.segment_max(sid, seg, num_segments=m)
+    n_unique = jnp.sum(is_new)
+    valid = jnp.arange(m) < n_unique
+    uids = jnp.where(valid, uids, num_rows)  # sentinel → dropped on scatter
+    return uids, gsum
+
+
+def sparse_adagrad_update(
+    table: jax.Array,
+    state: AdagradState,
+    ids: jax.Array,
+    row_grads: jax.Array,
+    lr: float,
+):
+    """Sparse Adagrad step on a ``[V, D]`` table.
+
+    ids: [...] int ids; row_grads: [..., D] matching occurrence grads.
+    Only the unique touched rows are read and written.
+    """
+    D = table.shape[-1]
+    uids, gsum = dedup_rows(ids.reshape(-1), row_grads.reshape(-1, D), table.shape[0])
+    acc_rows = state.accum[uids] + gsum * gsum  # gather clamps on the sentinel,
+    new_acc_rows = acc_rows  # but mode='drop' below discards those lanes
+    upd_rows = table[uids] - lr * gsum / jnp.sqrt(new_acc_rows)
+    accum = state.accum.at[uids].set(new_acc_rows, mode="drop")
+    table = table.at[uids].set(upd_rows, mode="drop")
+    return table, AdagradState(accum)

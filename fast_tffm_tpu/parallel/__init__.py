@@ -1,0 +1,14 @@
+from fast_tffm_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS,
+    ROW_AXIS,
+    batch_sharding,
+    make_mesh,
+    pad_vocab,
+    replicated,
+    table_sharding,
+)
+from fast_tffm_tpu.parallel.train_step import (  # noqa: F401
+    init_sharded_state,
+    make_sharded_predict_step,
+    make_sharded_train_step,
+)

@@ -1,0 +1,85 @@
+"""Row-sharded embedding lookup and scatter-update over the device mesh.
+
+TPU-native replacement for the reference's sharded parameter lookup
+(`renyi533/fast_tffm` :: model-graph builder: feature ids routed to
+`vocabulary_block_num` block variables by modulo, gathered over worker→ps
+RPC, with gradients scatter-added back asynchronously).  Here the table is
+contiguously row-sharded over the mesh ROW_AXIS and the lookup/update are
+deterministic XLA collectives inside `shard_map`:
+
+  lookup:  every row shard gathers the rows it owns (others masked to 0)
+           and a `psum` over ROW_AXIS assembles full rows on all shards —
+           ids travel nowhere (they are replicated over ROW_AXIS already);
+           only owned rows ride the ICI ring once.
+  update:  per-occurrence row gradients are deduped locally, `all_gather`ed
+           over DATA_AXIS (replacing Hogwild's racy async scatter with a
+           deterministic synchronous combine), re-deduped, and each shard
+           applies sparse Adagrad to the rows it owns — no second collective.
+
+These functions run INSIDE a shard_map body (parallel/train_step.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from fast_tffm_tpu.optim import AdagradState, dedup_rows
+from fast_tffm_tpu.parallel.mesh import DATA_AXIS, ROW_AXIS
+
+__all__ = ["sharded_gather", "sharded_sparse_adagrad_update"]
+
+
+def sharded_gather(table_shard: jax.Array, ids: jax.Array) -> jax.Array:
+    """Assemble full parameter rows for ``ids`` from the row-sharded table.
+
+    table_shard: [V/R, D] this shard's contiguous rows.
+    ids:         [B_local, N] global row ids (replicated over ROW_AXIS).
+    Returns:     [B_local, N, D] full rows, identical on every row shard.
+    """
+    shard_rows = table_shard.shape[0]
+    base = lax.axis_index(ROW_AXIS) * shard_rows
+    local = ids - base
+    owned = (local >= 0) & (local < shard_rows)
+    local = jnp.where(owned, local, 0)
+    rows = table_shard[local] * owned[..., None].astype(table_shard.dtype)
+    return lax.psum(rows, ROW_AXIS)
+
+
+def sharded_sparse_adagrad_update(
+    table_shard: jax.Array,
+    accum_shard: jax.Array,
+    ids: jax.Array,
+    row_grads: jax.Array,
+    lr: float,
+    num_rows_global: int,
+):
+    """Sparse Adagrad on the local row shard from global per-occurrence grads.
+
+    Dedup happens twice: locally (cheap, shrinks the all_gather payload's
+    effective content) and again after gathering all data shards'
+    contributions, because the same row id can be touched by several
+    data-parallel workers and Adagrad must see the fully summed gradient
+    exactly once (the determinism the reference's Hogwild explicitly gave
+    up — SURVEY.md §4.2).
+    """
+    D = table_shard.shape[-1]
+    uids, gsum = dedup_rows(ids.reshape(-1), row_grads.reshape(-1, D), num_rows_global)
+    all_uids = lax.all_gather(uids, DATA_AXIS, tiled=True)  # [W*M]
+    all_gsum = lax.all_gather(gsum, DATA_AXIS, tiled=True)  # [W*M, D]
+    # Sentinel ids (num_rows_global) from short shards collapse into one
+    # segment and are dropped again below.
+    guids, ggsum = dedup_rows(all_uids, all_gsum, num_rows_global)
+
+    shard_rows = table_shard.shape[0]
+    base = lax.axis_index(ROW_AXIS) * shard_rows
+    local = guids - base
+    owned = (local >= 0) & (local < shard_rows)
+    local = jnp.where(owned, local, shard_rows)  # out of range → mode='drop'
+
+    acc_rows = accum_shard[jnp.minimum(local, shard_rows - 1)] + ggsum * ggsum
+    upd_rows = table_shard[jnp.minimum(local, shard_rows - 1)] - lr * ggsum / jnp.sqrt(acc_rows)
+    accum_shard = accum_shard.at[local].set(acc_rows, mode="drop")
+    table_shard = table_shard.at[local].set(upd_rows, mode="drop")
+    return table_shard, accum_shard

@@ -1,0 +1,151 @@
+"""Training drivers: local and mesh-distributed.
+
+Capability parity with the reference's train/dist_train entrypoints
+(`renyi533/fast_tffm` :: py/ trainer: session loop over sess.run(train_op),
+periodic loss logging, Saver checkpoints; dist variant on a ps/worker
+cluster with async Hogwild updates).  Differences, all TPU-first:
+
+  * one jitted step (gather → fused scorer → loss → sparse Adagrad) instead
+    of a TF graph; host parsing overlaps device compute via prefetch;
+  * dist_train is the SAME program on a ('data','row') mesh — synchronous
+    deterministic updates over ICI replace Hogwild (SURVEY.md §5);
+  * metrics: step loss, examples/sec (/chip), validation AUC per epoch.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import numpy as np
+
+from fast_tffm_tpu.checkpoint import restore_checkpoint, save_checkpoint
+from fast_tffm_tpu.config import Config, build_model
+from fast_tffm_tpu.data.native import best_parser
+from fast_tffm_tpu.data.pipeline import batch_stream
+from fast_tffm_tpu.metrics import Throughput, auc
+from fast_tffm_tpu.models.base import Batch
+from fast_tffm_tpu.trainer import init_state, make_predict_step, make_train_step
+from fast_tffm_tpu.utils.prefetch import prefetch
+
+__all__ = ["train", "dist_train", "scan_max_nnz"]
+
+
+def scan_max_nnz(cfg: Config) -> int:
+    """Fix the static feature width: cfg.max_nnz, or a scan of the files."""
+    if cfg.max_nnz > 0:
+        return cfg.max_nnz
+    widest = 1
+    for path in (*cfg.train_files, *cfg.validation_files, *cfg.predict_files):
+        with open(path) as f:
+            for line in f:
+                n = len(line.split()) - 1
+                if n > widest:
+                    widest = n
+    return widest
+
+
+def _stream(cfg: Config, files, max_nnz, epochs):
+    return prefetch(
+        batch_stream(
+            files,
+            batch_size=cfg.batch_size,
+            vocabulary_size=cfg.vocabulary_size,
+            hash_feature_id=cfg.hash_feature_id,
+            max_nnz=max_nnz,
+            epochs=epochs,
+            weights=cfg.weight_files if cfg.weight_files else None,
+            parser=best_parser(),
+        ),
+        depth=cfg.queue_size,
+    )
+
+
+def _evaluate(cfg: Config, predict_step, state, files, max_nnz) -> float:
+    scores, labels, weights = [], [], []
+    for parsed, w in _stream(cfg, files, max_nnz, epochs=1):
+        b = Batch.from_parsed(parsed, w)
+        scores.append(np.asarray(predict_step(state, b)))
+        labels.append(parsed.labels)
+        weights.append(w)
+    if not scores:
+        return float("nan")
+    return auc(np.concatenate(labels), np.concatenate(scores), np.concatenate(weights))
+
+
+def _run_training(cfg: Config, state, step_fn, predict_step, max_nnz, log=print):
+    n_chips = jax.device_count()
+    meter = Throughput()
+    losses = []
+    start_step = int(state.step)
+    for epoch in range(cfg.epoch_num):
+        for parsed, w in _stream(cfg, cfg.train_files, max_nnz, epochs=1):
+            b = Batch.from_parsed(parsed, w)
+            state, loss = step_fn(state, b)
+            losses.append(loss)  # device value; only sync at log points
+            meter.add(parsed.batch_size)
+            if len(losses) >= cfg.log_every:
+                rate = meter.rate()
+                log(
+                    f"step {int(state.step)} epoch {epoch} "
+                    f"loss {np.mean([float(l) for l in losses]):.5f} "
+                    f"examples/sec {rate:,.0f} (/chip {rate / n_chips:,.0f})"
+                )
+                losses.clear()
+                meter.reset()
+        if cfg.validation_files:
+            val_auc = _evaluate(cfg, predict_step, state, cfg.validation_files, max_nnz)
+            log(f"epoch {epoch} validation auc {val_auc:.5f}")
+        if cfg.save_every_epochs and (epoch + 1) % cfg.save_every_epochs == 0:
+            save_checkpoint(cfg.model_file, state)
+            log(f"epoch {epoch} checkpoint -> {cfg.model_file}")
+    save_checkpoint(cfg.model_file, state)
+    log(f"training done: steps {start_step}->{int(state.step)}, model -> {cfg.model_file}")
+    return state
+
+
+def train(cfg: Config, *, resume: bool = False, log=print):
+    """Local (single-device) training — the reference's `train` mode."""
+    if not cfg.train_files:
+        raise ValueError("no train_files configured")
+    model = build_model(cfg)
+    max_nnz = scan_max_nnz(cfg)
+    state = init_state(model, jax.random.key(0), cfg.init_accumulator_value)
+    if resume:
+        state = restore_checkpoint(cfg.model_file, state)
+        log(f"resumed from {cfg.model_file} at step {int(state.step)}")
+    step_fn = make_train_step(model, cfg.learning_rate)
+    predict_step = make_predict_step(model)
+    return _run_training(cfg, state, step_fn, predict_step, max_nnz, log)
+
+
+def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
+    """Mesh-distributed training — the reference's `dist_train` mode.
+
+    One SPMD program over all visible chips; no job_name/task_index because
+    there is no ps/worker split to schedule — the mesh IS the cluster.
+    """
+    from fast_tffm_tpu.parallel import (
+        init_sharded_state,
+        make_mesh,
+        make_sharded_predict_step,
+        make_sharded_train_step,
+    )
+
+    if not cfg.train_files:
+        raise ValueError("no train_files configured")
+    model = build_model(cfg)
+    max_nnz = scan_max_nnz(cfg)
+    if mesh is None:
+        row = cfg.row_parallel or cfg.vocabulary_block_num
+        data = cfg.data_parallel or None
+        mesh = make_mesh(data, row)
+    log(f"mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))} on {mesh.devices.size} devices")
+    state = init_sharded_state(model, mesh, jax.random.key(0), cfg.init_accumulator_value)
+    if resume:
+        state = restore_checkpoint(cfg.model_file, state)
+        log(f"resumed from {cfg.model_file} at step {int(state.step)}")
+    step_fn = make_sharded_train_step(model, cfg.learning_rate, mesh)
+    predict_step = make_sharded_predict_step(model, mesh)
+    return _run_training(cfg, state, step_fn, predict_step, max_nnz, log)

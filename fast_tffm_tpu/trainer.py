@@ -1,0 +1,97 @@
+"""Model-agnostic jitted train/predict steps (single device).
+
+The TPU-native analog of the reference's session step loop
+(`renyi533/fast_tffm` :: local trainer: sess.run(train_op) over the graph
+parser → gather → scorer → loss → Adagrad scatter-add).  Here one jitted
+function fuses gather → fused scorer (custom VJP) → loss → dedup →
+sparse Adagrad scatter; XLA compiles the whole step into a single program.
+
+The mesh-sharded variant lives in parallel/train_step.py and reuses these
+loss pieces; this module is also its single-shard reference semantics.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from fast_tffm_tpu.models.base import Batch, logistic_loss
+from fast_tffm_tpu.optim import (
+    AdagradState,
+    dense_adagrad_update,
+    init_adagrad,
+    sparse_adagrad_update,
+)
+
+__all__ = ["TrainState", "init_state", "make_train_step", "make_predict_step"]
+
+
+class TrainState(NamedTuple):
+    table: jax.Array  # [V, D] sparse parameter table
+    table_opt: AdagradState
+    dense: Any  # dense params pytree ({} for FM/FFM)
+    dense_opt: Any
+    step: jax.Array  # i64 scalar
+
+
+def init_state(model, key: jax.Array, init_accumulator_value: float = 0.1) -> TrainState:
+    k1, k2 = jax.random.split(key)
+    table = model.init_table(k1)
+    dense = model.init_dense(k2)
+    return TrainState(
+        table=table,
+        table_opt=init_adagrad(table, init_accumulator_value),
+        dense=dense,
+        dense_opt=init_adagrad(dense, init_accumulator_value),
+        step=jnp.zeros((), jnp.int64),
+    )
+
+
+def batch_loss(model, table_rows, dense, batch: Batch):
+    """(total loss with L2, plain data loss) — shared with the sharded step."""
+    scores = model.score(table_rows, dense, batch)
+    data_loss = logistic_loss(scores, batch.labels, batch.weights)
+    reg = model.regularization(table_rows, dense, batch)
+    return data_loss + reg, data_loss
+
+
+def make_train_step(model, learning_rate: float):
+    """Returns jitted ``step(state, batch) -> (state, data_loss)``."""
+
+    @jax.jit
+    def step(state: TrainState, batch: Batch):
+        rows = state.table[batch.ids]  # [B, N, D] gather of touched rows only
+
+        grad_fn = jax.value_and_grad(
+            partial(batch_loss, model), argnums=(0, 1), has_aux=True
+        )
+        (_, data_loss), (g_rows, g_dense) = grad_fn(rows, state.dense, batch)
+
+        table, table_opt = sparse_adagrad_update(
+            state.table, state.table_opt, batch.ids, g_rows, learning_rate
+        )
+        dense, dense_opt = state.dense, state.dense_opt
+        if jax.tree.leaves(state.dense):
+            dense, dense_opt = dense_adagrad_update(
+                state.dense, state.dense_opt, g_dense, learning_rate
+            )
+        return (
+            TrainState(table, table_opt, dense, dense_opt, state.step + 1),
+            data_loss,
+        )
+
+    return step
+
+
+def make_predict_step(model):
+    """Returns jitted ``predict(state, batch) -> sigmoid scores [B]``."""
+
+    @jax.jit
+    def predict(state: TrainState, batch: Batch):
+        rows = state.table[batch.ids]
+        return jax.nn.sigmoid(model.score(rows, state.dense, batch))
+
+    return predict

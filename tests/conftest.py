@@ -1,0 +1,15 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX initializes.
+
+Multi-chip sharding code paths (SURVEY.md §5: "multi-device tests via XLA
+host-device emulation") run on `--xla_force_host_platform_device_count=8`;
+real-TPU behavior is exercised by bench.py / the driver instead.
+"""
+
+import os
+
+# Overwrite (not setdefault): the box has a real TPU visible, and these
+# tests must run on the virtual CPU mesh regardless of ambient JAX_PLATFORMS.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,85 @@
+"""Config schema: reference key names, defaults, validation."""
+
+import pytest
+
+from fast_tffm_tpu.config import build_model, load_config
+from fast_tffm_tpu.models import DeepFMModel, FFMModel, FMModel
+
+INI = """
+[General]
+model = {model}
+factor_num = 16
+order = {order}
+num_fields = 12
+vocabulary_size = 4096
+vocabulary_block_num = 4
+hash_feature_id = true
+model_file = /tmp/m.ckpt
+
+[Train]
+train_files = a.libsvm, b.libsvm
+weight_files = 1.0 2.5
+epoch_num = 3
+batch_size = 256
+learning_rate = 0.05
+factor_lambda = 1e-4
+bias_lambda = 1e-5
+
+[Predict]
+predict_files = t.libsvm
+score_path = /tmp/s.txt
+
+[Distributed]
+data_parallel = 2
+row_parallel = 4
+"""
+
+
+def _cfg(tmp_path, model="fm", order=2):
+    p = tmp_path / "c.cfg"
+    p.write_text(INI.format(model=model, order=order))
+    return load_config(str(p))
+
+
+def test_reference_keys_parsed(tmp_path):
+    cfg = _cfg(tmp_path)
+    assert cfg.factor_num == 16
+    assert cfg.vocabulary_size == 4096
+    assert cfg.vocabulary_block_num == 4
+    assert cfg.hash_feature_id is True
+    assert cfg.train_files == ("a.libsvm", "b.libsvm")
+    assert cfg.weight_files == (1.0, 2.5)
+    assert cfg.epoch_num == 3
+    assert cfg.learning_rate == 0.05
+    assert cfg.factor_lambda == 1e-4
+    assert cfg.data_parallel == 2 and cfg.row_parallel == 4
+
+
+@pytest.mark.parametrize(
+    "model,order,cls",
+    [("fm", 2, FMModel), ("fm", 3, FMModel), ("ffm", 2, FFMModel), ("deepfm", 2, DeepFMModel)],
+)
+def test_build_model(tmp_path, model, order, cls):
+    m = build_model(_cfg(tmp_path, model=model, order=order))
+    assert isinstance(m, cls)
+    assert m.vocabulary_size == 4096
+    if model == "fm":
+        assert m.order == order
+
+
+def test_validation_errors(tmp_path):
+    p = tmp_path / "bad.cfg"
+    p.write_text("[General]\nmodel = ffm\n")  # ffm without num_fields
+    with pytest.raises(ValueError, match="num_fields"):
+        load_config(str(p))
+    p.write_text("[General]\nmodel = gbm\n")
+    with pytest.raises(ValueError, match="unknown model"):
+        load_config(str(p))
+
+
+def test_defaults(tmp_path):
+    p = tmp_path / "min.cfg"
+    p.write_text("[General]\nvocabulary_size = 100\n")
+    cfg = load_config(str(p))
+    assert cfg.model == "fm" and cfg.order == 2
+    assert cfg.batch_size == 1024 and cfg.init_accumulator_value == 0.1

@@ -1,0 +1,114 @@
+"""Kernel correctness: fused FM score + hand-written VJP vs brute-force oracles.
+
+The reference had no tests (SURVEY.md §5); this follows the survey's mandated
+strategy — O(n²)/brute-force ANOVA oracles and autodiff cross-checks.
+"""
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fast_tffm_tpu.ops.fm import (
+    anova_kernel,
+    fm_score,
+    fm_score_anova_raw,
+    fm_score_order2_raw,
+)
+
+
+def _rand_batch(rng, batch=4, nnz=6, k=3, pad_tail=2):
+    rows = rng.normal(size=(batch, nnz, 1 + k)).astype(np.float32)
+    vals = rng.normal(size=(batch, nnz)).astype(np.float32)
+    if pad_tail:
+        vals[:, -pad_tail:] = 0.0  # padding slots
+    return jnp.asarray(rows), jnp.asarray(vals)
+
+
+def _oracle_score(rows, vals, order):
+    """Brute-force FM score: linear + Σ_{m=2..order} Σ_{i1<...<im} Π z · Σ_f."""
+    rows, vals = np.asarray(rows, np.float64), np.asarray(vals, np.float64)
+    B, N, _ = rows.shape
+    out = np.zeros(B)
+    for b in range(B):
+        w, v, x = rows[b, :, 0], rows[b, :, 1:], vals[b]
+        s = float(np.dot(w, x))
+        z = v * x[:, None]  # [N, k]
+        for m in range(2, order + 1):
+            for combo in itertools.combinations(range(N), m):
+                s += float(np.prod(z[list(combo)], axis=0).sum())
+        out[b] = s
+    return out
+
+
+@pytest.mark.parametrize("order", [2, 3, 4])
+def test_score_matches_bruteforce(order):
+    rng = np.random.default_rng(0)
+    rows, vals = _rand_batch(rng)
+    got = np.asarray(fm_score(rows, vals, order=order))
+    want = _oracle_score(rows, vals, order)
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_order2_equals_anova_path():
+    rng = np.random.default_rng(1)
+    rows, vals = _rand_batch(rng)
+    a = np.asarray(fm_score_order2_raw(rows, vals))
+    b = np.asarray(fm_score_anova_raw(rows, vals, 2))
+    np.testing.assert_allclose(a, b, rtol=1e-5)
+
+
+def test_anova_kernel_degree1_is_sum():
+    rng = np.random.default_rng(2)
+    z = jnp.asarray(rng.normal(size=(3, 5, 2)).astype(np.float32))
+    got = np.asarray(anova_kernel(z, 1))
+    want = np.asarray(jnp.sum(z, axis=(1, 2)))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+@pytest.mark.parametrize("order", [2, 3, 4])
+def test_custom_vjp_matches_autodiff(order):
+    rng = np.random.default_rng(3)
+    rows, vals = _rand_batch(rng)
+    g = jnp.asarray(rng.normal(size=(rows.shape[0],)).astype(np.float32))
+
+    def loss_custom(r, x):
+        return jnp.vdot(fm_score(r, x, order=order), g)
+
+    def loss_raw(r, x):
+        if order == 2:
+            return jnp.vdot(fm_score_order2_raw(r, x), g)
+        return jnp.vdot(fm_score_anova_raw(r, x, order), g)
+
+    gr_c, gx_c = jax.grad(loss_custom, argnums=(0, 1))(rows, vals)
+    gr_a, gx_a = jax.grad(loss_raw, argnums=(0, 1))(rows, vals)
+    np.testing.assert_allclose(np.asarray(gr_c), np.asarray(gr_a), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gx_c), np.asarray(gx_a), rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("order", [2, 3])
+def test_padding_is_neutral(order):
+    """Zero-valued slots must not change score or gradients."""
+    rng = np.random.default_rng(4)
+    rows, vals = _rand_batch(rng, nnz=5, pad_tail=0)
+    rows_pad = jnp.concatenate([rows, jnp.asarray(rng.normal(size=(4, 3, 4)), jnp.float32)], axis=1)
+    vals_pad = jnp.concatenate([vals, jnp.zeros((4, 3), jnp.float32)], axis=1)
+    np.testing.assert_allclose(
+        np.asarray(fm_score(rows, vals, order=order)),
+        np.asarray(fm_score(rows_pad, vals_pad, order=order)),
+        rtol=1e-5,
+    )
+    g = jax.grad(lambda r, x: fm_score(r, x, order=order).sum(), argnums=0)(rows_pad, vals_pad)
+    np.testing.assert_allclose(np.asarray(g[:, 5:]), 0.0, atol=1e-6)
+
+
+def test_jit_and_grad_compile():
+    rng = np.random.default_rng(5)
+    rows, vals = _rand_batch(rng)
+    f = jax.jit(lambda r, x: fm_score(r, x, order=3).sum())
+    v1 = f(rows, vals)
+    v2 = jax.jit(jax.grad(lambda r, x: fm_score(r, x, order=3).sum()))(rows, vals)
+    assert np.isfinite(float(v1))
+    assert np.all(np.isfinite(np.asarray(v2)))

@@ -1,0 +1,98 @@
+"""Model scoring oracles (FFM O(N²) brute force, DeepFM composition)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fast_tffm_tpu.models import Batch, DeepFMModel, FFMModel, FMModel
+from fast_tffm_tpu.ops.fm import fm_score
+
+
+def _batch(rng, B=4, N=5, pad_tail=1, num_fields=3):
+    ids = rng.integers(0, 50, size=(B, N)).astype(np.int32)
+    vals = rng.normal(size=(B, N)).astype(np.float32)
+    fields = rng.integers(0, num_fields, size=(B, N)).astype(np.int32)
+    if pad_tail:
+        vals[:, -pad_tail:] = 0.0
+    return Batch(
+        labels=jnp.asarray(rng.integers(0, 2, size=(B,)).astype(np.float32)),
+        ids=jnp.asarray(ids),
+        vals=jnp.asarray(vals),
+        fields=jnp.asarray(fields),
+        weights=jnp.ones((B,), jnp.float32),
+    )
+
+
+def _ffm_oracle(rows, batch, F, k):
+    rows = np.asarray(rows, np.float64)
+    vals = np.asarray(batch.vals, np.float64)
+    fields = np.asarray(batch.fields)
+    B, N = vals.shape
+    out = np.zeros(B)
+    for b in range(B):
+        w = rows[b, :, 0]
+        v = rows[b, :, 1:].reshape(N, F, k)
+        s = float(np.dot(w, vals[b]))
+        for i in range(N):
+            for j in range(i + 1, N):
+                s += float(
+                    np.dot(v[i, fields[b, j]], v[j, fields[b, i]])
+                    * vals[b, i]
+                    * vals[b, j]
+                )
+        out[b] = s
+    return out
+
+
+def test_ffm_matches_bruteforce():
+    rng = np.random.default_rng(0)
+    F, k = 3, 4
+    model = FFMModel(vocabulary_size=50, num_fields=F, factor_num=k)
+    batch = _batch(rng, num_fields=F)
+    table = model.init_table(jax.random.key(0))
+    # Random rows (init factors are tiny; use bigger values to exercise math).
+    rows = jnp.asarray(rng.normal(size=(4, 5, model.row_dim)).astype(np.float32))
+    got = np.asarray(model.score(rows, {}, batch))
+    want = _ffm_oracle(rows, batch, F, k)
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+    assert table.shape == (50, model.row_dim)
+
+
+def test_deepfm_is_fm_plus_mlp():
+    rng = np.random.default_rng(1)
+    model = DeepFMModel(vocabulary_size=50, num_fields=5, factor_num=4, hidden_dims=(8, 8, 8))
+    batch = _batch(rng, N=5, pad_tail=0)
+    rows = jnp.asarray(rng.normal(size=(4, 5, model.row_dim)).astype(np.float32))
+    dense = model.init_dense(jax.random.key(1))
+    got = np.asarray(model.score(rows, dense, batch))
+    fm_part = np.asarray(fm_score(rows, batch.vals, order=2))
+    emb = np.asarray(rows[..., 1:] * batch.vals[..., None]).reshape(4, -1)
+    x = emb
+    for li in range(4):
+        x = x @ np.asarray(dense[f"w{li}"]) + np.asarray(dense[f"b{li}"])
+        if li < 3:
+            x = np.maximum(x, 0.0)
+    np.testing.assert_allclose(got, fm_part + x[:, 0], rtol=1e-4)
+
+
+def test_fm_model_score_uses_kernel():
+    rng = np.random.default_rng(2)
+    model = FMModel(vocabulary_size=50, factor_num=4, order=3)
+    batch = _batch(rng)
+    table = model.init_table(jax.random.key(0))
+    rows = table[batch.ids]
+    got = np.asarray(model.score(rows, {}, batch))
+    want = np.asarray(fm_score(rows, batch.vals, order=3))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_regularization_masks_padding():
+    rng = np.random.default_rng(3)
+    model = FMModel(vocabulary_size=50, factor_num=4, factor_lambda=0.1, bias_lambda=0.2)
+    batch = _batch(rng, pad_tail=2)
+    rows = jnp.asarray(rng.normal(size=(4, 5, model.row_dim)).astype(np.float32))
+    reg = float(model.regularization(rows, {}, batch))
+    mask = np.asarray(batch.vals) != 0
+    r = np.asarray(rows)
+    want = 0.2 * (r[..., 0][mask] ** 2).sum() + 0.1 * ((r[..., 1:] ** 2).sum(-1)[mask]).sum()
+    np.testing.assert_allclose(reg, want, rtol=1e-5)

@@ -1,0 +1,108 @@
+"""Sharded trainer == single-device trainer, on a virtual 8-device CPU mesh.
+
+This is the determinism guarantee replacing the reference's Hogwild races
+(SURVEY.md §5): the mesh-sharded step must reproduce the single-shard step
+bit-for-bit (up to float reassociation).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fast_tffm_tpu.models import Batch, DeepFMModel, FMModel
+from fast_tffm_tpu.parallel import (
+    init_sharded_state,
+    make_mesh,
+    make_sharded_predict_step,
+    make_sharded_train_step,
+)
+from fast_tffm_tpu.trainer import init_state, make_predict_step, make_train_step
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices (see conftest.py)"
+)
+
+V = 96  # divisible by row shards (4) after padding
+
+
+def _batches(rng, n=5, B=32, N=6, F=4):
+    out = []
+    for _ in range(n):
+        out.append(
+            Batch(
+                labels=jnp.asarray(rng.integers(0, 2, size=(B,)).astype(np.float32)),
+                ids=jnp.asarray(rng.integers(0, V, size=(B, N)).astype(np.int32)),
+                vals=jnp.asarray(rng.normal(size=(B, N)).astype(np.float32)),
+                fields=jnp.asarray((rng.integers(0, F, size=(B, N))).astype(np.int32)),
+                weights=jnp.ones((B,), jnp.float32),
+            )
+        )
+    return out
+
+
+@pytest.mark.parametrize(
+    "mesh_shape", [(8, 1), (1, 8), (4, 2), (2, 4)], ids=lambda s: f"data{s[0]}xrow{s[1]}"
+)
+def test_sharded_fm_matches_single_device(mesh_shape):
+    model = FMModel(vocabulary_size=V, factor_num=4, order=2, factor_lambda=1e-4, bias_lambda=1e-4)
+    mesh = make_mesh(*mesh_shape)
+    rng = np.random.default_rng(0)
+    batches = _batches(rng)
+
+    ref_state = init_state(model, jax.random.key(7))
+    ref_step = make_train_step(model, learning_rate=0.1)
+    sh_state = init_sharded_state(model, mesh, jax.random.key(7))
+    sh_step = make_sharded_train_step(model, 0.1, mesh)
+
+    for b in batches:
+        ref_state, ref_loss = ref_step(ref_state, b)
+        sh_state, sh_loss = sh_step(sh_state, b)
+        np.testing.assert_allclose(float(sh_loss), float(ref_loss), rtol=1e-5)
+
+    V_pad = sh_state.table.shape[0]
+    np.testing.assert_allclose(
+        np.asarray(sh_state.table)[:V], np.asarray(ref_state.table), rtol=1e-4, atol=1e-6
+    )
+    # Vocab-padding rows (if any) stay at init.
+    if V_pad > V:
+        assert not np.any(np.asarray(sh_state.table)[V:, 0])
+
+    ref_pred = make_predict_step(model)
+    sh_pred = make_sharded_predict_step(model, mesh)
+    b = batches[0]
+    np.testing.assert_allclose(
+        np.asarray(sh_pred(sh_state, b)), np.asarray(ref_pred(ref_state, b)), rtol=1e-4
+    )
+
+
+def test_sharded_deepfm_matches_single_device():
+    model = DeepFMModel(vocabulary_size=V, num_fields=6, factor_num=4, hidden_dims=(8, 8, 8))
+    mesh = make_mesh(2, 4)
+    rng = np.random.default_rng(1)
+    batches = _batches(rng, n=3)
+
+    ref_state = init_state(model, jax.random.key(3))
+    ref_step = make_train_step(model, learning_rate=0.05)
+    sh_state = init_sharded_state(model, mesh, jax.random.key(3))
+    sh_step = make_sharded_train_step(model, 0.05, mesh)
+
+    for b in batches:
+        ref_state, ref_loss = ref_step(ref_state, b)
+        sh_state, sh_loss = sh_step(sh_state, b)
+        np.testing.assert_allclose(float(sh_loss), float(ref_loss), rtol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(sh_state.table)[:V], np.asarray(ref_state.table), rtol=1e-4, atol=1e-6
+    )
+    for k in ref_state.dense:
+        np.testing.assert_allclose(
+            np.asarray(sh_state.dense[k]), np.asarray(ref_state.dense[k]), rtol=1e-4, atol=1e-6
+        )
+
+
+def test_table_actually_sharded():
+    model = FMModel(vocabulary_size=V, factor_num=4)
+    mesh = make_mesh(2, 4)
+    state = init_sharded_state(model, mesh, jax.random.key(0))
+    shard_shapes = {s.data.shape for s in state.table.addressable_shards}
+    assert shard_shapes == {(V // 4, 5)}
