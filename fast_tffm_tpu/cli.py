@@ -28,6 +28,10 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--resume", action="store_true", help="resume training from model_file")
     args = ap.parse_args(argv)
 
+    from fast_tffm_tpu.utils.platform import apply_platform_env
+
+    apply_platform_env()
+
     cfg = load_config(args.config)
     if args.legacy:
         print(

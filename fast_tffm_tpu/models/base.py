@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 
 
+@jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
 class Batch:
     """Device-side mirror of data.libsvm.ParsedBatch (jnp arrays)."""

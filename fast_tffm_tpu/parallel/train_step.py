@@ -139,10 +139,10 @@ def make_sharded_train_step(model, learning_rate: float, mesh: Mesh):
             _batch_specs(),
         ),
         out_specs=(P(ROW_AXIS, None), P(ROW_AXIS, None), dense_spec, dense_spec, P()),
-        check_rep=False,
+        check_vma=False,
     )
 
-    @jax.jit
+    @partial(jax.jit, donate_argnums=(0,))
     def step(state: TrainState, batch: Batch):
         table, accum, dense, dense_acc, loss = mapped(
             state.table, state.table_opt.accum, state.dense, state.dense_opt.accum, batch
@@ -169,7 +169,7 @@ def make_sharded_predict_step(model, mesh: Mesh):
         mesh=mesh,
         in_specs=(P(ROW_AXIS, None), dense_spec, _batch_specs()),
         out_specs=P(DATA_AXIS),
-        check_rep=False,
+        check_vma=False,
     )
 
     @jax.jit

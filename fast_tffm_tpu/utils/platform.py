@@ -1,0 +1,29 @@
+"""Make the JAX_PLATFORMS env var authoritative.
+
+Some TPU deployments register an ambient PJRT plugin at interpreter
+startup (via sitecustomize) that wins backend selection even when the
+user exported ``JAX_PLATFORMS=cpu`` — the env var survives but the plugin
+overrides the platform choice.  Re-applying the env value through
+``jax.config`` after import restores the documented env-var contract.
+
+Must run before the backend initializes (before the first
+``jax.devices()`` / array creation); afterwards it is a silent no-op.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+__all__ = ["apply_platform_env"]
+
+
+def apply_platform_env() -> None:
+    """Honor ``JAX_PLATFORMS`` even under ambient PJRT plugin overrides."""
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        try:
+            jax.config.update("jax_platforms", platforms)
+        except Exception:
+            pass  # backend already initialized; selection is fixed now

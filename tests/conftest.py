@@ -3,13 +3,25 @@
 Multi-chip sharding code paths (SURVEY.md §5: "multi-device tests via XLA
 host-device emulation") run on `--xla_force_host_platform_device_count=8`;
 real-TPU behavior is exercised by bench.py / the driver instead.
+
+NOTE: env vars alone are NOT enough on this box — the ambient axon TPU
+plugin re-forces `JAX_PLATFORMS=axon` during jax import (sitecustomize on
+PYTHONPATH), so we must also override via jax.config AFTER import, before
+any backend initialization.
 """
 
 import os
 
-# Overwrite (not setdefault): the box has a real TPU visible, and these
-# tests must run on the virtual CPU mesh regardless of ambient JAX_PLATFORMS.
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+# Multi-device tests gate themselves on len(jax.devices()) (test_parallel's
+# skipif), so no device-count assert here — an ambient XLA_FLAGS with a
+# smaller forced count must degrade to skips, not a collection error.
+assert jax.default_backend() == "cpu", "tests must run on the virtual CPU mesh"

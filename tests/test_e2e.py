@@ -21,7 +21,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _write_dataset(path, rng, n=300, vocab=200, nnz=8):
-    good = set(rng.permutation(vocab)[: vocab // 4].tolist())
+    # The "good" signal set must be identical across train/valid files, so it
+    # is drawn from a fixed-seed rng, not the caller's shared stream.
+    good = set(np.random.default_rng(42).permutation(vocab)[: vocab // 4].tolist())
     lines = []
     for _ in range(n):
         ids = rng.choice(vocab, size=nnz, replace=False)
