@@ -45,12 +45,18 @@ class Config:
     queue_size: int = 8  # prefetch depth
     log_every: int = 100
     save_every_epochs: int = 1
+    trace_dir: str = ""  # jax.profiler trace output (TensorBoard/XProf)
+    trace_steps: int = 20  # bounded trace window length (after warmup)
+    metrics_path: str = ""  # JSONL step-metrics sink
     # [Predict]
     predict_files: tuple[str, ...] = ()
     score_path: str = "scores.txt"
     # [Distributed]
     data_parallel: int = 0  # 0 = all devices / row_parallel
     row_parallel: int = 0  # 0 = vocabulary_block_num
+    coordinator_address: str = ""  # multi-host: host:port of process 0
+    num_processes: int = 0  # multi-host: total process count
+    process_id: int = -1  # multi-host: this process's index
 
     def validate(self) -> "Config":
         if self.model not in ("fm", "ffm", "deepfm"):
@@ -114,6 +120,9 @@ def load_config(path: str) -> Config:
     cfg.queue_size = get(t, "queue_size", int, cfg.queue_size)
     cfg.log_every = get(t, "log_every", int, cfg.log_every)
     cfg.save_every_epochs = get(t, "save_every_epochs", int, cfg.save_every_epochs)
+    cfg.trace_dir = get(t, "trace_dir", str, cfg.trace_dir)
+    cfg.trace_steps = get(t, "trace_steps", int, cfg.trace_steps)
+    cfg.metrics_path = get(t, "metrics_path", str, cfg.metrics_path)
 
     p = "Predict"
     cfg.predict_files = get(p, "predict_files", _split, cfg.predict_files)
@@ -122,6 +131,9 @@ def load_config(path: str) -> Config:
     d = "Distributed"
     cfg.data_parallel = get(d, "data_parallel", int, cfg.data_parallel)
     cfg.row_parallel = get(d, "row_parallel", int, cfg.row_parallel)
+    cfg.coordinator_address = get(d, "coordinator_address", str, cfg.coordinator_address)
+    cfg.num_processes = get(d, "num_processes", int, cfg.num_processes)
+    cfg.process_id = get(d, "process_id", int, cfg.process_id)
 
     return cfg.validate()
 

@@ -4,3 +4,6 @@ from fast_tffm_tpu.ops.fm import (  # noqa: F401
     fm_score_anova_raw,
     fm_score_order2_raw,
 )
+
+# fast_tffm_tpu.ops.pallas_anova is imported lazily (inside fm_score's
+# pallas branch) so CPU-only runs never load jax.experimental.pallas.

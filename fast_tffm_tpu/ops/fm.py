@@ -190,7 +190,9 @@ def fm_score_anova_raw(rows: jax.Array, vals: jax.Array, order: int) -> jax.Arra
 # ---------------------------------------------------------------------------
 
 
-def fm_score(rows: jax.Array, vals: jax.Array, order: int = 2) -> jax.Array:
+def fm_score(
+    rows: jax.Array, vals: jax.Array, order: int = 2, *, use_pallas: bool | None = None
+) -> jax.Array:
     """FM score for a padded batch.
 
     Args:
@@ -199,6 +201,8 @@ def fm_score(rows: jax.Array, vals: jax.Array, order: int = 2) -> jax.Array:
       vals:  [batch, max_nnz] feature values; 0.0 marks padding slots.
       order: interaction order ≥ 2.  order=2 uses the fused (Σv)²−Σv² path;
              order≥3 the ANOVA dynamic program.  Both carry hand-written VJPs.
+      use_pallas: route the order≥3 interaction DP through the Pallas TPU
+             kernel (ops/pallas_anova.py).  None = auto (TPU backend only).
 
     Returns:
       [batch] raw (pre-sigmoid) scores.
@@ -207,4 +211,17 @@ def fm_score(rows: jax.Array, vals: jax.Array, order: int = 2) -> jax.Array:
         raise ValueError(f"FM order must be >= 2, got {order}")
     if order == 2:
         return _fm_score_order2(rows, vals)
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    if use_pallas:
+        from fast_tffm_tpu.ops.pallas_anova import anova_inter
+
+        # Only the DP carries a hand-written (kernel) VJP; the linear term
+        # and z = v·x are cheap elementwise ops XLA autodiff handles best.
+        # Off-TPU the kernel runs in the Pallas interpreter, keeping this
+        # public path testable on the CPU mesh.
+        interpret = jax.default_backend() != "tpu"
+        linear = jnp.sum(rows[..., 0] * vals, axis=-1)
+        z = rows[..., 1:] * vals[..., None]
+        return linear + anova_inter(z, order, interpret)
     return _fm_score_anova(rows, vals, order)

@@ -1,0 +1,68 @@
+"""Multi-host (pod / multi-slice) process initialization.
+
+The reference ran one TF server per ps/worker task over gRPC
+(`renyi533/fast_tffm` :: dist trainer: ClusterSpec + tf.train.Server).
+The TPU-native equivalent is JAX multi-controller SPMD: every host runs
+the SAME program, `jax.distributed.initialize` wires the processes into
+one runtime, and the ('data','row') mesh then spans every chip of every
+host — collectives ride ICI within a slice and DCN across slices with no
+further code changes (the mesh IS the cluster).
+
+On TPU pods the coordinator/process topology is discovered from the TPU
+metadata automatically, so `initialize()` needs no arguments; explicit
+coordinator_address/num_processes/process_id (cfg or env) cover GPU/CPU
+clusters and manual setups.  Single-process runs skip initialization
+entirely — the local trainer works unchanged.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+__all__ = ["maybe_initialize_distributed", "is_multihost", "process_index"]
+
+_INITIALIZED = False
+
+
+def maybe_initialize_distributed(
+    coordinator_address: str = "",
+    num_processes: int = 0,
+    process_id: int = -1,
+) -> bool:
+    """Call jax.distributed.initialize when multi-host context is present.
+
+    Returns True if the distributed runtime was (already) initialized.
+    Priority: explicit args > JAX_COORDINATOR_ADDRESS env > TPU metadata
+    auto-detection (initialize() with no args when JAX_NUM_PROCESSES is
+    set).  A plain single-host launch returns False and touches nothing.
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return True
+    coordinator_address = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS", "")
+    env_np = int(os.environ.get("JAX_NUM_PROCESSES", "0"))
+    num_processes = num_processes or env_np
+    if process_id < 0:
+        process_id = int(os.environ.get("JAX_PROCESS_ID", "-1"))
+
+    if coordinator_address:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes or None,
+            process_id=None if process_id < 0 else process_id,
+        )
+        _INITIALIZED = True
+    elif num_processes > 1:
+        jax.distributed.initialize()  # TPU metadata auto-detection
+        _INITIALIZED = True
+    return _INITIALIZED
+
+
+def is_multihost() -> bool:
+    return jax.process_count() > 1
+
+
+def process_index() -> int:
+    return jax.process_index()

@@ -28,6 +28,7 @@ from fast_tffm_tpu.metrics import Throughput, auc
 from fast_tffm_tpu.models.base import Batch
 from fast_tffm_tpu.trainer import init_state, make_predict_step, make_train_step
 from fast_tffm_tpu.utils.prefetch import prefetch
+from fast_tffm_tpu.utils.tracing import MetricsLogger, WindowTracer, step_trace
 
 __all__ = ["train", "dist_train", "scan_max_nnz"]
 
@@ -78,28 +79,50 @@ def _run_training(cfg: Config, state, step_fn, predict_step, max_nnz, log=print)
     n_chips = jax.device_count()
     meter = Throughput()
     losses = []
-    start_step = int(state.step)
-    for epoch in range(cfg.epoch_num):
-        for parsed, w in _stream(cfg, cfg.train_files, max_nnz, epochs=1):
-            b = Batch.from_parsed(parsed, w)
-            state, loss = step_fn(state, b)
-            losses.append(loss)  # device value; only sync at log points
-            meter.add(parsed.batch_size)
-            if len(losses) >= cfg.log_every:
-                rate = meter.rate()
-                log(
-                    f"step {int(state.step)} epoch {epoch} "
-                    f"loss {np.mean([float(l) for l in losses]):.5f} "
-                    f"examples/sec {rate:,.0f} (/chip {rate / n_chips:,.0f})"
-                )
-                losses.clear()
-                meter.reset()
-        if cfg.validation_files:
-            val_auc = _evaluate(cfg, predict_step, state, cfg.validation_files, max_nnz)
-            log(f"epoch {epoch} validation auc {val_auc:.5f}")
-        if cfg.save_every_epochs and (epoch + 1) % cfg.save_every_epochs == 0:
-            save_checkpoint(cfg.model_file, state)
-            log(f"epoch {epoch} checkpoint -> {cfg.model_file}")
+    start_step = step_num = int(state.step)
+    # On multi-host pods every process runs this loop; only process 0 owns
+    # the metrics file and profiler trace (shared filesystems would get N
+    # interleaved copies otherwise).
+    is_lead = jax.process_index() == 0
+    tracer = WindowTracer(cfg.trace_dir if is_lead else None, count=cfg.trace_steps)
+    metrics = MetricsLogger(cfg.metrics_path if is_lead else None)
+    try:
+        for epoch in range(cfg.epoch_num):
+            for parsed, w in _stream(cfg, cfg.train_files, max_nnz, epochs=1):
+                b = Batch.from_parsed(parsed, w)
+                tracer.on_step()
+                with step_trace("train", step_num):
+                    state, loss = step_fn(state, b)
+                step_num += 1
+                losses.append(loss)  # device value; only sync at log points
+                meter.add(parsed.batch_size)
+                if len(losses) >= cfg.log_every:
+                    rate = meter.rate()
+                    mean_loss = np.mean([float(l) for l in losses])
+                    log(
+                        f"step {int(state.step)} epoch {epoch} "
+                        f"loss {mean_loss:.5f} "
+                        f"examples/sec {rate:,.0f} (/chip {rate / n_chips:,.0f})"
+                    )
+                    metrics.log(
+                        step=int(state.step),
+                        epoch=epoch,
+                        loss=round(float(mean_loss), 6),
+                        examples_per_sec=round(rate, 1),
+                        examples_per_sec_per_chip=round(rate / n_chips, 1),
+                    )
+                    losses.clear()
+                    meter.reset()
+            if cfg.validation_files:
+                val_auc = _evaluate(cfg, predict_step, state, cfg.validation_files, max_nnz)
+                log(f"epoch {epoch} validation auc {val_auc:.5f}")
+                metrics.log(step=int(state.step), epoch=epoch, validation_auc=round(val_auc, 6))
+            if cfg.save_every_epochs and (epoch + 1) % cfg.save_every_epochs == 0:
+                save_checkpoint(cfg.model_file, state)
+                log(f"epoch {epoch} checkpoint -> {cfg.model_file}")
+    finally:
+        tracer.close()
+        metrics.close()
     save_checkpoint(cfg.model_file, state)
     log(f"training done: steps {start_step}->{int(state.step)}, model -> {cfg.model_file}")
     return state
@@ -132,9 +155,11 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
         make_sharded_predict_step,
         make_sharded_train_step,
     )
+    from fast_tffm_tpu.parallel.multihost import maybe_initialize_distributed
 
     if not cfg.train_files:
         raise ValueError("no train_files configured")
+    maybe_initialize_distributed(cfg.coordinator_address, cfg.num_processes, cfg.process_id)
     model = build_model(cfg)
     max_nnz = scan_max_nnz(cfg)
     if mesh is None:

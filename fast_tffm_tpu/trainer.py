@@ -46,7 +46,7 @@ def init_state(model, key: jax.Array, init_accumulator_value: float = 0.1) -> Tr
         table_opt=init_adagrad(table, init_accumulator_value),
         dense=dense,
         dense_opt=init_adagrad(dense, init_accumulator_value),
-        step=jnp.zeros((), jnp.int64),
+        step=jnp.zeros((), jnp.int32),
     )
 
 

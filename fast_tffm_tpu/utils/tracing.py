@@ -1,0 +1,112 @@
+"""Profiling, step annotation, and structured metrics.
+
+The reference had no tracing beyond periodic loss prints (SURVEY.md §5:
+TF-1.x RunMetadata existed but was never wired).  The TPU build makes the
+profiler a config key away:
+
+  * ``maybe_trace(trace_dir)`` — wraps a training run in a
+    ``jax.profiler`` trace when ``trace_dir`` is configured (viewable in
+    TensorBoard/XProf; captures XLA ops, fusion, HBM traffic);
+  * ``step_trace(name, step)`` — per-step TraceAnnotation so device steps
+    line up with host timeline rows;
+  * ``MetricsLogger`` — optional JSONL sink for step metrics (loss,
+    examples/sec, AUC) next to the stdout log, one object per line.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+
+import jax
+
+__all__ = ["maybe_trace", "WindowTracer", "step_trace", "MetricsLogger"]
+
+
+@contextlib.contextmanager
+def maybe_trace(trace_dir: str | None):
+    """jax.profiler.trace(trace_dir) when set; no-op otherwise.
+
+    Wraps whatever the caller scopes it to — prefer WindowTracer for long
+    training runs (whole-run traces are multi-GB and skew throughput).
+    """
+    if not trace_dir:
+        yield
+        return
+    os.makedirs(trace_dir, exist_ok=True)
+    with jax.profiler.trace(trace_dir):
+        yield
+
+
+class WindowTracer:
+    """Trace a bounded step window [skip, skip + count) of a long run.
+
+    Whole-run profiler traces are unusable (GBs, XProf won't load them)
+    and their host-side overhead skews the throughput being measured, so
+    tracing starts after ``skip`` steps (letting compilation and warmup
+    fall outside the window) and stops after ``count`` traced steps.
+    No-op when ``trace_dir`` is empty.
+    """
+
+    def __init__(self, trace_dir: str | None, *, skip: int = 5, count: int = 20):
+        self._dir = trace_dir or None
+        self._skip = skip
+        self._count = count
+        self._seen = 0
+        self._active = False
+
+    def on_step(self) -> None:
+        """Call once per train step (before/after — consistency is all)."""
+        if self._dir is None:
+            return
+        if not self._active and self._seen == self._skip:
+            os.makedirs(self._dir, exist_ok=True)
+            jax.profiler.start_trace(self._dir)
+            self._active = True
+        elif self._active and self._seen >= self._skip + self._count:
+            jax.profiler.stop_trace()
+            self._active = False
+            self._dir = None  # one window per run
+        self._seen += 1
+
+    def close(self) -> None:
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+            self._dir = None
+
+
+def step_trace(name: str, step: int):
+    """Annotate one train/eval step on the profiler timeline."""
+    return jax.profiler.StepTraceAnnotation(name, step_num=step)
+
+
+class MetricsLogger:
+    """Append-only JSONL metrics sink (no-op when path is empty)."""
+
+    def __init__(self, path: str | None):
+        self._f = None
+        if path:
+            dirpart = os.path.dirname(path)
+            if dirpart:
+                os.makedirs(dirpart, exist_ok=True)
+            self._f = open(path, "a", buffering=1)
+
+    def log(self, **fields) -> None:
+        if self._f is None:
+            return
+        fields.setdefault("ts", round(time.time(), 3))
+        self._f.write(json.dumps(fields) + "\n")
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
