@@ -1,12 +1,24 @@
 """Checkpoint save/restore for train state.
 
 Capability parity with the reference's `tf.train.Saver` → `model_file`
-(`renyi533/fast_tffm` :: local/dist trainer save + predictor restore).
-Format: a single .npz holding the sparse table, Adagrad accumulators,
-flattened dense params, and the step counter.  Restore is
-mesh-shape-agnostic: arrays are loaded on host and re-placed with whatever
-shardings the caller supplies (SURVEY.md §5: "restore-compatible across
-mesh shapes").
+(`renyi533/fast_tffm` :: local/dist trainer save + predictor restore), in
+two formats:
+
+  * **npz** — a single atomic .npz holding the sparse table, Adagrad
+    accumulators, flattened dense params, and the step counter.  Simple,
+    single-file, but gathers everything to one host — right for vocabs
+    that fit host RAM.
+  * **orbax** — a sharded Orbax checkpoint directory: every host writes
+    only its own table shards in parallel (OCDBT).  The only format that
+    works at the 10B-parameter-table scale (BASELINE north star), where no
+    single host can materialize the table.
+
+Both restores are mesh-shape-agnostic (SURVEY.md §5: "restore-compatible
+across mesh shapes"): arrays are re-placed with whatever shardings the
+caller's ``like`` state supplies; a vocab-padding mismatch (different
+row-shard counts pad the table differently) is reconciled by re-padding
+with the ``like`` state's init rows.  Format is auto-detected on restore
+(directory = orbax, file = npz).
 """
 
 from __future__ import annotations
@@ -22,14 +34,18 @@ from fast_tffm_tpu.trainer import TrainState
 __all__ = ["save_checkpoint", "restore_checkpoint", "latest_step"]
 
 
-def save_checkpoint(path: str, state: TrainState) -> None:
-    """Atomically write ``state`` to ``path`` (.npz)."""
+# ---------------------------------------------------------------------------
+# npz format
+# ---------------------------------------------------------------------------
+
+
+def _save_npz(path: str, state: TrainState) -> None:
     flat = {
         "table": np.asarray(state.table),
         "table_accum": np.asarray(state.table_opt.accum),
         "step": np.asarray(state.step),
     }
-    dense_leaves, dense_def = jax.tree.flatten(state.dense)
+    dense_leaves, _dense_def = jax.tree.flatten(state.dense)
     acc_leaves, _ = jax.tree.flatten(state.dense_opt.accum)
     for i, (p, a) in enumerate(zip(dense_leaves, acc_leaves)):
         flat[f"dense_{i}"] = np.asarray(p)
@@ -43,27 +59,133 @@ def save_checkpoint(path: str, state: TrainState) -> None:
     os.replace(tmp, path)
 
 
+def _load_npz(path: str, like: TrainState):
+    with np.load(path) as z:
+        dense_leaves, _ = jax.tree.flatten(like.dense)
+        return (
+            z["table"],
+            z["table_accum"],
+            [z[f"dense_{i}"] for i in range(len(dense_leaves))],
+            [z[f"dense_accum_{i}"] for i in range(len(dense_leaves))],
+            z["step"],
+        )
+
+
+# ---------------------------------------------------------------------------
+# orbax format
+# ---------------------------------------------------------------------------
+
+
+_STEP_SIDECAR = "TRAIN_STEP"
+
+
+def _save_orbax(path: str, state: TrainState) -> None:
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, state, force=True)
+    ckptr.wait_until_finished()
+    if jax.process_index() == 0:
+        # Tiny sidecar (next to the dir — orbax owns the dir's contents) so
+        # latest_step never has to restore the possibly larger-than-host-RAM
+        # table just to read one scalar.
+        with open(path + "." + _STEP_SIDECAR, "w") as f:
+            f.write(str(int(state.step)))
+
+
+def _orbax_table_shape(path: str):
+    """Saved table's global shape from checkpoint metadata (no data reads)."""
+    import orbax.checkpoint as ocp
+
+    meta = ocp.StandardCheckpointer().metadata(os.path.abspath(path))
+    item = getattr(meta, "item_metadata", meta)
+    table_meta = item.table if hasattr(item, "table") else item["table"]
+    return tuple(table_meta.shape)
+
+
+def _restore_orbax_inplace(path: str, like: TrainState):
+    """Sharded restore straight onto ``like``'s placement (no host gather).
+
+    Real restore failures (corrupt checkpoint, version mismatch) propagate;
+    only a table-shape mismatch (vocab re-padding across mesh shapes) makes
+    the caller take the host-side re-pad path, decided via metadata before
+    any data is read.
+    """
+    import orbax.checkpoint as ocp
+
+    if _orbax_table_shape(path) != tuple(like.table.shape):
+        return None
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding), like
+    )
+    return ocp.StandardCheckpointer().restore(os.path.abspath(path), abstract)
+
+
+def _load_orbax_host(path: str, like: TrainState):
+    import orbax.checkpoint as ocp
+
+    raw = ocp.StandardCheckpointer().restore(os.path.abspath(path))
+    table = np.asarray(raw.table if hasattr(raw, "table") else raw["table"])
+    if hasattr(raw, "table_opt"):
+        accum = np.asarray(raw.table_opt.accum)
+        dense = raw.dense
+        dense_acc = raw.dense_opt.accum
+        step = np.asarray(raw.step)
+    else:
+        accum = np.asarray(raw["table_opt"]["accum"])
+        dense = raw["dense"]
+        dense_acc = raw["dense_opt"]["accum"]
+        step = np.asarray(raw["step"])
+    dense_leaves = [np.asarray(x) for x in jax.tree.leaves(dense)]
+    acc_leaves = [np.asarray(x) for x in jax.tree.leaves(dense_acc)]
+    return table, accum, dense_leaves, acc_leaves, step
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+
+def save_checkpoint(path: str, state: TrainState, format: str = "auto") -> None:
+    """Write ``state`` to ``path``.
+
+    format: 'npz' | 'orbax' | 'auto' (auto = orbax when the path looks like
+    a directory target — trailing slash or '.orbax' suffix — else npz).
+    """
+    if format == "auto":
+        format = "orbax" if path.endswith((".orbax", "/")) or os.path.isdir(path) else "npz"
+    if format == "orbax":
+        _save_orbax(path.rstrip("/"), state)
+    elif format == "npz":
+        _save_npz(path, state)
+    else:
+        raise ValueError(f"unknown checkpoint format {format!r}")
+
+
 def restore_checkpoint(path: str, like: TrainState) -> TrainState:
     """Load ``path`` into the structure (and shardings) of ``like``.
 
     ``like`` supplies the dense pytree structure and the target placement:
-    each loaded array is device_put with the corresponding array's sharding,
-    so a checkpoint written on one mesh restores onto another (or onto a
-    single device).
+    each loaded array lands with the corresponding array's sharding, so a
+    checkpoint written on one mesh restores onto another (or onto a single
+    device).  Orbax checkpoints with matching shapes restore shard-parallel
+    with no host gather.
     """
-    with np.load(path) as z:
-        table = z["table"]
-        table_accum = z["table_accum"]
-        step = z["step"]
-        dense_leaves, dense_def = jax.tree.flatten(like.dense)
-        new_dense = [z[f"dense_{i}"] for i in range(len(dense_leaves))]
-        new_accum = [z[f"dense_accum_{i}"] for i in range(len(dense_leaves))]
+    path = path.rstrip("/")
+    if os.path.isdir(path):
+        restored = _restore_orbax_inplace(path, like)
+        if restored is not None:
+            return restored
+        table, table_accum, new_dense, new_accum, step = _load_orbax_host(path, like)
+    else:
+        table, table_accum, new_dense, new_accum, step = _load_npz(path, like)
 
     if table.shape[0] != like.table.shape[0]:
         # Mesh-shape change ⇒ different vocab padding; re-pad with init rows.
         v = min(table.shape[0], like.table.shape[0])
-        host_table = np.asarray(like.table)
-        host_accum = np.asarray(like.table_opt.accum)
+        host_table = np.array(like.table)  # writable host copies
+        host_accum = np.array(like.table_opt.accum)
         host_table[:v] = table[:v]
         host_accum[:v] = table_accum[:v]
         table, table_accum = host_table, host_accum
@@ -71,6 +193,7 @@ def restore_checkpoint(path: str, like: TrainState) -> TrainState:
     def put(arr, target):
         return jax.device_put(np.asarray(arr), target.sharding)
 
+    dense_leaves, dense_def = jax.tree.flatten(like.dense)
     return TrainState(
         table=put(table, like.table),
         table_opt=AdagradState(put(table_accum, like.table_opt.accum)),
@@ -89,9 +212,13 @@ def restore_checkpoint(path: str, like: TrainState) -> TrainState:
 
 def latest_step(path: str) -> int | None:
     """Step stored in a checkpoint, or None if absent/unreadable."""
+    path = path.rstrip("/")
     if not os.path.exists(path):
         return None
     try:
+        if os.path.isdir(path):
+            with open(path + "." + _STEP_SIDECAR) as f:
+                return int(f.read().strip())
         with np.load(path) as z:
             return int(z["step"])
     except Exception:

@@ -29,6 +29,7 @@ class Config:
     vocabulary_block_num: int = 1  # reference key; default row_parallel
     hash_feature_id: bool = False
     model_file: str = "model.ckpt"
+    checkpoint_format: str = "npz"  # npz | orbax (orbax = sharded, pod-scale)
     # [Train]
     train_files: tuple[str, ...] = ()
     weight_files: tuple[float, ...] = ()  # per-file example weights
@@ -67,6 +68,8 @@ class Config:
             raise ValueError("order must be >= 2")
         if self.vocabulary_size <= 0 or self.batch_size <= 0:
             raise ValueError("vocabulary_size and batch_size must be positive")
+        if self.checkpoint_format not in ("npz", "orbax"):
+            raise ValueError(f"unknown checkpoint_format {self.checkpoint_format!r}")
         return self
 
 
@@ -99,6 +102,7 @@ def load_config(path: str) -> Config:
     cfg.vocabulary_block_num = get(g, "vocabulary_block_num", int, cfg.vocabulary_block_num)
     cfg.hash_feature_id = get(g, "hash_feature_id", ini._convert_to_boolean, cfg.hash_feature_id)
     cfg.model_file = get(g, "model_file", str, cfg.model_file)
+    cfg.checkpoint_format = get(g, "checkpoint_format", str, cfg.checkpoint_format).lower()
 
     t = "Train"
     cfg.train_files = get(t, "train_files", _split, cfg.train_files)

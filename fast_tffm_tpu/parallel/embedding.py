@@ -4,17 +4,22 @@ TPU-native replacement for the reference's sharded parameter lookup
 (`renyi533/fast_tffm` :: model-graph builder: feature ids routed to
 `vocabulary_block_num` block variables by modulo, gathered over worker→ps
 RPC, with gradients scatter-added back asynchronously).  Here the table is
-contiguously row-sharded over the mesh ROW_AXIS and the lookup/update are
-deterministic XLA collectives inside `shard_map`:
+contiguously row-sharded over the mesh ROW_AXIS, the batch is sharded over
+BOTH mesh axes (every chip computes a distinct micro-batch — no redundant
+compute anywhere), and the lookup/update are deterministic XLA collectives
+inside `shard_map`:
 
-  lookup:  every row shard gathers the rows it owns (others masked to 0)
-           and a `psum` over ROW_AXIS assembles full rows on all shards —
-           ids travel nowhere (they are replicated over ROW_AXIS already);
-           only owned rows ride the ICI ring once.
-  update:  per-occurrence row gradients are deduped locally, `all_gather`ed
-           over DATA_AXIS (replacing Hogwild's racy async scatter with a
-           deterministic synchronous combine), re-deduped, and each shard
-           applies sparse Adagrad to the rows it owns — no second collective.
+  lookup:  each chip all_gathers the (tiny, int32) ids of its ROW_AXIS
+           peers, gathers the rows it owns (others masked to 0), and a
+           `psum_scatter` over ROW_AXIS returns each requesting chip
+           exactly its own rows — every parameter row crosses ICI once,
+           and the heavy [*, N, D] float traffic rides the same
+           reduce-scatter that a dense sharded matmul would use.
+  update:  per-occurrence row gradients are deduped locally (sort +
+           segment-sum, static shapes), all_gathered over BOTH axes
+           (replacing Hogwild's racy async scatter with a deterministic
+           synchronous combine), re-deduped, and each shard applies sparse
+           Adagrad to the rows it owns — no second collective.
 
 These functions run INSIDE a shard_map body (parallel/train_step.py).
 """
@@ -32,19 +37,24 @@ __all__ = ["sharded_gather", "sharded_sparse_adagrad_update"]
 
 
 def sharded_gather(table_shard: jax.Array, ids: jax.Array) -> jax.Array:
-    """Assemble full parameter rows for ``ids`` from the row-sharded table.
+    """Assemble this chip's parameter rows from the row-sharded table.
 
     table_shard: [V/R, D] this shard's contiguous rows.
-    ids:         [B_local, N] global row ids (replicated over ROW_AXIS).
-    Returns:     [B_local, N, D] full rows, identical on every row shard.
+    ids:         [B_local, N] global row ids for THIS chip's micro-batch
+                 (batch is sharded over data AND row axes).
+    Returns:     [B_local, N, D] rows for this chip's ids.
     """
     shard_rows = table_shard.shape[0]
     base = lax.axis_index(ROW_AXIS) * shard_rows
-    local = ids - base
+    # Ids are int32 and tiny next to D-wide rows; gather all ROW peers' ids,
+    # serve the rows we own, and reduce-scatter each peer its answers (each
+    # row is owned by exactly one shard, so the sum IS the row).
+    all_ids = lax.all_gather(ids, ROW_AXIS, tiled=True)  # [R*B_local, N]
+    local = all_ids - base
     owned = (local >= 0) & (local < shard_rows)
     local = jnp.where(owned, local, 0)
     rows = table_shard[local] * owned[..., None].astype(table_shard.dtype)
-    return lax.psum(rows, ROW_AXIS)
+    return lax.psum_scatter(rows, ROW_AXIS, scatter_dimension=0, tiled=True)
 
 
 def sharded_sparse_adagrad_update(
@@ -58,16 +68,16 @@ def sharded_sparse_adagrad_update(
     """Sparse Adagrad on the local row shard from global per-occurrence grads.
 
     Dedup happens twice: locally (cheap, shrinks the all_gather payload's
-    effective content) and again after gathering all data shards'
+    effective content) and again after gathering every chip's
     contributions, because the same row id can be touched by several
-    data-parallel workers and Adagrad must see the fully summed gradient
-    exactly once (the determinism the reference's Hogwild explicitly gave
-    up — SURVEY.md §4.2).
+    micro-batches and Adagrad must see the fully summed gradient exactly
+    once (the determinism the reference's Hogwild explicitly gave up —
+    SURVEY.md §4.2).
     """
     D = table_shard.shape[-1]
     uids, gsum = dedup_rows(ids.reshape(-1), row_grads.reshape(-1, D), num_rows_global)
-    all_uids = lax.all_gather(uids, DATA_AXIS, tiled=True)  # [W*M]
-    all_gsum = lax.all_gather(gsum, DATA_AXIS, tiled=True)  # [W*M, D]
+    all_uids = lax.all_gather(uids, (DATA_AXIS, ROW_AXIS), tiled=True)  # [P*M]
+    all_gsum = lax.all_gather(gsum, (DATA_AXIS, ROW_AXIS), tiled=True)  # [P*M, D]
     # Sentinel ids (num_rows_global) from short shards collapse into one
     # segment and are dropped again below.
     guids, ggsum = dedup_rows(all_uids, all_gsum, num_rows_global)

@@ -54,8 +54,10 @@ def table_sharding(mesh: Mesh) -> NamedSharding:
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
-    """Batch-major arrays: leading dim over DATA_AXIS, replicated over ROW."""
-    return NamedSharding(mesh, P(DATA_AXIS))
+    """Batch-major arrays: leading dim split over EVERY chip (both axes) —
+    matches the train/predict steps' batch specs (compute is fully
+    data-parallel; only the table is row-sharded)."""
+    return NamedSharding(mesh, P((DATA_AXIS, ROW_AXIS)))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

@@ -5,11 +5,14 @@ The distributed trainer, TPU-first: one jitted SPMD program per step over a
 (`renyi533/fast_tffm` :: dist trainer: between-graph replication,
 Supervisor, asynchronous Hogwild scatter-adds over gRPC).  Per step:
 
-  gather:   psum over ROW_AXIS assembles touched rows (parallel/embedding)
-  compute:  fused FM scorer + loss, batch split over DATA_AXIS
-  combine:  all_gather(DATA_AXIS) of deduped sparse row grads +
-            psum(DATA_AXIS) of dense grads — deterministic sync replacing
-            Hogwild races
+  gather:   ids all_gathered + rows psum_scattered over ROW_AXIS
+            (parallel/embedding) — each parameter row crosses ICI once
+  compute:  fused FM scorer + loss; the batch is split over BOTH mesh
+            axes, so every chip scores a distinct micro-batch (no
+            redundant compute on the row axis)
+  combine:  all_gather over both axes of deduped sparse row grads +
+            psum of dense grads — deterministic sync replacing Hogwild
+            races
   update:   each row shard applies sparse Adagrad to its own rows
 
 Semantics match trainer.py's single-device step exactly (tested on the
@@ -32,7 +35,6 @@ from fast_tffm_tpu.parallel.embedding import sharded_gather, sharded_sparse_adag
 from fast_tffm_tpu.parallel.mesh import (
     DATA_AXIS,
     ROW_AXIS,
-    batch_sharding,
     pad_vocab,
     replicated,
     table_sharding,
@@ -52,13 +54,18 @@ def _state_specs():
     )
 
 
+_BOTH = (DATA_AXIS, ROW_AXIS)
+
+
 def _batch_specs() -> Batch:
+    # The batch splits over every chip (both mesh axes): compute is fully
+    # data-parallel; only the table is row-sharded.
     return Batch(
-        labels=P(DATA_AXIS),
-        ids=P(DATA_AXIS, None),
-        vals=P(DATA_AXIS, None),
-        fields=P(DATA_AXIS, None),
-        weights=P(DATA_AXIS),
+        labels=P(_BOTH),
+        ids=P(_BOTH, None),
+        vals=P(_BOTH, None),
+        fields=P(_BOTH, None),
+        weights=P(_BOTH),
     )
 
 
@@ -91,7 +98,8 @@ def init_sharded_state(model, mesh: Mesh, key, init_accumulator_value: float = 0
 def make_sharded_train_step(model, learning_rate: float, mesh: Mesh):
     """Returns jitted SPMD ``step(state, batch) -> (state, global mean loss)``.
 
-    Batch arrays must have leading dim divisible by mesh.shape['data'].
+    Batch arrays must have leading dim divisible by the total device count
+    (the batch splits over both mesh axes).
     """
     model = _pad_model_vocab(model, mesh)
     num_rows_global = model.vocabulary_size
@@ -107,7 +115,7 @@ def make_sharded_train_step(model, learning_rate: float, mesh: Mesh):
                 - scores * batch.labels
                 + jnp.log1p(jnp.exp(-jnp.abs(scores)))
             )
-            denom = jnp.maximum(lax.psum(jnp.sum(batch.weights), DATA_AXIS), 1.0)
+            denom = jnp.maximum(lax.psum(jnp.sum(batch.weights), _BOTH), 1.0)
             data_loss = jnp.sum(per * batch.weights) / denom
             reg = model.regularization(rows, dense, batch)
             return data_loss + reg, data_loss
@@ -119,12 +127,12 @@ def make_sharded_train_step(model, learning_rate: float, mesh: Mesh):
             table, accum, batch.ids, g_rows, learning_rate, num_rows_global
         )
         if jax.tree.leaves(dense):
-            g_dense = lax.psum(g_dense, DATA_AXIS)
+            g_dense = lax.psum(g_dense, _BOTH)
             dense, dense_acc = dense_adagrad_update(
                 dense, AdagradState(dense_acc), g_dense, learning_rate
             )
             dense_acc = dense_acc.accum
-        data_loss = lax.psum(data_loss_local, DATA_AXIS)
+        data_loss = lax.psum(data_loss_local, _BOTH)
         return table, accum, dense, dense_acc, data_loss
 
     dense_spec = jax.tree.map(lambda _: P(), model.init_dense(jax.random.key(0)))
@@ -161,14 +169,18 @@ def make_sharded_predict_step(model, mesh: Mesh):
 
     def shard_body(table, dense, batch: Batch):
         rows = sharded_gather(table, batch.ids)
-        return jax.nn.sigmoid(model.score(rows, dense, batch))
+        scores = jax.nn.sigmoid(model.score(rows, dense, batch))
+        # Replicate the (tiny, [B]) score vector so the result is fetchable
+        # on every process of a multi-host mesh — a P(('data','row'))-sharded
+        # output would span non-addressable devices there.
+        return lax.all_gather(scores, _BOTH, tiled=True)
 
     dense_spec = jax.tree.map(lambda _: P(), model.init_dense(jax.random.key(0)))
     mapped = shard_map(
         shard_body,
         mesh=mesh,
         in_specs=(P(ROW_AXIS, None), dense_spec, _batch_specs()),
-        out_specs=P(DATA_AXIS),
+        out_specs=P(),
         check_vma=False,
     )
 

@@ -26,8 +26,15 @@ __all__ = ["predict", "dist_predict"]
 def _run_predict(cfg: Config, state, predict_step, max_nnz, log=print) -> str:
     if not cfg.predict_files:
         raise ValueError("no predict_files configured")
+    # Multi-host: the sharded predict step is ONE SPMD program over the
+    # global mesh, so every process must feed identical batches (the mesh
+    # shards them internally over all chips — that IS the work split, the
+    # reference's dist_predict file sharding done at chip granularity);
+    # replicated scores come back on every process, process 0 writes them.
+    is_lead = jax.process_index() == 0
     n = 0
-    with open(cfg.score_path, "w") as out:
+    out = open(cfg.score_path, "w") if is_lead else None
+    try:
         stream = batch_stream(
             cfg.predict_files,
             batch_size=cfg.batch_size,
@@ -40,9 +47,13 @@ def _run_predict(cfg: Config, state, predict_step, max_nnz, log=print) -> str:
             b = Batch.from_parsed(parsed, w)
             scores = np.asarray(predict_step(state, b))
             real = w > 0  # drop batch-size padding rows
-            for s in scores[real]:
-                out.write(f"{s:.6f}\n")
+            if out is not None:
+                for s in scores[real]:
+                    out.write(f"{s:.6f}\n")
             n += int(real.sum())
+    finally:
+        if out is not None:
+            out.close()
     log(f"wrote {n} scores -> {cfg.score_path}")
     return cfg.score_path
 

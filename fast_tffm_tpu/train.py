@@ -84,6 +84,12 @@ def _run_training(cfg: Config, state, step_fn, predict_step, max_nnz, log=print)
     # the metrics file and profiler trace (shared filesystems would get N
     # interleaved copies otherwise).
     is_lead = jax.process_index() == 0
+    ckpt_format = cfg.checkpoint_format
+    if jax.process_count() > 1 and ckpt_format == "npz":
+        # npz gathers the table to one host — impossible once shards live on
+        # other processes; orbax writes each host's shards in parallel.
+        log("note: multi-host run — switching checkpoint_format npz -> orbax")
+        ckpt_format = "orbax"
     tracer = WindowTracer(cfg.trace_dir if is_lead else None, count=cfg.trace_steps)
     metrics = MetricsLogger(cfg.metrics_path if is_lead else None)
     try:
@@ -118,12 +124,12 @@ def _run_training(cfg: Config, state, step_fn, predict_step, max_nnz, log=print)
                 log(f"epoch {epoch} validation auc {val_auc:.5f}")
                 metrics.log(step=int(state.step), epoch=epoch, validation_auc=round(val_auc, 6))
             if cfg.save_every_epochs and (epoch + 1) % cfg.save_every_epochs == 0:
-                save_checkpoint(cfg.model_file, state)
+                save_checkpoint(cfg.model_file, state, ckpt_format)
                 log(f"epoch {epoch} checkpoint -> {cfg.model_file}")
     finally:
         tracer.close()
         metrics.close()
-    save_checkpoint(cfg.model_file, state)
+    save_checkpoint(cfg.model_file, state, ckpt_format)
     log(f"training done: steps {start_step}->{int(state.step)}, model -> {cfg.model_file}")
     return state
 
