@@ -177,6 +177,17 @@ def restore_checkpoint(path: str, like: TrainState) -> TrainState:
         restored = _restore_orbax_inplace(path, like)
         if restored is not None:
             return restored
+        if jax.process_count() > 1:
+            # The re-pad fallback materializes the table on every host and
+            # writes through a host copy of `like` — both impossible once
+            # shards live on non-addressable devices.  Fail with the remedy
+            # rather than OOM-ing or crashing mid-gather.
+            raise RuntimeError(
+                f"checkpoint {path!r} has table shape {_orbax_table_shape(path)} "
+                f"but this mesh expects {tuple(like.table.shape)} — multi-host "
+                "restore needs a matching padded vocab (same row-shard count), "
+                "or a single-host re-pad pass first"
+            )
         table, table_accum, new_dense, new_accum, step = _load_orbax_host(path, like)
     else:
         table, table_accum, new_dense, new_accum, step = _load_npz(path, like)

@@ -14,12 +14,53 @@ from __future__ import annotations
 
 import ctypes
 import os
+import shutil
+import subprocess
 
 import numpy as np
 
 from fast_tffm_tpu.data.libsvm import ParsedBatch
 
 _SO_PATH = os.path.join(os.path.dirname(__file__), "_libsvm_parser.so")
+_CSRC_DIR = os.path.join(os.path.dirname(__file__), os.pardir, os.pardir, "csrc")
+_BUILD_ATTEMPTED = False
+
+
+def _try_build() -> None:
+    """Build the .so from csrc/ once per process if a toolchain is present.
+
+    The reference shipped its kernels as a compile-it-yourself Makefile; here
+    the build is a sub-second g++ invocation, so running it lazily on first
+    use keeps the fast path on by default without a packaging step.  Any
+    failure (no make/g++, read-only tree, concurrent writer) just leaves the
+    pure-Python parser in place.
+    """
+    global _BUILD_ATTEMPTED
+    if _BUILD_ATTEMPTED:
+        return
+    _BUILD_ATTEMPTED = True
+    if not os.path.isdir(_CSRC_DIR) or not shutil.which("make"):
+        return
+    # Build to a process-unique name, then atomically rename into place:
+    # concurrent processes (multi-host pods share the filesystem) must never
+    # dlopen a half-written ELF.
+    tmp = f"{_SO_PATH}.{os.getpid()}.tmp"
+    try:
+        subprocess.run(
+            ["make", "-C", _CSRC_DIR, f"OUT={tmp}"],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        os.replace(tmp, _SO_PATH)
+    except (subprocess.SubprocessError, OSError):
+        pass
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
 
 _ERRORS = {
     1: "empty line",
@@ -106,7 +147,9 @@ class NativeParser:
 
 
 def load_native_parser() -> NativeParser | None:
-    """Load the C++ parser if built; None → caller uses the Python parser."""
+    """Load the C++ parser, building it on first use; None → Python fallback."""
+    if not os.path.exists(_SO_PATH):
+        _try_build()
     if not os.path.exists(_SO_PATH):
         return None
     try:

@@ -54,7 +54,8 @@ def _run_predict(cfg: Config, state, predict_step, max_nnz, log=print) -> str:
     finally:
         if out is not None:
             out.close()
-    log(f"wrote {n} scores -> {cfg.score_path}")
+    if is_lead:
+        log(f"wrote {n} scores -> {cfg.score_path}")
     return cfg.score_path
 
 

@@ -14,6 +14,7 @@ cluster with async Hogwild updates).  Differences, all TPU-first:
 
 from __future__ import annotations
 
+import os
 import sys
 import time
 
@@ -88,7 +89,17 @@ def _run_training(cfg: Config, state, step_fn, predict_step, max_nnz, log=print)
     if jax.process_count() > 1 and ckpt_format == "npz":
         # npz gathers the table to one host — impossible once shards live on
         # other processes; orbax writes each host's shards in parallel.
+        # Import NOW so a missing orbax fails before hours of training, not
+        # at the first end-of-epoch save.
+        import orbax.checkpoint  # noqa: F401
+
         log("note: multi-host run — switching checkpoint_format npz -> orbax")
+        ckpt_format = "orbax"
+    elif ckpt_format == "npz" and os.path.isdir(cfg.model_file):
+        # model_file already holds an orbax directory (e.g. an earlier
+        # multi-host run): an npz os.replace onto it would crash at save
+        # time, after training.  Stay in the format the path already has.
+        log(f"note: {cfg.model_file} is an orbax checkpoint dir — keeping orbax format")
         ckpt_format = "orbax"
     tracer = WindowTracer(cfg.trace_dir if is_lead else None, count=cfg.trace_steps)
     metrics = MetricsLogger(cfg.metrics_path if is_lead else None)
