@@ -13,14 +13,29 @@
 //   * labels <= 0 map to 0.0, otherwise 1.0
 //   * hashing: 64-bit FNV-1a over the raw feature token bytes, mod vocab
 //   * padding: ids/vals/fields zero-filled beyond each row's nnz
+//   * floats: decimal -> double -> float32, matching Python float() + the
+//     np.float32 cast (NOT strtof, whose single-rounding direct-to-float
+//     result can differ in the last ulp)
+//
+// The number parsers are hand-rolled because strtod/strtoll dominate the
+// profile on CTR-style data (~40 numeric tokens per line): the fast path
+// (<= 15 mantissa digits, |decimal exponent| <= 22) computes
+// mantissa * 10^e in one correctly-rounded double operation — provably
+// identical to strtod there — and anything else falls back to strtod.
 //
 // Build: csrc/Makefile -> fast_tffm_tpu/data/_libsvm_parser.so
 
+#include <atomic>
 #include <cctype>
 #include <cerrno>
 #include <cstdint>
+#include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <string>
+#include <thread>
+#include <utility>
+#include <vector>
 
 namespace {
 
@@ -46,6 +61,281 @@ enum ErrorCode {
   kIdOutOfRange = 4,
   kRowTooWide = 5,
 };
+
+// Powers of ten exactly representable in double (10^0 .. 10^22).
+const double kPow10[] = {1e0,  1e1,  1e2,  1e3,  1e4,  1e5,  1e6,  1e7,
+                         1e8,  1e9,  1e10, 1e11, 1e12, 1e13, 1e14, 1e15,
+                         1e16, 1e17, 1e18, 1e19, 1e20, 1e21, 1e22};
+
+// strtod on an unterminated [p, end) span (NUL-terminated copy; heap only
+// for pathological token lengths).  Handles everything the fast path
+// declines: huge exponents, inf/nan, 16+ digit mantissas.
+inline bool slow_double(const char* p, const char* end, double* out) {
+  char stackbuf[64];
+  size_t len = static_cast<size_t>(end - p);
+  if (len == 0) return false;
+  std::string heapbuf;
+  char* tmp;
+  if (len < sizeof(stackbuf)) {
+    tmp = stackbuf;
+  } else {
+    heapbuf.resize(len + 1);
+    tmp = heapbuf.data();
+  }
+  memcpy(tmp, p, len);
+  tmp[len] = '\0';
+  char* after = nullptr;
+  errno = 0;
+  double v = strtod(tmp, &after);
+  // Python float() accepts "1e999" as inf; strtod sets ERANGE but returns
+  // HUGE_VAL, which is the same inf — only reject on no-parse.
+  if (after != tmp + len) return false;
+  *out = v;
+  return true;
+}
+
+// Parse a full-token decimal number, bit-identical to Python float(tok).
+inline bool parse_double(const char* p, const char* end, double* out) {
+  const char* q = p;
+  bool neg = false;
+  if (q < end && (*q == '+' || *q == '-')) {
+    neg = (*q == '-');
+    ++q;
+  }
+  uint64_t mant = 0;
+  int digits = 0;   // significant digits accumulated into mant
+  int exp10 = 0;    // decimal exponent to apply to mant
+  bool any = false;
+  while (q < end && *q >= '0' && *q <= '9') {
+    any = true;
+    if (digits < 15) {
+      mant = mant * 10 + static_cast<uint64_t>(*q - '0');
+      if (mant) ++digits;  // leading zeros are free
+    } else {
+      return slow_double(p, end, out);  // 16+ digits: exactness not provable
+    }
+    ++q;
+  }
+  if (q < end && *q == '.') {
+    ++q;
+    while (q < end && *q >= '0' && *q <= '9') {
+      any = true;
+      if (digits < 15) {
+        mant = mant * 10 + static_cast<uint64_t>(*q - '0');
+        if (mant) ++digits;
+        --exp10;
+      } else {
+        return slow_double(p, end, out);
+      }
+      ++q;
+    }
+  }
+  if (!any) return slow_double(p, end, out);  // "inf", "nan", or junk
+  if (q < end && (*q == 'e' || *q == 'E')) {
+    ++q;
+    bool eneg = false;
+    if (q < end && (*q == '+' || *q == '-')) {
+      eneg = (*q == '-');
+      ++q;
+    }
+    int e = 0;
+    bool eany = false;
+    while (q < end && *q >= '0' && *q <= '9') {
+      eany = true;
+      if (e < 100000) e = e * 10 + (*q - '0');
+      ++q;
+    }
+    if (!eany) return false;
+    exp10 += eneg ? -e : e;
+  }
+  if (q != end) return false;  // trailing junk: Python float() would raise
+  double d;
+  if (exp10 >= 0) {
+    if (exp10 > 22) return slow_double(p, end, out);
+    d = static_cast<double>(mant) * kPow10[exp10];  // one rounding: exact
+  } else {
+    if (exp10 < -22) return slow_double(p, end, out);
+    d = static_cast<double>(mant) / kPow10[-exp10];  // one rounding: exact
+  }
+  *out = neg ? -d : d;
+  return true;
+}
+
+// Parse a full-token decimal integer (optional sign, digits only — the
+// subset Python int(tok) accepts that feature-id tokens use).
+inline bool parse_int(const char* p, const char* end, int64_t* out) {
+  const char* q = p;
+  bool neg = false;
+  if (q < end && (*q == '+' || *q == '-')) {
+    neg = (*q == '-');
+    ++q;
+  }
+  if (q >= end) return false;
+  uint64_t v = 0;
+  while (q < end) {
+    if (*q < '0' || *q > '9') return false;
+    if (v > (UINT64_MAX - 9) / 10) return false;  // uint64 overflow
+    v = v * 10 + static_cast<uint64_t>(*q - '0');
+    ++q;
+  }
+  // Values beyond int64 range are rejected, never wrapped (Python's big
+  // ints fail the range check / numpy cast downstream; both paths error).
+  if (v > static_cast<uint64_t>(INT64_MAX)) return false;
+  *out = neg ? -static_cast<int64_t>(v) : static_cast<int64_t>(v);
+  return true;
+}
+
+struct LineSpan {
+  const char* begin;
+  const char* end;
+};
+
+// Collect non-blank line spans (at most n when n >= 0).
+inline void collect_lines(const char* buf, int64_t n,
+                          std::vector<LineSpan>* out) {
+  const char* p = buf;
+  while (*p && (n < 0 || static_cast<int64_t>(out->size()) < n)) {
+    const char* eol = static_cast<const char*>(strchr(p, '\n'));
+    const char* end = eol ? eol : p + strlen(p);
+    const char* q = p;
+    while (q < end && is_space(*q)) ++q;
+    if (q < end) out->push_back({p, end});
+    p = eol ? eol + 1 : end;
+  }
+}
+
+// Parse one line into row `li` of the output buffers.  Returns an ErrorCode.
+inline int32_t parse_line(const char* p, const char* end, int64_t li,
+                          int64_t width, int64_t vocabulary_size,
+                          int32_t hash_feature_id, float* labels, int64_t* ids,
+                          float* vals, int32_t* fields, int32_t* nnz) {
+  const char* q = p;
+  while (q < end && is_space(*q)) ++q;
+  if (q >= end) return kEmptyLine;
+  // Label token.
+  const char* tok = q;
+  while (q < end && !is_space(*q)) ++q;
+  double y;
+  if (!parse_double(tok, q, &y)) return kBadLabel;
+  labels[li] = y <= 0.0 ? 0.0f : 1.0f;
+  // Feature tokens.
+  int64_t m = 0;
+  int64_t* row_ids = ids + li * width;
+  float* row_vals = vals + li * width;
+  int32_t* row_fields = fields + li * width;
+  while (q < end) {
+    while (q < end && is_space(*q)) ++q;
+    if (q >= end) break;
+    tok = q;
+    while (q < end && !is_space(*q)) ++q;
+    const char* tok_end = q;
+    // Split on ':' — one colon (feat:val) or two (field:feat:val).
+    const char* c1 =
+        static_cast<const char*>(memchr(tok, ':', tok_end - tok));
+    if (!c1 || c1 == tok || c1 + 1 >= tok_end) return kBadToken;
+    const char* c2 =
+        static_cast<const char*>(memchr(c1 + 1, ':', tok_end - (c1 + 1)));
+    const char* feat_begin;
+    const char* feat_end;
+    int64_t field = 0;
+    const char* val_begin;
+    if (c2) {
+      if (c2 + 1 >= tok_end) return kBadToken;
+      if (!parse_int(tok, c1, &field)) return kBadToken;
+      feat_begin = c1 + 1;
+      feat_end = c2;
+      val_begin = c2 + 1;
+    } else {
+      feat_begin = tok;
+      feat_end = c1;
+      val_begin = c1 + 1;
+    }
+    int64_t fid;
+    if (hash_feature_id) {
+      fid = static_cast<int64_t>(fnv1a64(feat_begin, feat_end - feat_begin) %
+                                 static_cast<uint64_t>(vocabulary_size));
+    } else {
+      if (!parse_int(feat_begin, feat_end, &fid)) return kBadToken;
+      if (fid < 0 || fid >= vocabulary_size) return kIdOutOfRange;
+    }
+    double v;
+    if (!parse_double(val_begin, tok_end, &v)) return kBadToken;
+    if (m >= width) return kRowTooWide;
+    row_ids[m] = fid;
+    row_vals[m] = static_cast<float>(v);
+    row_fields[m] = static_cast<int32_t>(field);
+    ++m;
+  }
+  nnz[li] = static_cast<int32_t>(m);
+  return kOk;
+}
+
+int32_t parse_span_range(const std::vector<LineSpan>& spans, int64_t lo,
+                         int64_t hi, int64_t width, int64_t vocabulary_size,
+                         int32_t hash_feature_id, float* labels, int64_t* ids,
+                         float* vals, int32_t* fields, int32_t* nnz,
+                         int64_t* error_line) {
+  for (int64_t li = lo; li < hi; ++li) {
+    int32_t code =
+        parse_line(spans[li].begin, spans[li].end, li, width, vocabulary_size,
+                   hash_feature_id, labels, ids, vals, fields, nnz);
+    if (code != kOk) {
+      *error_line = li;
+      return code;
+    }
+  }
+  return kOk;
+}
+
+// Parse every span, spreading rows over a std::thread pool when it pays.
+// Threads write disjoint row ranges; the FIRST error by line index wins,
+// matching single-threaded reporting order.
+int32_t parse_spans_mt(const std::vector<LineSpan>& spans, int64_t width,
+                       int64_t vocabulary_size, int32_t hash_feature_id,
+                       int32_t threads, float* labels, int64_t* ids,
+                       float* vals, int32_t* fields, int32_t* nnz,
+                       int64_t* error_line) {
+  const int64_t rows = static_cast<int64_t>(spans.size());
+  if (threads <= 1 || rows < 2 * threads) {
+    return parse_span_range(spans, 0, rows, width, vocabulary_size,
+                            hash_feature_id, labels, ids, vals, fields, nnz,
+                            error_line);
+  }
+  std::atomic<int64_t> first_bad(INT64_MAX);
+  std::vector<int32_t> codes(static_cast<size_t>(threads), kOk);
+  std::vector<int64_t> errs(static_cast<size_t>(threads), -1);
+  std::vector<std::thread> pool;
+  pool.reserve(static_cast<size_t>(threads));
+  const int64_t chunk = (rows + threads - 1) / threads;
+  for (int32_t t = 0; t < threads; ++t) {
+    const int64_t lo = t * chunk;
+    const int64_t hi = lo + chunk < rows ? lo + chunk : rows;
+    if (lo >= hi) break;
+    pool.emplace_back([&, t, lo, hi]() {
+      int64_t err = -1;
+      int32_t code = parse_span_range(spans, lo, hi, width, vocabulary_size,
+                                      hash_feature_id, labels, ids, vals,
+                                      fields, nnz, &err);
+      if (code != kOk) {
+        codes[static_cast<size_t>(t)] = code;
+        errs[static_cast<size_t>(t)] = err;
+        int64_t cur = first_bad.load();
+        while (err < cur && !first_bad.compare_exchange_weak(cur, err)) {
+        }
+      }
+    });
+  }
+  for (auto& th : pool) th.join();
+  const int64_t bad = first_bad.load();
+  if (bad == INT64_MAX) return kOk;
+  for (size_t t = 0; t < errs.size(); ++t) {
+    if (errs[t] == bad) {
+      *error_line = bad;
+      return codes[t];
+    }
+  }
+  return kOk;  // unreachable
+}
 
 }  // namespace
 
@@ -81,103 +371,205 @@ void fm_parse_shape(const char* buf, int64_t* n_lines, int64_t* widest) {
   *widest = wide;
 }
 
-// Parse into caller-allocated buffers.  Returns an ErrorCode; on error,
-// *error_line holds the (0-based, blank-skipped) offending line index.
+// Parse into caller-allocated buffers, optionally with a worker-thread pool
+// (the in-kernel analog of the reference trainer's cfg-driven parse-thread
+// count).  Returns an ErrorCode; on error, *error_line holds the (0-based,
+// blank-skipped) first offending line index.
 //
 //   labels: float32[n]      ids: int64[n*width]   vals: float32[n*width]
 //   fields: int32[n*width]  nnz: int32[n]
-int32_t fm_parse(const char* buf, int64_t n, int64_t width,
-                 int64_t vocabulary_size, int32_t hash_feature_id,
-                 float* labels, int64_t* ids, float* vals, int32_t* fields,
-                 int32_t* nnz, int64_t* error_line) {
+int32_t fm_parse_mt(const char* buf, int64_t n, int64_t width,
+                    int64_t vocabulary_size, int32_t hash_feature_id,
+                    int32_t threads, float* labels, int64_t* ids, float* vals,
+                    int32_t* fields, int32_t* nnz, int64_t* error_line) {
   memset(ids, 0, sizeof(int64_t) * n * width);
   memset(vals, 0, sizeof(float) * n * width);
   memset(fields, 0, sizeof(int32_t) * n * width);
   memset(nnz, 0, sizeof(int32_t) * n);
 
-  const char* p = buf;
-  int64_t li = 0;
-  while (*p && li < n) {
-    const char* eol = strchr(p, '\n');
-    const char* end = eol ? eol : p + strlen(p);
-    const char* q = p;
-    while (q < end && is_space(*q)) ++q;
-    if (q >= end) {  // blank line: skip without consuming a row
-      p = eol ? eol + 1 : end;
-      continue;
-    }
-    // Label token.
-    char* after = nullptr;
-    errno = 0;
-    float y = strtof(q, &after);
-    if (after == q || errno != 0 || (after < end && !is_space(*after)) ) {
-      *error_line = li;
-      return kBadLabel;
-    }
-    labels[li] = y <= 0.0f ? 0.0f : 1.0f;
-    q = after;
-    // Feature tokens.
-    int64_t m = 0;
-    while (q < end) {
-      while (q < end && is_space(*q)) ++q;
-      if (q >= end) break;
-      const char* tok = q;
-      while (q < end && !is_space(*q)) ++q;
-      const char* tok_end = q;
-      // Split on ':' — one colon (feat:val) or two (field:feat:val).
-      const char* c1 = static_cast<const char*>(
-          memchr(tok, ':', tok_end - tok));
-      if (!c1 || c1 == tok || c1 + 1 >= tok_end) {
-        *error_line = li;
-        return kBadToken;
+  std::vector<LineSpan> spans;
+  spans.reserve(static_cast<size_t>(n));
+  collect_lines(buf, n, &spans);
+  return parse_spans_mt(spans, width, vocabulary_size, hash_feature_id,
+                        threads, labels, ids, vals, fields, nnz, error_line);
+}
+
+// Single-threaded entry kept for ABI compatibility with older bindings.
+int32_t fm_parse(const char* buf, int64_t n, int64_t width,
+                 int64_t vocabulary_size, int32_t hash_feature_id,
+                 float* labels, int64_t* ids, float* vals, int32_t* fields,
+                 int32_t* nnz, int64_t* error_line) {
+  return fm_parse_mt(buf, n, width, vocabulary_size, hash_feature_id, 1,
+                     labels, ids, vals, fields, nnz, error_line);
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Streaming batch reader: the native data-loader.
+//
+// The reference fed its FmParser op from TF queue-runner threads doing the
+// file reading and batching in Python/TF; here the WHOLE host input path —
+// chunked file reads, line splitting, round-robin worker sharding, parsing
+// into the padded batch — lives in C++ behind three C ABI calls, so the
+// Python driver never touches individual lines (its per-line loop costs as
+// much as the parse itself).  data/pipeline.py routes through this when the
+// .so is present and falls back to the pure-Python generator otherwise.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct FmReader {
+  FILE* f = nullptr;
+  std::vector<char> buf;     // read window
+  size_t pos = 0, len = 0;   // unconsumed span within buf
+  std::string tail;          // partial line carried across refills
+  bool tail_valid = false;   // tail holds a complete final unterminated line
+  bool eof = false;
+  int64_t shard_index = 0, shard_count = 1;
+  int64_t counter = 0;       // global non-blank line index (spans files)
+  // Per-call arena for the selected lines (stable while parsing).
+  std::string arena;
+  std::vector<std::pair<size_t, size_t>> offsets;  // (offset, len) into arena
+};
+
+// Pull the next raw line span out of the buffered file.  Returns false at
+// EOF.  The returned span is valid until the next call (it may point into
+// r->tail or r->buf).
+bool next_line(FmReader* r, const char** begin, const char** end) {
+  for (;;) {
+    if (r->pos < r->len) {
+      const char* base = r->buf.data();
+      const char* nl = static_cast<const char*>(
+          memchr(base + r->pos, '\n', r->len - r->pos));
+      if (nl) {
+        size_t line_end = static_cast<size_t>(nl - base);
+        if (!r->tail.empty()) {
+          r->tail.append(base + r->pos, line_end - r->pos);
+          *begin = r->tail.data();
+          *end = r->tail.data() + r->tail.size();
+          r->pos = line_end + 1;
+          r->tail_valid = true;  // consumer must clear via consume_tail
+          return true;
+        }
+        *begin = base + r->pos;
+        *end = nl;
+        r->pos = line_end + 1;
+        return true;
       }
-      const char* c2 = static_cast<const char*>(
-          memchr(c1 + 1, ':', tok_end - (c1 + 1)));
-      const char* feat_begin;
-      const char* feat_end;
-      int64_t field = 0;
-      const char* val_begin;
-      if (c2) {
-        if (c2 + 1 >= tok_end) { *error_line = li; return kBadToken; }
-        char* fend = nullptr;
-        errno = 0;
-        field = strtoll(tok, &fend, 10);
-        if (fend != c1 || errno != 0) { *error_line = li; return kBadToken; }
-        feat_begin = c1 + 1;
-        feat_end = c2;
-        val_begin = c2 + 1;
-      } else {
-        feat_begin = tok;
-        feat_end = c1;
-        val_begin = c1 + 1;
-      }
-      int64_t fid;
-      if (hash_feature_id) {
-        fid = static_cast<int64_t>(
-            fnv1a64(feat_begin, feat_end - feat_begin) %
-            static_cast<uint64_t>(vocabulary_size));
-      } else {
-        char* iend = nullptr;
-        errno = 0;
-        fid = strtoll(feat_begin, &iend, 10);
-        if (iend != feat_end || errno != 0) { *error_line = li; return kBadToken; }
-        if (fid < 0 || fid >= vocabulary_size) { *error_line = li; return kIdOutOfRange; }
-      }
-      char* vend = nullptr;
-      errno = 0;
-      float v = strtof(val_begin, &vend);
-      if (vend != tok_end || errno != 0) { *error_line = li; return kBadToken; }
-      if (m >= width) { *error_line = li; return kRowTooWide; }
-      ids[li * width + m] = fid;
-      vals[li * width + m] = v;
-      fields[li * width + m] = static_cast<int32_t>(field);
-      ++m;
+      // No newline in the window: stash the fragment and refill.
+      r->tail.append(base + r->pos, r->len - r->pos);
+      r->pos = r->len;
     }
-    nnz[li] = static_cast<int32_t>(m);
-    ++li;
-    p = eol ? eol + 1 : end;
+    if (r->eof) {
+      if (!r->tail.empty()) {
+        *begin = r->tail.data();
+        *end = r->tail.data() + r->tail.size();
+        r->tail_valid = true;
+        r->eof = true;
+        // Mark consumed so the next call returns false.
+        r->pos = r->len = 0;
+        return true;
+      }
+      return false;
+    }
+    size_t got = fread(r->buf.data(), 1, r->buf.size(), r->f);
+    r->pos = 0;
+    r->len = got;
+    if (got == 0) r->eof = true;
   }
-  return kOk;
+}
+
+inline bool is_blank(const char* b, const char* e) {
+  while (b < e && is_space(*b)) ++b;
+  return b >= e;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Open a libsvm file for streamed batch reading.  shard_index/shard_count
+// implement round-robin line sharding by GLOBAL non-blank line index;
+// counter_start carries that index across files (data/pipeline.py threads
+// it through a multi-file, multi-epoch schedule).  Returns NULL on failure.
+void* fm_reader_open(const char* path, int64_t shard_index,
+                     int64_t shard_count, int64_t counter_start) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  FmReader* r = new FmReader();
+  r->f = f;
+  r->buf.resize(1 << 22);  // 4 MiB read window
+  r->shard_index = shard_index;
+  r->shard_count = shard_count < 1 ? 1 : shard_count;
+  r->counter = counter_start;
+  return r;
+}
+
+// Global non-blank line counter after the lines consumed so far.
+int64_t fm_reader_counter(void* reader) {
+  return static_cast<FmReader*>(reader)->counter;
+}
+
+void fm_reader_close(void* reader) {
+  FmReader* r = static_cast<FmReader*>(reader);
+  if (r->f) fclose(r->f);
+  delete r;
+}
+
+// Fill up to `want` rows of the caller's batch buffers (each sized for at
+// least `want` rows).  Returns the number of rows produced; fewer than
+// `want` means the file is exhausted.  On a parse error returns -1 and sets
+// *error_code (ErrorCode) and *error_line (this-shard row index within the
+// current call).
+int64_t fm_reader_next(void* reader, int64_t want, int64_t width,
+                       int64_t vocabulary_size, int32_t hash_feature_id,
+                       int32_t threads, float* labels, int64_t* ids,
+                       float* vals, int32_t* fields, int32_t* nnz,
+                       int32_t* error_code, int64_t* error_line) {
+  FmReader* r = static_cast<FmReader*>(reader);
+  r->arena.clear();
+  r->offsets.clear();
+
+  const char *b, *e;
+  while (static_cast<int64_t>(r->offsets.size()) < want && next_line(r, &b, &e)) {
+    bool selected = false;
+    if (!is_blank(b, e)) {
+      selected = (r->counter % r->shard_count) == r->shard_index;
+      ++r->counter;
+    }
+    if (selected) {
+      r->offsets.emplace_back(r->arena.size(), static_cast<size_t>(e - b));
+      r->arena.append(b, static_cast<size_t>(e - b));
+    }
+    if (r->tail_valid) {
+      r->tail.clear();
+      r->tail_valid = false;
+    }
+  }
+
+  const int64_t rows = static_cast<int64_t>(r->offsets.size());
+  if (rows == 0) return 0;
+  memset(ids, 0, sizeof(int64_t) * rows * width);
+  memset(vals, 0, sizeof(float) * rows * width);
+  memset(fields, 0, sizeof(int32_t) * rows * width);
+  memset(nnz, 0, sizeof(int32_t) * rows);
+
+  std::vector<LineSpan> spans;
+  spans.reserve(static_cast<size_t>(rows));
+  for (const auto& [off, len] : r->offsets) {
+    spans.push_back({r->arena.data() + off, r->arena.data() + off + len});
+  }
+
+  int64_t err = -1;
+  int32_t code = parse_spans_mt(spans, width, vocabulary_size, hash_feature_id,
+                                threads, labels, ids, vals, fields, nnz, &err);
+  if (code != kOk) {
+    *error_code = code;
+    *error_line = err;
+    return -1;
+  }
+  return rows;
 }
 
 }  // extern "C"

@@ -80,13 +80,14 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_int64),
         ctypes.POINTER(ctypes.c_int64),
     ]
-    lib.fm_parse.restype = ctypes.c_int32
-    lib.fm_parse.argtypes = [
+    lib.fm_parse_mt.restype = ctypes.c_int32
+    lib.fm_parse_mt.argtypes = [
         ctypes.c_char_p,
         ctypes.c_int64,  # n
         ctypes.c_int64,  # width
         ctypes.c_int64,  # vocabulary_size
         ctypes.c_int32,  # hash_feature_id
+        ctypes.c_int32,  # threads
         np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),  # labels
         np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),  # ids
         np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),  # vals
@@ -94,14 +95,47 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),  # nnz
         ctypes.POINTER(ctypes.c_int64),  # error_line
     ]
+    lib.fm_reader_open.restype = ctypes.c_void_p
+    lib.fm_reader_open.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int64,  # shard_index
+        ctypes.c_int64,  # shard_count
+        ctypes.c_int64,  # counter_start
+    ]
+    lib.fm_reader_counter.restype = ctypes.c_int64
+    lib.fm_reader_counter.argtypes = [ctypes.c_void_p]
+    lib.fm_reader_close.restype = None
+    lib.fm_reader_close.argtypes = [ctypes.c_void_p]
+    lib.fm_reader_next.restype = ctypes.c_int64
+    lib.fm_reader_next.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int64,  # want
+        ctypes.c_int64,  # width
+        ctypes.c_int64,  # vocabulary_size
+        ctypes.c_int32,  # hash_feature_id
+        ctypes.c_int32,  # threads
+        np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),  # labels
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),  # ids
+        np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),  # vals
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),  # fields
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),  # nnz
+        ctypes.POINTER(ctypes.c_int32),  # error_code
+        ctypes.POINTER(ctypes.c_int64),  # error_line
+    ]
     return lib
 
 
 class NativeParser:
-    """Callable with the signature of ``libsvm.parse_lines``."""
+    """Callable with the signature of ``libsvm.parse_lines``.
 
-    def __init__(self, lib: ctypes.CDLL):
+    ``threads`` spreads the parse over an in-kernel std::thread pool — the
+    analog of the reference trainer's cfg-driven parse-thread count, but
+    inside one GIL-released ctypes call instead of TF queue-runner threads.
+    """
+
+    def __init__(self, lib: ctypes.CDLL, threads: int = 1):
         self._lib = lib
+        self.threads = max(1, int(threads))
 
     def fnv1a64(self, token: bytes) -> int:
         return int(self._lib.fm_fnv1a64(token, len(token)))
@@ -115,23 +149,27 @@ class NativeParser:
         max_nnz: int | None = None,
     ) -> ParsedBatch:
         buf = ("\n".join(lines)).encode("utf-8")
-        n_lines = ctypes.c_int64()
-        widest = ctypes.c_int64()
-        self._lib.fm_parse_shape(buf, ctypes.byref(n_lines), ctypes.byref(widest))
         n = len(lines)
-        width = max_nnz if max_nnz is not None else max(int(widest.value), 1)
+        if max_nnz is not None:
+            width = max_nnz
+        else:
+            n_lines = ctypes.c_int64()
+            widest = ctypes.c_int64()
+            self._lib.fm_parse_shape(buf, ctypes.byref(n_lines), ctypes.byref(widest))
+            width = max(int(widest.value), 1)
         labels = np.zeros((n,), np.float32)
         ids = np.zeros((n, width), np.int64)
         vals = np.zeros((n, width), np.float32)
         fields = np.zeros((n, width), np.int32)
         nnz = np.zeros((n,), np.int32)
         err_line = ctypes.c_int64(-1)
-        code = self._lib.fm_parse(
+        code = self._lib.fm_parse_mt(
             buf,
             n,
             width,
             vocabulary_size,
             1 if hash_feature_id_flag else 0,
+            self.threads,
             labels,
             ids,
             vals,
@@ -146,21 +184,130 @@ class NativeParser:
         return ParsedBatch(labels=labels, ids=ids, vals=vals, fields=fields, nnz=nnz)
 
 
-def load_native_parser() -> NativeParser | None:
-    """Load the C++ parser, building it on first use; None → Python fallback."""
+def native_batch_stream(
+    parser: "NativeParser",
+    files,
+    *,
+    batch_size: int,
+    vocabulary_size: int,
+    hash_feature_id: bool = False,
+    max_nnz: int,
+    epochs: int = 1,
+    shard_index: int = 0,
+    shard_count: int = 1,
+    weights=None,
+    drop_remainder: bool = False,
+):
+    """Stream (ParsedBatch, example_weights) batches entirely through C++.
+
+    Same contract as ``pipeline.batch_stream`` (epoch repeats, per-file
+    example weights, round-robin line sharding by global non-blank line
+    index, zero-padded short final batch with weight-0 rows), but the file
+    reading, line splitting, sharding, and parsing all happen inside
+    ``fm_reader_next`` — the Python side only schedules files and yields
+    filled NumPy buffers.  Batches freely span file and epoch boundaries,
+    exactly like the Python generator chain.
+    """
+    if weights is not None and len(weights) != len(files):
+        raise ValueError(f"weights has {len(weights)} entries for {len(files)} files")
+    lib = parser._lib
+    width = int(max_nnz)
+
+    def alloc():
+        return (
+            np.zeros((batch_size,), np.float32),
+            np.zeros((batch_size, width), np.int64),
+            np.zeros((batch_size, width), np.float32),
+            np.zeros((batch_size, width), np.int32),
+            np.zeros((batch_size,), np.int32),
+            np.zeros((batch_size,), np.float32),
+        )
+
+    labels, ids, vals, fields, nnz, w = alloc()
+    filled = 0
+    counter = 0  # global non-blank line index, threaded through every file
+    for _ in range(max(0, epochs)):
+        for fi, path in enumerate(files):
+            fw = 1.0 if weights is None else float(weights[fi])
+            handle = lib.fm_reader_open(
+                os.fspath(path).encode(), shard_index, shard_count, counter
+            )
+            if not handle:
+                raise FileNotFoundError(path)
+            try:
+                while True:
+                    want = batch_size - filled
+                    ec = ctypes.c_int32(0)
+                    el = ctypes.c_int64(-1)
+                    got = lib.fm_reader_next(
+                        handle,
+                        want,
+                        width,
+                        vocabulary_size,
+                        1 if hash_feature_id else 0,
+                        parser.threads,
+                        labels[filled:],
+                        ids[filled:],
+                        vals[filled:],
+                        fields[filled:],
+                        nnz[filled:],
+                        ctypes.byref(ec),
+                        ctypes.byref(el),
+                    )
+                    if got < 0:
+                        raise ValueError(
+                            f"{_ERRORS.get(ec.value, f'error {ec.value}')} in {path} "
+                            f"(shard row {el.value} of this batch)"
+                        )
+                    w[filled : filled + got] = fw
+                    filled += int(got)
+                    if filled == batch_size:
+                        yield ParsedBatch(labels, ids, vals, fields, nnz), w
+                        labels, ids, vals, fields, nnz, w = alloc()
+                        filled = 0
+                        continue
+                    break  # got < want: file exhausted
+            finally:
+                counter = int(lib.fm_reader_counter(handle))
+                lib.fm_reader_close(handle)
+    if filled and not drop_remainder:
+        # Rows beyond `filled` are already zero (fresh buffers) and carry
+        # weight 0 — identical to pipeline.pad_batch on the Python path.
+        yield ParsedBatch(labels, ids, vals, fields, nnz), w
+
+
+def _stale() -> bool:
+    """True when the .so is missing or older than any csrc/ source file."""
     if not os.path.exists(_SO_PATH):
+        return True
+    so_mtime = os.path.getmtime(_SO_PATH)
+    try:
+        entries = os.listdir(_CSRC_DIR)
+    except OSError:
+        return False
+    return any(
+        e.endswith((".cpp", ".h")) and os.path.getmtime(os.path.join(_CSRC_DIR, e)) > so_mtime
+        for e in entries
+    )
+
+
+def load_native_parser(threads: int = 1) -> NativeParser | None:
+    """Load the C++ parser, (re)building it on first use; None → Python fallback."""
+    if _stale():
         _try_build()
     if not os.path.exists(_SO_PATH):
         return None
     try:
-        return NativeParser(_bind(ctypes.CDLL(_SO_PATH)))
-    except OSError:
+        lib = _bind(ctypes.CDLL(_SO_PATH))
+    except (OSError, AttributeError):
+        # AttributeError: a stale pre-fm_parse_mt .so — rebuild next process.
         return None
+    return NativeParser(lib, threads)
 
 
-def best_parser():
+def best_parser(threads: int = 1):
     """The fastest available parser honoring the parse_lines contract."""
-    native = load_native_parser()
+    native = load_native_parser(threads)
     if native is not None:
         return native
     from fast_tffm_tpu.data.libsvm import parse_lines

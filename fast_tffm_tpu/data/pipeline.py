@@ -84,6 +84,25 @@ def batch_stream(
     ``libsvm.parse_lines``); data/native.py passes the C++ implementation.
     """
     from fast_tffm_tpu.data.libsvm import parse_lines
+    from fast_tffm_tpu.data.native import NativeParser, native_batch_stream
+
+    if isinstance(parser, NativeParser) and max_nnz is not None:
+        # Full-native path: file reads, sharding, and parsing all in C++
+        # (the Python per-line loop below costs as much as the parse).
+        yield from native_batch_stream(
+            parser,
+            files,
+            batch_size=batch_size,
+            vocabulary_size=vocabulary_size,
+            hash_feature_id=hash_feature_id,
+            max_nnz=max_nnz,
+            epochs=epochs,
+            shard_index=shard_index,
+            shard_count=shard_count,
+            weights=weights,
+            drop_remainder=drop_remainder,
+        )
+        return
 
     parse = parser if parser is not None else parse_lines
     stream = line_stream(

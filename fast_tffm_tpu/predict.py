@@ -41,7 +41,7 @@ def _run_predict(cfg: Config, state, predict_step, max_nnz, log=print) -> str:
             vocabulary_size=cfg.vocabulary_size,
             hash_feature_id=cfg.hash_feature_id,
             max_nnz=max_nnz,
-            parser=best_parser(),
+            parser=best_parser(cfg.thread_num),
         )
         for parsed, w in prefetch(stream, depth=cfg.queue_size):
             b = Batch.from_parsed(parsed, w)

@@ -58,7 +58,7 @@ def _stream(cfg: Config, files, max_nnz, epochs):
             max_nnz=max_nnz,
             epochs=epochs,
             weights=cfg.weight_files if cfg.weight_files else None,
-            parser=best_parser(),
+            parser=best_parser(cfg.thread_num),
         ),
         depth=cfg.queue_size,
     )

@@ -126,3 +126,146 @@ class TestNativeParity:
         a = parse_lines(lines, vocabulary_size=1 << 20, hash_feature_id_flag=True)
         b = native(lines, vocabulary_size=1 << 20, hash_feature_id_flag=True)
         np.testing.assert_array_equal(a.ids, b.ids)
+
+    def test_number_parsing_edge_cases_match_python(self):
+        # Exercise the hand-rolled fast path AND its strtod fallbacks
+        # (16+ digit mantissas, |exp|>22, inf) against Python float().
+        vals = [
+            "0.5", "123.456", "1e-7", "2.5E+3", "1.", "0.000123",
+            "9007199254740993.0", "1.2345678901234567", "6.02e23", "1e-30",
+            "3.4028236e38", "inf", "-0.0",
+        ]
+        lines = [f"1 {i}:{v}" for i, v in enumerate(vals)]
+        a = parse_lines(lines, vocabulary_size=100)
+        b = native(lines, vocabulary_size=100)
+        np.testing.assert_array_equal(
+            a.vals.view(np.uint32), b.vals.view(np.uint32)
+        )  # bit-identical, not just close
+
+    def test_long_token_slow_path_matches_python(self):
+        # 70-char value token forces the strtod fallback past the stack
+        # buffer; must parse like Python, not error.
+        tok = "0." + "0" * 67 + "1"
+        a = parse_lines([f"1 0:{tok}"], vocabulary_size=10)
+        b = native([f"1 0:{tok}"], vocabulary_size=10)
+        np.testing.assert_array_equal(a.vals.view(np.uint32), b.vals.view(np.uint32))
+
+    def test_int64_overflow_field_rejected(self):
+        # Field ids beyond int64 must error, never silently wrap.
+        with pytest.raises(ValueError, match="bad token"):
+            native(["1 9999999999999999999:3:1.0"], vocabulary_size=10)
+
+    def test_native_parse_mt_matches_single_thread(self):
+        from fast_tffm_tpu.data.native import NativeParser
+
+        lines = [f"{i % 2} {i % 97}:{i * 0.125} {(i * 7) % 97}:{i}.5" for i in range(257)]
+        a = native(lines, vocabulary_size=97, max_nnz=4)
+        mt = NativeParser(native._lib, threads=4)
+        b = mt(lines, vocabulary_size=97, max_nnz=4)
+        for f in ("labels", "ids", "vals", "fields", "nnz"):
+            np.testing.assert_array_equal(getattr(a, f), getattr(b, f))
+
+    def test_native_parse_mt_reports_first_error(self):
+        from fast_tffm_tpu.data.native import NativeParser
+
+        lines = [f"1 {i}:1.0" for i in range(100)]
+        lines[83] = "1 bad_token"
+        lines[17] = "1 also:bad:tokens:here"
+        mt = NativeParser(native._lib, threads=4)
+        with pytest.raises(ValueError, match="at line 17"):
+            mt(lines, vocabulary_size=1000, max_nnz=2)
+
+
+@pytest.mark.skipif(native is None, reason="C++ parser not built (make -C csrc)")
+class TestNativeStream:
+    """The C++ streaming reader must be indistinguishable from the Python
+    generator chain (pipeline.line_stream -> parse -> pad)."""
+
+    @staticmethod
+    def _write_files(tmp_path, rng):
+        paths = []
+        for name, n in [("a.libsvm", 533), ("b.libsvm", 291)]:
+            p = tmp_path / name
+            with open(p, "w") as f:
+                for i in range(n):
+                    m = int(rng.integers(1, 8))
+                    feats = " ".join(
+                        f"{rng.integers(0, 1000)}:{rng.random():.5f}" for _ in range(m)
+                    )
+                    f.write(f"{rng.integers(0, 2)} {feats}\n")
+                    if i % 50 == 0:
+                        f.write("\n")  # blank lines must be skipped identically
+            paths.append(str(p))
+        return paths
+
+    @pytest.mark.parametrize(
+        "kw",
+        [
+            {},
+            {"epochs": 2},
+            {"weights": [2.0, 0.5]},
+            {"shard_index": 1, "shard_count": 3},
+            {"drop_remainder": True},
+            {
+                "hash_feature_id": True,
+                "epochs": 2,
+                "shard_index": 0,
+                "shard_count": 2,
+                "weights": [1.5, 3.0],
+            },
+        ],
+    )
+    def test_matches_python_stream(self, tmp_path, kw):
+        from fast_tffm_tpu.data.pipeline import batch_stream
+
+        files = self._write_files(tmp_path, np.random.default_rng(3))
+
+        def collect(parser):
+            return [
+                (b, w.copy())
+                for b, w in batch_stream(
+                    files,
+                    batch_size=64,
+                    vocabulary_size=1000,
+                    max_nnz=8,
+                    parser=parser,
+                    **kw,
+                )
+            ]
+
+        py, nat = collect(None), collect(native)
+        assert len(py) == len(nat)
+        for (pb, pw), (nb, nw) in zip(py, nat):
+            for f in ("labels", "ids", "vals", "fields", "nnz"):
+                np.testing.assert_array_equal(getattr(pb, f), getattr(nb, f))
+            np.testing.assert_array_equal(pw, nw)
+
+    def test_missing_file_raises(self, tmp_path):
+        from fast_tffm_tpu.data.native import native_batch_stream
+
+        with pytest.raises(FileNotFoundError):
+            next(
+                native_batch_stream(
+                    native,
+                    [str(tmp_path / "nope.libsvm")],
+                    batch_size=4,
+                    vocabulary_size=10,
+                    max_nnz=2,
+                )
+            )
+
+    def test_parse_error_names_file(self, tmp_path):
+        from fast_tffm_tpu.data.native import native_batch_stream
+
+        p = tmp_path / "bad.libsvm"
+        p.write_text("1 0:1.0\n1 nonsense\n")
+        with pytest.raises(ValueError, match="bad.libsvm"):
+            list(
+                native_batch_stream(
+                    native,
+                    [str(p)],
+                    batch_size=4,
+                    vocabulary_size=10,
+                    max_nnz=2,
+                )
+            )
