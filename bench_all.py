@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Train-step throughput for ALL five BASELINE.json benchmark configs.
+
+`bench.py` stays the driver's one-line config-#1 benchmark; this sweeps the
+whole BASELINE.md table — one JSON line per config — on whatever chips are
+visible:
+
+  #1 2nd-order FM k=8   (Criteo-sample shape: 39 feats, 1M vocab)
+  #2 2nd-order FM k=16  (Criteo-1TB shape: 16M vocab, row-sharded mesh step)
+  #3 FFM k=4            (Avazu shape: 22 fields)
+  #4 DeepFM 3×400 MLP   (Criteo shape; MXU dense half)
+  #5 order-3 FM k=8     (KDD-2012 shape: 11 feats; Pallas ANOVA kernel on TPU)
+
+Batches are synthetic (the host input path is benchmarked separately by the
+data-layer tests; device throughput is what the north star counts).
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fast_tffm_tpu.models import Batch, DeepFMModel, FFMModel, FMModel
+from fast_tffm_tpu.trainer import init_state, make_train_step
+
+BASELINE = 500_000.0  # examples/sec/chip north star
+
+
+def make_batch(rng, batch_size, nnz, vocab, num_fields=0):
+    fields = (
+        np.tile(np.arange(nnz, dtype=np.int32) % max(num_fields, 1), (batch_size, 1))
+        if num_fields
+        else np.zeros((batch_size, nnz), np.int32)
+    )
+    return Batch(
+        labels=jnp.asarray(rng.integers(0, 2, size=(batch_size,)).astype(np.float32)),
+        ids=jnp.asarray(rng.integers(0, vocab, size=(batch_size, nnz)).astype(np.int32)),
+        vals=jnp.asarray(np.abs(rng.normal(size=(batch_size, nnz)).astype(np.float32)) + 0.1),
+        fields=jnp.asarray(fields),
+        weights=jnp.ones((batch_size,), np.float32),
+    )
+
+
+def time_step(step, state, batches, warmup=5, iters=30):
+    for i in range(warmup):
+        state, loss = step(state, batches[i % len(batches)])
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        state, loss = step(state, batches[i % len(batches)])
+    jax.block_until_ready(loss)
+    return iters / (time.perf_counter() - t0)
+
+
+def bench_local(name, model, batch_size, nnz, vocab, num_fields=0, lr=0.01):
+    state = init_state(model, jax.random.key(0))
+    step = make_train_step(model, lr)
+    rng = np.random.default_rng(0)
+    batches = [make_batch(rng, batch_size, nnz, vocab, num_fields) for _ in range(8)]
+    sps = time_step(step, state, batches)
+    report(name, batch_size * sps / jax.device_count())
+
+
+def bench_sharded(name, model, batch_size, nnz, vocab, lr=0.01):
+    from fast_tffm_tpu.parallel import init_sharded_state, make_mesh, make_sharded_train_step
+
+    mesh = make_mesh(None, jax.device_count())  # all visible chips on the row axis
+    state = init_sharded_state(model, mesh, jax.random.key(0))
+    step = make_sharded_train_step(model, lr, mesh)
+    rng = np.random.default_rng(0)
+    batches = [make_batch(rng, batch_size, nnz, vocab) for _ in range(8)]
+    sps = time_step(step, state, batches)
+    report(name, batch_size * sps / jax.device_count())
+
+
+def report(name, per_chip):
+    print(
+        json.dumps(
+            {
+                "metric": name,
+                "value": round(per_chip, 1),
+                "unit": "examples/sec/chip",
+                "vs_baseline": round(per_chip / BASELINE, 4),
+            }
+        )
+    )
+
+
+def main():
+    B = 16384
+    bench_local(
+        "cfg1: train ex/s/chip (FM order2 k=8, nnz=39, vocab=1M)",
+        FMModel(vocabulary_size=1 << 20, factor_num=8, order=2),
+        B, 39, 1 << 20, lr=0.05,
+    )
+    bench_sharded(
+        "cfg2: train ex/s/chip (FM order2 k=16, nnz=39, vocab=16M, row-sharded mesh)",
+        FMModel(vocabulary_size=1 << 24, factor_num=16, order=2),
+        B, 39, 1 << 24, lr=0.05,
+    )
+    bench_local(
+        "cfg3: train ex/s/chip (FFM k=4, 22 fields, vocab=1M)",
+        FFMModel(vocabulary_size=1 << 20, num_fields=22, factor_num=4),
+        8192, 22, 1 << 20, num_fields=22, lr=0.05,
+    )
+    bench_local(
+        "cfg4: train ex/s/chip (DeepFM k=8 + 3x400 MLP, nnz=39, vocab=1M)",
+        DeepFMModel(vocabulary_size=1 << 20, num_fields=39, factor_num=8),
+        8192, 39, 1 << 20, lr=0.02,
+    )
+    bench_local(
+        "cfg5: train ex/s/chip (FM order3 k=8, nnz=11, vocab=1M, ANOVA kernel)",
+        FMModel(vocabulary_size=1 << 20, factor_num=8, order=3),
+        B, 11, 1 << 20, lr=0.05,
+    )
+
+
+if __name__ == "__main__":
+    main()

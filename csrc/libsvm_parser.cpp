@@ -50,7 +50,11 @@ inline uint64_t fnv1a64(const char* data, int64_t len) {
   return h;
 }
 
-inline bool is_space(char c) { return c == ' ' || c == '\t' || c == '\r'; }
+// Matches Python str.split() whitespace for the characters that can appear
+// inside a line ('\n' is always a terminator before tokenization).
+inline bool is_space(char c) {
+  return c == ' ' || c == '\t' || c == '\r' || c == '\v' || c == '\f';
+}
 
 // Error codes mirrored in data/native.py.
 enum ErrorCode {
@@ -60,6 +64,7 @@ enum ErrorCode {
   kBadToken = 3,
   kIdOutOfRange = 4,
   kRowTooWide = 5,
+  kReadError = 6,
 };
 
 // Powers of ten exactly representable in double (10^0 .. 10^22).
@@ -426,12 +431,23 @@ struct FmReader {
   std::string tail;          // partial line carried across refills
   bool tail_valid = false;   // tail holds a complete final unterminated line
   bool eof = false;
+  bool read_error = false;   // fread failed mid-file (NOT clean EOF)
   int64_t shard_index = 0, shard_count = 1;
   int64_t counter = 0;       // global non-blank line index (spans files)
   // Per-call arena for the selected lines (stable while parsing).
   std::string arena;
   std::vector<std::pair<size_t, size_t>> offsets;  // (offset, len) into arena
 };
+
+// First '\n' OR '\r' in [p, p+len) — universal-newline line terminators,
+// matching the Python path's text-mode open().  A '\r\n' pair produces an
+// empty second line, which the blank-line skip discards.
+inline const char* find_eol(const char* p, size_t len) {
+  const char* lf = static_cast<const char*>(memchr(p, '\n', len));
+  const char* cr = static_cast<const char*>(
+      memchr(p, '\r', lf ? static_cast<size_t>(lf - p) : len));
+  return cr ? cr : lf;
+}
 
 // Pull the next raw line span out of the buffered file.  Returns false at
 // EOF.  The returned span is valid until the next call (it may point into
@@ -440,8 +456,7 @@ bool next_line(FmReader* r, const char** begin, const char** end) {
   for (;;) {
     if (r->pos < r->len) {
       const char* base = r->buf.data();
-      const char* nl = static_cast<const char*>(
-          memchr(base + r->pos, '\n', r->len - r->pos));
+      const char* nl = find_eol(base + r->pos, r->len - r->pos);
       if (nl) {
         size_t line_end = static_cast<size_t>(nl - base);
         if (!r->tail.empty()) {
@@ -476,6 +491,14 @@ bool next_line(FmReader* r, const char** begin, const char** end) {
     size_t got = fread(r->buf.data(), 1, r->buf.size(), r->f);
     r->pos = 0;
     r->len = got;
+    if (got < r->buf.size() && ferror(r->f)) {
+      // A transient I/O failure must NOT look like clean EOF — silently
+      // truncating an epoch is the worst possible failure mode.
+      r->read_error = true;
+      r->eof = true;
+      r->len = 0;  // drop the partial window; the caller aborts anyway
+      return false;
+    }
     if (got == 0) r->eof = true;
   }
 }
@@ -546,6 +569,12 @@ int64_t fm_reader_next(void* reader, int64_t want, int64_t width,
       r->tail.clear();
       r->tail_valid = false;
     }
+  }
+
+  if (r->read_error) {
+    *error_code = kReadError;
+    *error_line = -1;
+    return -1;
   }
 
   const int64_t rows = static_cast<int64_t>(r->offsets.size());

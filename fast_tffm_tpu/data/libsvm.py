@@ -128,7 +128,8 @@ def parse_lines(
             )
         m = len(ids_)
         ids[li, :m] = ids_
-        vals[li, :m] = vals_
+        with np.errstate(over="ignore"):  # huge decimals -> inf, like the C++ cast
+            vals[li, :m] = vals_
         fields[li, :m] = flds_
         nnz[li] = m
     return ParsedBatch(labels=labels, ids=ids, vals=vals, fields=fields, nnz=nnz)

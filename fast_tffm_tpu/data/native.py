@@ -68,6 +68,7 @@ _ERRORS = {
     3: "bad token",
     4: "feature id out of range",
     5: "row wider than max_nnz",
+    6: "read error (I/O failure mid-file, not clean EOF)",
 }
 
 
@@ -255,9 +256,13 @@ def native_batch_stream(
                         ctypes.byref(el),
                     )
                     if got < 0:
+                        # el is relative to THIS fm_reader_next call, which
+                        # writes at offset `filled`; report the batch row.
+                        where = (
+                            f" (batch row {filled + el.value})" if el.value >= 0 else ""
+                        )
                         raise ValueError(
-                            f"{_ERRORS.get(ec.value, f'error {ec.value}')} in {path} "
-                            f"(shard row {el.value} of this batch)"
+                            f"{_ERRORS.get(ec.value, f'error {ec.value}')} in {path}{where}"
                         )
                     w[filled : filled + got] = fw
                     filled += int(got)

@@ -269,3 +269,54 @@ class TestNativeStream:
                     max_nnz=2,
                 )
             )
+
+    def test_parse_error_reports_batch_row(self, tmp_path):
+        # A batch spanning two files: the error row index must be absolute
+        # within the batch, not relative to the current fm_reader_next call.
+        from fast_tffm_tpu.data.native import native_batch_stream
+
+        a, b = tmp_path / "a.libsvm", tmp_path / "b.libsvm"
+        a.write_text("1 0:1.0\n0 1:2.0\n")  # contributes batch rows 0-1
+        b.write_text("1 2:1.0\n1 nonsense\n")  # error at batch row 3
+        with pytest.raises(ValueError, match=r"batch row 3"):
+            list(
+                native_batch_stream(
+                    native,
+                    [str(a), str(b)],
+                    batch_size=8,
+                    vocabulary_size=10,
+                    max_nnz=2,
+                )
+            )
+
+    def test_universal_newlines_and_exotic_whitespace(self, tmp_path):
+        # CRLF and lone-CR line endings plus \v/\f whitespace: the Python
+        # path (text-mode open + str.split/strip) and the native reader must
+        # produce identical batches.
+        from fast_tffm_tpu.data.pipeline import batch_stream
+
+        p = tmp_path / "mixed.libsvm"
+        with open(p, "w", newline="") as f:
+            f.write("1 0:1.0\r\n")  # CRLF
+            f.write("0 1:2.0\r")  # classic-Mac lone CR
+            f.write("1\t2:3.0\v4:5.0\n")  # tab + vertical-tab separators
+            f.write("\f\n")  # form-feed-only line: blank, skipped
+            f.write("0 3:4.0\n")
+
+        def collect(parser):
+            return list(
+                batch_stream(
+                    [str(p)],
+                    batch_size=4,
+                    vocabulary_size=10,
+                    max_nnz=2,
+                    parser=parser,
+                )
+            )
+
+        py, nat = collect(None), collect(native)
+        assert len(py) == len(nat) == 1
+        for (pb, pw), (nb, nw) in zip(py, nat):
+            for f in ("labels", "ids", "vals", "fields", "nnz"):
+                np.testing.assert_array_equal(getattr(pb, f), getattr(nb, f))
+            np.testing.assert_array_equal(pw, nw)

@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""Synthetic CTR dataset generator (libsvm / libffm text).
+
+The reference project shipped a small sample data file as its de-facto test
+input (`renyi533/fast_tffm` :: repo-root sample data + sample.cfg; SURVEY.md
+§5 "the de-facto test is running train/predict on a bundled sample data
+file").  No dataset ships in this environment, so this tool generates
+statistically Criteo/Avazu/KDD-shaped data with a PLANTED factorization
+-machine signal, so that training on it produces a genuinely learnable AUC
+(the e2e smoke's success criterion) rather than coin-flip labels:
+
+  * one feature id per field, drawn Zipf-like within the field's id range
+    (CTR data is heavy-tailed: a few ids dominate);
+  * labels ~ Bernoulli(sigmoid(score)) where score comes from a hidden FM
+    (bias + order-2 interactions) over the drawn ids;
+  * --format libffm writes `field:feat:val` tokens (FFM), libsvm `feat:val`.
+
+Usage (the configs/ headers reference these exact commands):
+
+  python tools/gen_synthetic.py --rows 100000 --fields 39 --vocab 1048576 \
+      --out data/criteo_sample.train.libsvm
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+
+def _zipf_ids(rng, n_rows: int, field_lo: int, field_hi: int) -> np.ndarray:
+    """Heavy-tailed id draw within [field_lo, field_hi)."""
+    span = field_hi - field_lo
+    # Inverse-CDF of a truncated power law: rank ~ u^alpha spreads mass onto
+    # low ranks; permuting ranks decorrelates popularity from id order.
+    u = rng.random(n_rows)
+    ranks = np.minimum((span * u**2.5).astype(np.int64), span - 1)
+    return field_lo + ranks
+
+
+def _mix64(x: np.ndarray, salt: int) -> np.ndarray:
+    """SplitMix64 finalizer: uint64 → well-mixed uint64 (vectorized)."""
+    z = (x.astype(np.uint64) + np.uint64(salt * 0x9E3779B97F4A7C15 & 0xFFFFFFFFFFFFFFFF))
+    z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return z ^ (z >> np.uint64(31))
+
+
+def _id_normal(ids: np.ndarray, salt: int) -> np.ndarray:
+    """Deterministic N(0,1) draw per feature id (Box-Muller over two hashes).
+
+    The hidden FM's parameters MUST be a pure function of the id value, not
+    of any per-file state — train/validation/test files generated in
+    separate calls have to score examples with the SAME planted model, or
+    held-out AUC is structurally pinned at 0.5.
+    """
+    with np.errstate(divide="ignore"):
+        u1 = (_mix64(ids, 2 * salt) >> np.uint64(11)).astype(np.float64) / float(1 << 53)
+        u2 = (_mix64(ids, 2 * salt + 1) >> np.uint64(11)).astype(np.float64) / float(1 << 53)
+        z = np.sqrt(-2.0 * np.log(np.maximum(u1, 1e-300))) * np.cos(2.0 * np.pi * u2)
+    return z.astype(np.float32)
+
+
+def generate(
+    out: str,
+    rows: int,
+    fields: int,
+    vocab: int,
+    fmt: str = "libsvm",
+    factor_num: int = 4,
+    seed: int = 0,
+    binary_vals: bool = False,
+    model_seed: int = 1234,
+) -> None:
+    rng = np.random.default_rng(seed)
+    # Field f owns the id range [f*vocab//fields, (f+1)*vocab//fields).
+    bounds = np.linspace(0, vocab, fields + 1).astype(np.int64)
+
+    ids = np.stack(
+        [_zipf_ids(rng, rows, bounds[f], bounds[f + 1]) for f in range(fields)],
+        axis=1,
+    )  # [rows, fields]
+    vals = (
+        np.ones((rows, fields), np.float32)
+        if binary_vals
+        else np.round(np.abs(rng.normal(0.5, 0.35, size=(rows, fields))) + 0.05, 4).astype(
+            np.float32
+        )
+    )
+
+    # Hidden FM: per-id bias + factors as a stateless function of (id,
+    # model_seed) — files generated with different --seed but the same
+    # --model-seed share one planted model, so held-out AUC is meaningful.
+    bias = 0.6 * _id_normal(ids, model_seed).reshape(rows, fields)
+    fac = np.stack(
+        [0.45 * _id_normal(ids, model_seed + 7 + j) for j in range(factor_num)],
+        axis=-1,
+    ).reshape(rows, fields, factor_num)
+
+    vx = fac * vals[..., None]
+    s1 = vx.sum(axis=1)
+    inter = 0.5 * ((s1 * s1).sum(-1) - (vx * vx).sum(axis=(1, 2)))
+    score = (bias * vals).sum(axis=1) + inter
+    score = (score - score.mean()) / (score.std() + 1e-6) * 1.5  # calibrated spread
+    labels = (rng.random(rows) < 1.0 / (1.0 + np.exp(-score))).astype(np.int64)
+
+    os.makedirs(os.path.dirname(os.path.abspath(out)), exist_ok=True)
+    with open(out, "w") as f:
+        for r in range(rows):
+            if fmt == "libffm":
+                toks = " ".join(
+                    f"{fi}:{ids[r, fi]}:{vals[r, fi]}" for fi in range(fields)
+                )
+            else:
+                toks = " ".join(f"{ids[r, fi]}:{vals[r, fi]}" for fi in range(fields))
+            f.write(f"{labels[r]} {toks}\n")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", required=True, help="output text file")
+    ap.add_argument("--rows", type=int, default=100_000)
+    ap.add_argument("--fields", type=int, default=39, help="features per example")
+    ap.add_argument("--vocab", type=int, default=1 << 20)
+    ap.add_argument("--format", choices=("libsvm", "libffm"), default="libsvm")
+    ap.add_argument("--factor-num", type=int, default=4, help="hidden FM rank")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--binary-vals", action="store_true", help="all feature values 1.0 (one-hot style)"
+    )
+    ap.add_argument(
+        "--model-seed",
+        type=int,
+        default=1234,
+        help="seed of the PLANTED model (keep equal across train/valid/test splits)",
+    )
+    a = ap.parse_args(argv)
+    generate(
+        a.out,
+        a.rows,
+        a.fields,
+        a.vocab,
+        a.format,
+        a.factor_num,
+        a.seed,
+        a.binary_vals,
+        a.model_seed,
+    )
+    print(f"wrote {a.rows} rows ({a.fields} fields, vocab {a.vocab}, {a.format}) -> {a.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
