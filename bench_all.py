@@ -106,8 +106,10 @@ def main():
         8192, 22, 1 << 20, num_fields=22, lr=0.05,
     )
     bench_local(
-        "cfg4: train ex/s/chip (DeepFM k=8 + 3x400 MLP, nnz=39, vocab=1M)",
-        DeepFMModel(vocabulary_size=1 << 20, num_fields=39, factor_num=8),
+        "cfg4: train ex/s/chip (DeepFM k=8 + 3x400 MLP bf16, nnz=39, vocab=1M)",
+        DeepFMModel(
+            vocabulary_size=1 << 20, num_fields=39, factor_num=8, compute_dtype="bfloat16"
+        ),
         8192, 39, 1 << 20, lr=0.02,
     )
     bench_local(

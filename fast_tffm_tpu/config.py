@@ -25,6 +25,7 @@ class Config:
     order: int = 2
     num_fields: int = 0  # required for ffm/deepfm
     hidden_dims: tuple[int, ...] = (400, 400, 400)  # deepfm MLP head
+    compute_dtype: str = "float32"  # deepfm MLP matmul precision (float32|bfloat16)
     vocabulary_size: int = 1 << 20
     vocabulary_block_num: int = 1  # reference key; default row_parallel
     hash_feature_id: bool = False
@@ -70,6 +71,8 @@ class Config:
             raise ValueError("vocabulary_size and batch_size must be positive")
         if self.checkpoint_format not in ("npz", "orbax"):
             raise ValueError(f"unknown checkpoint_format {self.checkpoint_format!r}")
+        if self.compute_dtype not in ("float32", "bfloat16"):
+            raise ValueError(f"unknown compute_dtype {self.compute_dtype!r}")
         return self
 
 
@@ -79,7 +82,10 @@ def _split(s: str) -> tuple[str, ...]:
 
 def load_config(path: str) -> Config:
     """Parse an INI file into a validated Config."""
-    ini = configparser.ConfigParser()
+    # The reference's sample.cfg style annotates values in place
+    # ("key = value  ; comment"); ConfigParser keeps inline comments unless
+    # told otherwise, which would corrupt every annotated value.
+    ini = configparser.ConfigParser(inline_comment_prefixes=(";", "#"))
     with open(path) as f:
         ini.read_file(f)
     cfg = Config()
@@ -98,6 +104,7 @@ def load_config(path: str) -> Config:
     cfg.hidden_dims = get(
         g, "hidden_dims", lambda s: tuple(int(x) for x in _split(s)), cfg.hidden_dims
     )
+    cfg.compute_dtype = get(g, "compute_dtype", str, cfg.compute_dtype).lower()
     cfg.vocabulary_size = get(g, "vocabulary_size", int, cfg.vocabulary_size)
     cfg.vocabulary_block_num = get(g, "vocabulary_block_num", int, cfg.vocabulary_block_num)
     cfg.hash_feature_id = get(g, "hash_feature_id", ini._convert_to_boolean, cfg.hash_feature_id)
@@ -172,4 +179,5 @@ def build_model(cfg: Config):
         init_value_range=cfg.init_value_range,
         factor_lambda=cfg.factor_lambda,
         bias_lambda=cfg.bias_lambda,
+        compute_dtype=cfg.compute_dtype,
     )

@@ -32,6 +32,12 @@ class DeepFMModel:
     init_value_range: float = 0.01
     factor_lambda: float = 0.0
     bias_lambda: float = 0.0
+    # MXU-native precision for the MLP matmuls: params/optimizer state stay
+    # float32 (master weights); activations and weights are cast per-matmul
+    # and products accumulate in float32 (preferred_element_type).  The FM
+    # half and the embedding table are untouched — they are HBM-bound
+    # gathers + VPU elementwise work, not MXU work.
+    compute_dtype: str = "float32"  # float32 | bfloat16
 
     @property
     def row_dim(self) -> int:
@@ -62,8 +68,13 @@ class DeepFMModel:
 
     def _mlp(self, dense, x: jax.Array) -> jax.Array:
         n_layers = len(self.hidden_dims) + 1
+        dt = jnp.dtype(self.compute_dtype)
         for li in range(n_layers):
-            x = x @ dense[f"w{li}"] + dense[f"b{li}"]
+            x = jnp.dot(
+                x.astype(dt),
+                dense[f"w{li}"].astype(dt),
+                preferred_element_type=jnp.float32,
+            ) + dense[f"b{li}"]
             if li < n_layers - 1:
                 x = jax.nn.relu(x)
         return x[..., 0]  # [B]

@@ -83,3 +83,39 @@ def test_defaults(tmp_path):
     cfg = load_config(str(p))
     assert cfg.model == "fm" and cfg.order == 2
     assert cfg.batch_size == 1024 and cfg.init_accumulator_value == 0.1
+
+
+def test_compute_dtype_parsed_and_validated(tmp_path):
+    p = tmp_path / "c.cfg"
+    p.write_text(
+        "[General]\nmodel = deepfm\nnum_fields = 5\ncompute_dtype = BFLOAT16\n"
+    )
+    from fast_tffm_tpu.config import build_model, load_config
+
+    cfg = load_config(str(p))
+    assert cfg.compute_dtype == "bfloat16"
+    assert build_model(cfg).compute_dtype == "bfloat16"
+
+    p.write_text("[General]\nmodel = deepfm\nnum_fields = 5\ncompute_dtype = fp8\n")
+    import pytest
+
+    with pytest.raises(ValueError, match="compute_dtype"):
+        load_config(str(p))
+
+
+def test_shipped_configs_parse():
+    # sample.cfg and every configs/*.cfg use inline ";" comments — they must
+    # all load cleanly (regression: inline comments once leaked into values).
+    import glob
+    import os
+
+    from fast_tffm_tpu.config import load_config
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = [os.path.join(repo, "sample.cfg")] + sorted(
+        glob.glob(os.path.join(repo, "configs", "*.cfg"))
+    )
+    assert len(paths) >= 6
+    for p in paths:
+        cfg = load_config(p)
+        assert cfg.model in ("fm", "ffm", "deepfm"), p

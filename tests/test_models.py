@@ -96,3 +96,25 @@ def test_regularization_masks_padding():
     r = np.asarray(rows)
     want = 0.2 * (r[..., 0][mask] ** 2).sum() + 0.1 * ((r[..., 1:] ** 2).sum(-1)[mask]).sum()
     np.testing.assert_allclose(reg, want, rtol=1e-5)
+
+
+def test_deepfm_bfloat16_compute_close_to_f32():
+    # bf16 is a COMPUTE dtype only: params stay f32, matmuls accumulate f32.
+    # Scores must track the f32 model within bf16 rounding, and gradients
+    # must stay finite f32 (the optimizer never sees bf16).
+    rng = np.random.default_rng(4)
+    kw = dict(vocabulary_size=50, num_fields=5, factor_num=4, hidden_dims=(16, 16, 16))
+    m32 = DeepFMModel(**kw)
+    m16 = DeepFMModel(**kw, compute_dtype="bfloat16")
+    batch = _batch(rng, N=5, pad_tail=0)
+    rows = jnp.asarray(rng.normal(size=(4, 5, m32.row_dim)).astype(np.float32))
+    dense = m32.init_dense(jax.random.key(1))
+    s32 = np.asarray(m32.score(rows, dense, batch))
+    s16 = np.asarray(m16.score(rows, dense, batch))
+    assert s16.dtype == np.float32
+    np.testing.assert_allclose(s16, s32, rtol=3e-2, atol=3e-2)
+
+    g = jax.grad(lambda d: jnp.sum(m16.score(rows, d, batch)))(dense)
+    for leaf in jax.tree.leaves(g):
+        assert leaf.dtype == jnp.float32
+        assert bool(jnp.all(jnp.isfinite(leaf)))
