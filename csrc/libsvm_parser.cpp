@@ -19,9 +19,13 @@
 //
 // The number parsers are hand-rolled because strtod/strtoll dominate the
 // profile on CTR-style data (~40 numeric tokens per line): the fast path
-// (<= 15 mantissa digits, |decimal exponent| <= 22) computes
+// (Clinger's bound — mantissa value <= 2^53, |decimal exponent| <= 22,
+// which covers the 16-17 digit shortest-repr of float32 values) computes
 // mantissa * 10^e in one correctly-rounded double operation — provably
 // identical to strtod there — and anything else falls back to strtod.
+// Scanning is fused with parsing: the hot (non-hash) path touches each
+// token's characters once, except that a fractional value's integer digits
+// are seen twice (scan_int tries them before scan_double_fast re-reads).
 //
 // Build: csrc/Makefile -> fast_tffm_tpu/data/_libsvm_parser.so
 
@@ -99,73 +103,6 @@ inline bool slow_double(const char* p, const char* end, double* out) {
   return true;
 }
 
-// Parse a full-token decimal number, bit-identical to Python float(tok).
-inline bool parse_double(const char* p, const char* end, double* out) {
-  const char* q = p;
-  bool neg = false;
-  if (q < end && (*q == '+' || *q == '-')) {
-    neg = (*q == '-');
-    ++q;
-  }
-  uint64_t mant = 0;
-  int digits = 0;   // significant digits accumulated into mant
-  int exp10 = 0;    // decimal exponent to apply to mant
-  bool any = false;
-  while (q < end && *q >= '0' && *q <= '9') {
-    any = true;
-    if (digits < 15) {
-      mant = mant * 10 + static_cast<uint64_t>(*q - '0');
-      if (mant) ++digits;  // leading zeros are free
-    } else {
-      return slow_double(p, end, out);  // 16+ digits: exactness not provable
-    }
-    ++q;
-  }
-  if (q < end && *q == '.') {
-    ++q;
-    while (q < end && *q >= '0' && *q <= '9') {
-      any = true;
-      if (digits < 15) {
-        mant = mant * 10 + static_cast<uint64_t>(*q - '0');
-        if (mant) ++digits;
-        --exp10;
-      } else {
-        return slow_double(p, end, out);
-      }
-      ++q;
-    }
-  }
-  if (!any) return slow_double(p, end, out);  // "inf", "nan", or junk
-  if (q < end && (*q == 'e' || *q == 'E')) {
-    ++q;
-    bool eneg = false;
-    if (q < end && (*q == '+' || *q == '-')) {
-      eneg = (*q == '-');
-      ++q;
-    }
-    int e = 0;
-    bool eany = false;
-    while (q < end && *q >= '0' && *q <= '9') {
-      eany = true;
-      if (e < 100000) e = e * 10 + (*q - '0');
-      ++q;
-    }
-    if (!eany) return false;
-    exp10 += eneg ? -e : e;
-  }
-  if (q != end) return false;  // trailing junk: Python float() would raise
-  double d;
-  if (exp10 >= 0) {
-    if (exp10 > 22) return slow_double(p, end, out);
-    d = static_cast<double>(mant) * kPow10[exp10];  // one rounding: exact
-  } else {
-    if (exp10 < -22) return slow_double(p, end, out);
-    d = static_cast<double>(mant) / kPow10[-exp10];  // one rounding: exact
-  }
-  *out = neg ? -d : d;
-  return true;
-}
-
 // Parse a full-token decimal integer (optional sign, digits only — the
 // subset Python int(tok) accepts that feature-id tokens use).
 inline bool parse_int(const char* p, const char* end, int64_t* out) {
@@ -188,6 +125,108 @@ inline bool parse_int(const char* p, const char* end, int64_t* out) {
   if (v > static_cast<uint64_t>(INT64_MAX)) return false;
   *out = neg ? -static_cast<int64_t>(v) : static_cast<int64_t>(v);
   return true;
+}
+
+// Scan a decimal number starting at p, stopping at the first character that
+// cannot extend it (single fused pass — scanning IS parsing; tokens are
+// never re-walked).  Returns the cursor after the number with *out set, or
+// nullptr when the fast path cannot guarantee Python-float() bit-parity
+// (no digits, 16+ digits, |exp10| > 22, malformed exponent) — the caller
+// then re-parses the full whitespace-delimited token through slow_double.
+inline const char* scan_double_fast(const char* p, const char* end,
+                                    double* out) {
+  const char* q = p;
+  bool neg = false;
+  if (q < end && (*q == '+' || *q == '-')) {
+    neg = (*q == '-');
+    ++q;
+  }
+  // Clinger's exactness bound: the fast path is provably correctly rounded
+  // whenever the mantissa is exactly representable in a double (<= 2^53)
+  // and the scaling power of ten is exact (|exp10| <= 22).  Accumulating
+  // up to 19 digits (vs. stopping at 15 significant) matters in practice:
+  // shortest-repr float32 values round-trip through 16-17 digit decimals.
+  constexpr uint64_t kMantNoOverflow = (UINT64_MAX - 9) / 10;
+  constexpr uint64_t kMantExact = 1ULL << 53;
+  const char* d0 = q;
+  uint64_t mant = 0;
+  while (q < end) {
+    unsigned c = static_cast<unsigned char>(*q) - '0';
+    if (c > 9) break;
+    if (mant > kMantNoOverflow) return nullptr;  // 20+ digits: slow path
+    mant = mant * 10 + c;
+    ++q;
+  }
+  const char* d1 = q;
+  int frac = 0;
+  if (q < end && *q == '.') {
+    ++q;
+    const char* f0 = q;
+    while (q < end) {
+      unsigned c = static_cast<unsigned char>(*q) - '0';
+      if (c > 9) break;
+      if (mant > kMantNoOverflow) return nullptr;
+      mant = mant * 10 + c;
+      ++q;
+    }
+    frac = static_cast<int>(q - f0);
+  }
+  int ndig = static_cast<int>(d1 - d0) + frac;
+  if (ndig == 0 || mant > kMantExact) return nullptr;
+  int exp10 = -frac;
+  if (q < end && (*q == 'e' || *q == 'E')) {
+    ++q;
+    bool eneg = false;
+    if (q < end && (*q == '+' || *q == '-')) {
+      eneg = (*q == '-');
+      ++q;
+    }
+    const char* e0 = q;
+    int e = 0;
+    while (q < end) {
+      unsigned c = static_cast<unsigned char>(*q) - '0';
+      if (c > 9) break;
+      if (e < 100000) e = e * 10 + static_cast<int>(c);
+      ++q;
+    }
+    if (q == e0) return nullptr;  // "1e" / "1e+": slow path rejects
+    exp10 += eneg ? -e : e;
+  }
+  double d;
+  if (exp10 >= 0) {
+    if (exp10 > 22) return nullptr;
+    d = static_cast<double>(mant) * kPow10[exp10];  // one rounding: exact
+  } else {
+    if (exp10 < -22) return nullptr;
+    d = static_cast<double>(mant) / kPow10[-exp10];  // one rounding: exact
+  }
+  *out = neg ? -d : d;
+  return q;
+}
+
+// Scan an optionally-signed decimal integer, stopping at the first
+// non-digit.  Returns the cursor after the digits, or nullptr on no digits
+// or int64 overflow (matching parse_int's rejection).
+inline const char* scan_int(const char* p, const char* end, int64_t* out) {
+  const char* q = p;
+  bool neg = false;
+  if (q < end && (*q == '+' || *q == '-')) {
+    neg = (*q == '-');
+    ++q;
+  }
+  const char* d0 = q;
+  uint64_t v = 0;
+  while (q < end) {
+    unsigned c = static_cast<unsigned char>(*q) - '0';
+    if (c > 9) break;
+    if (v > (UINT64_MAX - 9) / 10) return nullptr;
+    v = v * 10 + c;
+    ++q;
+  }
+  if (q == d0) return nullptr;
+  if (v > static_cast<uint64_t>(INT64_MAX)) return nullptr;
+  *out = neg ? -static_cast<int64_t>(v) : static_cast<int64_t>(v);
+  return q;
 }
 
 struct LineSpan {
@@ -217,13 +256,25 @@ inline int32_t parse_line(const char* p, const char* end, int64_t li,
   const char* q = p;
   while (q < end && is_space(*q)) ++q;
   if (q >= end) return kEmptyLine;
-  // Label token.
-  const char* tok = q;
-  while (q < end && !is_space(*q)) ++q;
+
+  // Label: fused scan; anything the fast scan can't finish (or that does
+  // not end at whitespace) re-parses the whole token via the slow path.
   double y;
-  if (!parse_double(tok, q, &y)) return kBadLabel;
+  {
+    const char* tok = q;
+    const char* after = scan_double_fast(q, end, &y);
+    if (after && (after >= end || is_space(*after))) {
+      q = after;
+    } else {
+      while (q < end && !is_space(*q)) ++q;
+      if (!slow_double(tok, q, &y)) return kBadLabel;
+    }
+  }
   labels[li] = y <= 0.0 ? 0.0f : 1.0f;
-  // Feature tokens.
+
+  // Feature tokens: "feat:val" or "field:feat:val".  The hot (non-hash)
+  // path walks each token exactly once — the digit scans both segment and
+  // parse; only exotic tokens fall back to a find-token-end + slow re-parse.
   int64_t m = 0;
   int64_t* row_ids = ids + li * width;
   float* row_vals = vals + li * width;
@@ -231,40 +282,71 @@ inline int32_t parse_line(const char* p, const char* end, int64_t li,
   while (q < end) {
     while (q < end && is_space(*q)) ++q;
     if (q >= end) break;
-    tok = q;
-    while (q < end && !is_space(*q)) ++q;
-    const char* tok_end = q;
-    // Split on ':' — one colon (feat:val) or two (field:feat:val).
-    const char* c1 =
-        static_cast<const char*>(memchr(tok, ':', tok_end - tok));
-    if (!c1 || c1 == tok || c1 + 1 >= tok_end) return kBadToken;
-    const char* c2 =
-        static_cast<const char*>(memchr(c1 + 1, ':', tok_end - (c1 + 1)));
-    const char* feat_begin;
-    const char* feat_end;
     int64_t field = 0;
-    const char* val_begin;
-    if (c2) {
-      if (c2 + 1 >= tok_end) return kBadToken;
-      if (!parse_int(tok, c1, &field)) return kBadToken;
-      feat_begin = c1 + 1;
-      feat_end = c2;
-      val_begin = c2 + 1;
-    } else {
-      feat_begin = tok;
-      feat_end = c1;
-      val_begin = c1 + 1;
-    }
     int64_t fid;
-    if (hash_feature_id) {
+    if (!hash_feature_id) {
+      int64_t a;
+      const char* p1 = scan_int(q, end, &a);
+      if (!p1 || p1 >= end || *p1 != ':') return kBadToken;
+      ++p1;  // past ':'
+      int64_t b;
+      const char* p2 = scan_int(p1, end, &b);
+      if (p2 && p2 < end && *p2 == ':') {
+        field = a;  // field:feat:val
+        fid = b;
+        q = p2 + 1;
+      } else {
+        fid = a;  // feat:val
+        q = p1;
+      }
+      if (fid < 0 || fid >= vocabulary_size) return kIdOutOfRange;
+    } else {
+      // Hash mode: feature tokens are raw bytes, so the colon structure
+      // needs one explicit pass to the token end.
+      const char* tok = q;
+      const char* c1 = nullptr;
+      const char* c2 = nullptr;
+      const char* t = q;
+      while (t < end && !is_space(*t)) {
+        if (*t == ':') {
+          if (!c1) {
+            c1 = t;
+          } else if (!c2) {
+            c2 = t;
+          }
+        }
+        ++t;
+      }
+      // An empty feature name is ACCEPTED (hashed as zero bytes) in both
+      // the ':val' and 'field::val' forms — Python's tok.split(':') does
+      // the same; only an empty VALUE segment is a bad token.
+      if (!c1 || c1 + 1 >= t) return kBadToken;
+      const char* feat_begin;
+      const char* feat_end;
+      if (c2) {
+        if (c2 + 1 >= t) return kBadToken;
+        if (!parse_int(tok, c1, &field)) return kBadToken;
+        feat_begin = c1 + 1;
+        feat_end = c2;
+      } else {
+        feat_begin = tok;
+        feat_end = c1;
+      }
       fid = static_cast<int64_t>(fnv1a64(feat_begin, feat_end - feat_begin) %
                                  static_cast<uint64_t>(vocabulary_size));
-    } else {
-      if (!parse_int(feat_begin, feat_end, &fid)) return kBadToken;
-      if (fid < 0 || fid >= vocabulary_size) return kIdOutOfRange;
+      q = (c2 ? c2 : c1) + 1;  // value begins after the last split colon
     }
     double v;
-    if (!parse_double(val_begin, tok_end, &v)) return kBadToken;
+    {
+      const char* vtok = q;
+      const char* va = scan_double_fast(q, end, &v);
+      if (va && (va >= end || is_space(*va))) {
+        q = va;
+      } else {
+        while (q < end && !is_space(*q)) ++q;
+        if (!slow_double(vtok, q, &v)) return kBadToken;
+      }
+    }
     if (m >= width) return kRowTooWide;
     row_ids[m] = fid;
     row_vals[m] = static_cast<float>(v);

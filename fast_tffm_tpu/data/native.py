@@ -22,7 +22,22 @@ import numpy as np
 from fast_tffm_tpu.data.libsvm import ParsedBatch
 
 _SO_PATH = os.path.join(os.path.dirname(__file__), "_libsvm_parser.so")
-_CSRC_DIR = os.path.join(os.path.dirname(__file__), os.pardir, os.pardir, "csrc")
+
+
+def _find_csrc_dir() -> str | None:
+    """csrc/ from a repo checkout / sdist build tree, or the copy setup.py
+    places inside the package for wheel installs."""
+    here = os.path.dirname(__file__)
+    for cand in (
+        os.path.join(here, os.pardir, os.pardir, "csrc"),
+        os.path.join(here, os.pardir, "csrc"),
+    ):
+        if os.path.isfile(os.path.join(cand, "Makefile")):
+            return cand
+    return None
+
+
+_CSRC_DIR = _find_csrc_dir()
 _BUILD_ATTEMPTED = False
 
 
@@ -39,7 +54,7 @@ def _try_build() -> None:
     if _BUILD_ATTEMPTED:
         return
     _BUILD_ATTEMPTED = True
-    if not os.path.isdir(_CSRC_DIR) or not shutil.which("make"):
+    if _CSRC_DIR is None or not shutil.which("make"):
         return
     # Build to a process-unique name, then atomically rename into place:
     # concurrent processes (multi-host pods share the filesystem) must never
@@ -285,6 +300,8 @@ def _stale() -> bool:
     """True when the .so is missing or older than any csrc/ source file."""
     if not os.path.exists(_SO_PATH):
         return True
+    if _CSRC_DIR is None:
+        return False
     so_mtime = os.path.getmtime(_SO_PATH)
     try:
         entries = os.listdir(_CSRC_DIR)

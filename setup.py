@@ -1,0 +1,29 @@
+"""Build shim: ship csrc/ inside the wheel as package data.
+
+The native parser sources live at the repo root (csrc/) next to this file;
+data/native.py lazily compiles them on first use.  Wheels only carry files
+inside the package, so build_py copies csrc/ to fast_tffm_tpu/csrc/ in the
+build tree — native.py probes both locations (checkout first, then the
+installed copy).  Everything else is declared in pyproject.toml.
+"""
+
+import os
+import shutil
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class BuildPyWithCsrc(build_py):
+    def run(self):
+        super().run()
+        src = os.path.join(os.path.dirname(os.path.abspath(__file__)), "csrc")
+        dst = os.path.join(self.build_lib, "fast_tffm_tpu", "csrc")
+        if os.path.isdir(src):
+            os.makedirs(dst, exist_ok=True)
+            for name in os.listdir(src):
+                if name.endswith(".cpp") or name == "Makefile":
+                    shutil.copy2(os.path.join(src, name), os.path.join(dst, name))
+
+
+setup(cmdclass={"build_py": BuildPyWithCsrc})

@@ -76,7 +76,7 @@ def test_generator_formats(tmp_path):
     for line in open(ffm):
         toks = line.split()
         assert all(t.count(":") == 2 for t in toks[1:])
-        assert all(t.rsplit(":", 1)[1] == "1.0" for t in toks[1:])
+        assert all(float(t.rsplit(":", 1)[1]) == 1.0 for t in toks[1:])
 
 
 def test_generator_signal_is_learnable(tmp_path):

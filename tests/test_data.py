@@ -320,3 +320,19 @@ class TestNativeStream:
             for f in ("labels", "ids", "vals", "fields", "nnz"):
                 np.testing.assert_array_equal(getattr(pb, f), getattr(nb, f))
             np.testing.assert_array_equal(pw, nw)
+
+    def test_hash_mode_empty_feature_matches_python(self):
+        # ':1' (empty feature name, hashed as zero bytes) is valid in hash
+        # mode on BOTH paths; empty VALUE segments are bad tokens on both.
+        from fast_tffm_tpu.data.libsvm import parse_lines
+
+        lines = ["1 :1.5 a:2.0", "0 3::0.5"]
+        py = parse_lines(lines, vocabulary_size=1 << 20, hash_feature_id_flag=True)
+        nat = native(lines, vocabulary_size=1 << 20, hash_feature_id_flag=True)
+        for f in ("labels", "ids", "vals", "fields", "nnz"):
+            np.testing.assert_array_equal(getattr(py, f), getattr(nat, f))
+        for bad in ("1 a:", "1 :"):
+            with pytest.raises(ValueError):
+                parse_lines([bad], vocabulary_size=10, hash_feature_id_flag=True)
+            with pytest.raises(ValueError):
+                native([bad], vocabulary_size=10, hash_feature_id_flag=True)

@@ -106,14 +106,20 @@ def generate(
     labels = (rng.random(rows) < 1.0 / (1.0 + np.exp(-score))).astype(np.int64)
 
     os.makedirs(os.path.dirname(os.path.abspath(out)), exist_ok=True)
+    # Values print as fixed 4-decimal tokens: vals were rounded to 4 decimals,
+    # so this round-trips to the same float32 — and it matches how real CTR
+    # dumps look (a float32's 17-digit shortest repr does not, and pushes
+    # every token off the parser's exact fast path into strtod).
     with open(out, "w") as f:
         for r in range(rows):
             if fmt == "libffm":
                 toks = " ".join(
-                    f"{fi}:{ids[r, fi]}:{vals[r, fi]}" for fi in range(fields)
+                    f"{fi}:{ids[r, fi]}:{vals[r, fi]:.4f}" for fi in range(fields)
                 )
             else:
-                toks = " ".join(f"{ids[r, fi]}:{vals[r, fi]}" for fi in range(fields))
+                toks = " ".join(
+                    f"{ids[r, fi]}:{vals[r, fi]:.4f}" for fi in range(fields)
+                )
             f.write(f"{labels[r]} {toks}\n")
 
 
