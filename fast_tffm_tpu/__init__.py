@@ -11,4 +11,36 @@ parameter-server sharding, redone as `jax.sharding` + collectives).
 
 __version__ = "0.1.0"
 
+from fast_tffm_tpu.config import Config, build_model, load_config  # noqa: F401
+from fast_tffm_tpu.models import Batch, DeepFMModel, FFMModel, FMModel  # noqa: F401
 from fast_tffm_tpu.ops.fm import fm_score  # noqa: F401
+
+__all__ = [
+    "Batch",
+    "Config",
+    "DeepFMModel",
+    "FFMModel",
+    "FMModel",
+    "build_model",
+    "fm_score",
+    "load_config",
+    "train",
+    "dist_train",
+    "predict",
+    "dist_predict",
+]
+
+
+def __getattr__(name):
+    # train/predict drivers import lazily: they pull the full driver stack
+    # (checkpointing, pipelines), which library users of just the kernels
+    # and models should not pay for at import time.
+    if name in ("train", "dist_train"):
+        import fast_tffm_tpu.train as _t
+
+        return getattr(_t, name)
+    if name in ("predict", "dist_predict"):
+        import fast_tffm_tpu.predict as _p
+
+        return getattr(_p, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

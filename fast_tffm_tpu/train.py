@@ -15,7 +15,9 @@ cluster with async Hogwild updates).  Differences, all TPU-first:
 from __future__ import annotations
 
 import os
+import signal
 import sys
+import threading
 import time
 
 import jax
@@ -103,16 +105,39 @@ def _run_training(cfg: Config, state, step_fn, predict_step, max_nnz, log=print)
         ckpt_format = "orbax"
     tracer = WindowTracer(cfg.trace_dir if is_lead else None, count=cfg.trace_steps)
     metrics = MetricsLogger(cfg.metrics_path if is_lead else None)
+    # Preemption-safe shutdown (the reference's only recovery story was
+    # Supervisor restart-from-checkpoint; cloud TPU maintenance sends
+    # SIGTERM): first signal finishes the current step, checkpoints, and
+    # exits cleanly; a second signal falls through to the default handler.
+    stop_requested = threading.Event()
+    restore_handlers = {}
+    if threading.current_thread() is threading.main_thread():
+        def _on_signal(signum, frame):
+            log(f"received signal {signum}: checkpointing after current step")
+            stop_requested.set()
+            signal.signal(signum, restore_handlers[signum])
+
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            restore_handlers[sig] = signal.signal(sig, _on_signal)
     try:
         for epoch in range(cfg.epoch_num):
+            if stop_requested.is_set():
+                break
             for parsed, w in _stream(cfg, cfg.train_files, max_nnz, epochs=1):
                 b = Batch.from_parsed(parsed, w)
                 tracer.on_step()
                 with step_trace("train", step_num):
                     state, loss = step_fn(state, b)
                 step_num += 1
+                if step_num == start_step + 1:
+                    # Step 1 paid the XLA compile; a meter window that
+                    # includes it reads as a throughput collapse.
+                    jax.block_until_ready(loss)
+                    meter.reset()
                 losses.append(loss)  # device value; only sync at log points
                 meter.add(parsed.batch_size)
+                if stop_requested.is_set():
+                    break
                 if len(losses) >= cfg.log_every:
                     rate = meter.rate()
                     mean_loss = np.mean([float(l) for l in losses])
@@ -130,6 +155,8 @@ def _run_training(cfg: Config, state, step_fn, predict_step, max_nnz, log=print)
                     )
                     losses.clear()
                     meter.reset()
+            if stop_requested.is_set():
+                break
             if cfg.validation_files:
                 val_auc = _evaluate(cfg, predict_step, state, cfg.validation_files, max_nnz)
                 log(f"epoch {epoch} validation auc {val_auc:.5f}")
@@ -140,8 +167,19 @@ def _run_training(cfg: Config, state, step_fn, predict_step, max_nnz, log=print)
     finally:
         tracer.close()
         metrics.close()
+        for sig, handler in restore_handlers.items():
+            try:
+                signal.signal(sig, handler)
+            except (ValueError, TypeError):
+                pass
     save_checkpoint(cfg.model_file, state, ckpt_format)
-    log(f"training done: steps {start_step}->{int(state.step)}, model -> {cfg.model_file}")
+    if stop_requested.is_set():
+        log(
+            f"stopped on signal at step {int(state.step)}, model -> {cfg.model_file} "
+            "(resume with --resume)"
+        )
+    else:
+        log(f"training done: steps {start_step}->{int(state.step)}, model -> {cfg.model_file}")
     return state
 
 

@@ -129,3 +129,49 @@ def test_abort_and_resume(tmp_path):
 
     state = train(cfg, resume=True, log=lambda *_: None)
     assert int(state.step) > step_before
+
+
+def test_sigterm_checkpoints_and_stops(tmp_path):
+    # Preemption drill: SIGTERM mid-training must checkpoint and return
+    # cleanly (the resume path then continues from the saved step).
+    import os
+    import signal
+    import threading
+
+    import numpy as np
+
+    from fast_tffm_tpu.checkpoint import latest_step
+    from fast_tffm_tpu.config import Config
+    from fast_tffm_tpu.train import train
+
+    rng = np.random.default_rng(0)
+    f = tmp_path / "t.libsvm"
+    lines = []
+    for _ in range(512):
+        ids = rng.choice(64, size=4, replace=False)
+        toks = " ".join(f"{i}:1.0" for i in ids)
+        lines.append(f"{rng.integers(0, 2)} {toks}")
+    f.write_text("\n".join(lines) + "\n")
+
+    cfg = Config(
+        model="fm",
+        factor_num=4,
+        vocabulary_size=64,
+        model_file=str(tmp_path / "m.ckpt"),
+        train_files=(str(f),),
+        epoch_num=50,  # far more work than the signal allows
+        batch_size=32,
+        log_every=10**9,
+    ).validate()
+
+    logs = []
+    killer = threading.Timer(1.5, lambda: os.kill(os.getpid(), signal.SIGTERM))
+    killer.start()
+    try:
+        state = train(cfg, log=logs.append)
+    finally:
+        killer.cancel()
+    saved = latest_step(cfg.model_file)
+    assert saved == int(state.step)
+    assert any("stopped on signal" in l for l in logs)
+    assert int(state.step) < 50 * (512 // 32)  # actually stopped early
