@@ -32,6 +32,7 @@
 #include <atomic>
 #include <cctype>
 #include <cerrno>
+#include <charconv>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -76,13 +77,27 @@ const double kPow10[] = {1e0,  1e1,  1e2,  1e3,  1e4,  1e5,  1e6,  1e7,
                          1e8,  1e9,  1e10, 1e11, 1e12, 1e13, 1e14, 1e15,
                          1e16, 1e17, 1e18, 1e19, 1e20, 1e21, 1e22};
 
-// strtod on an unterminated [p, end) span (NUL-terminated copy; heap only
-// for pathological token lengths).  Handles everything the fast path
-// declines: huge exponents, inf/nan, 16+ digit mantissas.
+// Everything the Clinger fast path declines (16+ digit mantissas above
+// 2^53, |exp10| > 22, inf/nan): first std::from_chars — correctly rounded,
+// Eisel-Lemire-class speed, no NUL-copy — then strtod as the semantic
+// backstop for what from_chars doesn't accept (leading '+' is skipped
+// manually since Python float() allows it; overflow/underflow tokens like
+// "1e999"/"1e-999" fall through to strtod, which maps them to ±inf/±0
+// exactly as Python does).
 inline bool slow_double(const char* p, const char* end, double* out) {
-  char stackbuf[64];
   size_t len = static_cast<size_t>(end - p);
   if (len == 0) return false;
+  const char* q = p;
+  if (*q == '+') ++q;  // from_chars rejects an explicit plus; Python doesn't
+  if (q < end) {
+    double v;
+    auto [ptr, ec] = std::from_chars(q, end, v, std::chars_format::general);
+    if (ec == std::errc() && ptr == end) {
+      *out = v;
+      return true;
+    }
+  }
+  char stackbuf[64];
   std::string heapbuf;
   char* tmp;
   if (len < sizeof(stackbuf)) {

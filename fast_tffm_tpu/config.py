@@ -80,6 +80,24 @@ def _split(s: str) -> tuple[str, ...]:
     return tuple(x for x in (t.strip() for t in s.replace(",", " ").split()) if x)
 
 
+def _split_files(s: str) -> tuple[str, ...]:
+    """File list with glob expansion: `train_files = data/part-*.libsvm`.
+
+    Matches expand sorted (stable shard order across workers); a pattern
+    with no match is kept literally so the missing-file error names the
+    user's path, not a silently empty list.
+    """
+    import glob as _glob
+
+    out: list[str] = []
+    for tok in _split(s):
+        if any(c in tok for c in "*?["):
+            out.extend(sorted(_glob.glob(tok)) or [tok])
+        else:
+            out.append(tok)
+    return tuple(out)
+
+
 def load_config(path: str) -> Config:
     """Parse an INI file into a validated Config."""
     # The reference's sample.cfg style annotates values in place
@@ -112,11 +130,11 @@ def load_config(path: str) -> Config:
     cfg.checkpoint_format = get(g, "checkpoint_format", str, cfg.checkpoint_format).lower()
 
     t = "Train"
-    cfg.train_files = get(t, "train_files", _split, cfg.train_files)
+    cfg.train_files = get(t, "train_files", _split_files, cfg.train_files)
     cfg.weight_files = get(
         t, "weight_files", lambda s: tuple(float(x) for x in _split(s)), cfg.weight_files
     )
-    cfg.validation_files = get(t, "validation_files", _split, cfg.validation_files)
+    cfg.validation_files = get(t, "validation_files", _split_files, cfg.validation_files)
     cfg.epoch_num = get(t, "epoch_num", int, cfg.epoch_num)
     cfg.batch_size = get(t, "batch_size", int, cfg.batch_size)
     cfg.max_nnz = get(t, "max_nnz", int, cfg.max_nnz)
@@ -136,7 +154,7 @@ def load_config(path: str) -> Config:
     cfg.metrics_path = get(t, "metrics_path", str, cfg.metrics_path)
 
     p = "Predict"
-    cfg.predict_files = get(p, "predict_files", _split, cfg.predict_files)
+    cfg.predict_files = get(p, "predict_files", _split_files, cfg.predict_files)
     cfg.score_path = get(p, "score_path", str, cfg.score_path)
 
     d = "Distributed"

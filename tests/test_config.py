@@ -119,3 +119,23 @@ def test_shipped_configs_parse():
     for p in paths:
         cfg = load_config(p)
         assert cfg.model in ("fm", "ffm", "deepfm"), p
+
+
+def test_file_globs_expand(tmp_path):
+    for name in ("part-00.libsvm", "part-01.libsvm", "part-02.libsvm"):
+        (tmp_path / name).write_text("1 0:1.0\n")
+    p = tmp_path / "c.cfg"
+    p.write_text(
+        f"[Train]\ntrain_files = {tmp_path}/part-*.libsvm\n"
+        f"validation_files = {tmp_path}/missing-*.libsvm\n"
+    )
+    from fast_tffm_tpu.config import load_config
+
+    cfg = load_config(str(p))
+    assert [f.rsplit("/", 1)[1] for f in cfg.train_files] == [
+        "part-00.libsvm",
+        "part-01.libsvm",
+        "part-02.libsvm",
+    ]
+    # No-match patterns stay literal so downstream errors name the path.
+    assert cfg.validation_files == (f"{tmp_path}/missing-*.libsvm",)

@@ -336,3 +336,30 @@ class TestNativeStream:
                 parse_lines([bad], vocabulary_size=10, hash_feature_id_flag=True)
             with pytest.raises(ValueError):
                 native([bad], vocabulary_size=10, hash_feature_id_flag=True)
+
+
+@pytest.mark.skipif(native is None, reason="C++ parser not built (make -C csrc)")
+def test_number_parsing_fuzz_matches_python():
+    # Differential fuzz across fast (Clinger), from_chars, and strtod paths:
+    # random mantissa lengths 1-25 digits, exponents -320..320, signs,
+    # fractions — bit-identical float32 results vs Python float().
+    rng = np.random.default_rng(7)
+    toks = []
+    for _ in range(600):
+        ndig = int(rng.integers(1, 26))
+        digits = "".join(rng.choice(list("0123456789"), size=ndig))
+        tok = digits
+        if rng.random() < 0.5 and ndig > 1:
+            cut = int(rng.integers(1, ndig))
+            tok = digits[:cut] + "." + digits[cut:]
+        if rng.random() < 0.4:
+            tok += f"e{int(rng.integers(-320, 321))}"
+        if rng.random() < 0.3:
+            tok = ("-" if rng.random() < 0.5 else "+") + tok
+        toks.append(tok)
+    toks += ["inf", "-inf", "Infinity", "1e999", "-1e999", "1e-999",
+             "9007199254740993", "9007199254740992", "0." + "9" * 40]
+    lines = [f"1 {i}:{t}" for i, t in enumerate(toks)]
+    a = parse_lines(lines, vocabulary_size=len(toks))
+    b = native(lines, vocabulary_size=len(toks))
+    np.testing.assert_array_equal(a.vals.view(np.uint32), b.vals.view(np.uint32))
