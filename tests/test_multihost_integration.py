@@ -1,0 +1,115 @@
+"""True multi-process integration: jax.distributed over localhost.
+
+Two OS processes × two virtual CPU devices each form one GLOBAL 4-device
+mesh (the pod story scaled down: same `dist_train` command on every host,
+collectives over the global mesh, orbax sharded checkpointing, lead-host
+-only output files).  This is the test the reference never had — its dist
+mode was only checkable by hand-launching real ps/worker processes
+(SURVEY.md §5).
+
+The row axis spans both processes (row_parallel=2 with 2 local devices per
+process ⇒ each process holds half of every table row-shard pair), so the
+id all_gather + psum_scatter lookup genuinely crosses process boundaries.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent(
+    """
+    import sys
+    pid, nproc, port, tmp = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+    sys.path.insert(0, {repo!r})
+    import jax
+    # The harness/sitecustomize may have pinned another platform via env;
+    # jax.config wins if applied before backend initialization.
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+    jax.distributed.initialize(f"127.0.0.1:{{port}}", num_processes=nproc, process_id=pid)
+    assert jax.device_count() == 2 * nproc, jax.devices()
+
+    from fast_tffm_tpu.config import Config
+    from fast_tffm_tpu.train import dist_train
+
+    cfg = Config(
+        model="fm", factor_num=4, vocabulary_size=128,
+        model_file=f"{{tmp}}/model.orbax", checkpoint_format="orbax",
+        train_files=(f"{{tmp}}/train.libsvm",),
+        validation_files=(f"{{tmp}}/valid.libsvm",),
+        epoch_num=2, batch_size=32, learning_rate=0.1, log_every=5,
+        row_parallel=2,
+    ).validate()
+    state = dist_train(cfg, log=lambda m: print(f"[{{pid}}] {{m}}", flush=True))
+    print(f"[{{pid}}] DONE step={{int(state.step)}}", flush=True)
+    """
+).format(repo=REPO)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _write_data(tmp_path):
+    rng = np.random.default_rng(0)
+    for name, n in [("train", 320), ("valid", 96)]:
+        with open(tmp_path / f"{name}.libsvm", "w") as f:
+            for _ in range(n):
+                ids = rng.choice(128, size=5, replace=False)
+                toks = " ".join(f"{i}:1.0" for i in ids)
+                f.write(f"{rng.integers(0, 2)} {toks}\n")
+
+
+@pytest.mark.slow
+def test_two_process_dist_train_and_cross_mesh_restore(tmp_path):
+    _write_data(tmp_path)
+    port = _free_port()
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    env = {k: v for k, v in os.environ.items() if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(i), "2", str(port), str(tmp_path)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=420)
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {i} failed:\n{out}"
+        assert f"[{i}] DONE step=20" in out, out
+    assert "mesh: {'data': 2, 'row': 2} on 4 devices" in outs[0]
+    assert "validation auc" in outs[0]
+    # Lead process owns the logging; worker 1 stays quiet except its own marker.
+    assert os.path.isdir(tmp_path / "model.orbax")
+
+    # Cross-mesh restore: the 2x2-mesh orbax checkpoint loads onto a plain
+    # single-process state (different padding path) and carries the step.
+    from fast_tffm_tpu.checkpoint import latest_step, restore_checkpoint
+    from fast_tffm_tpu.models import FMModel
+    from fast_tffm_tpu.trainer import init_state
+
+    import jax
+
+    assert latest_step(str(tmp_path / "model.orbax")) == 20
+    model = FMModel(vocabulary_size=128, factor_num=4)
+    like = init_state(model, jax.random.key(0))
+    restored = restore_checkpoint(str(tmp_path / "model.orbax"), like)
+    assert int(restored.step) == 20
+    assert np.isfinite(np.asarray(restored.table)).all()
+    assert not np.array_equal(np.asarray(restored.table), np.asarray(like.table))
