@@ -530,6 +530,7 @@ struct FmReader {
   bool eof = false;
   bool read_error = false;   // fread failed mid-file (NOT clean EOF)
   int64_t shard_index = 0, shard_count = 1;
+  int64_t shard_block = 1;   // lines per shard block (block-cyclic assignment)
   int64_t counter = 0;       // global non-blank line index (spans files)
   // Per-call arena for the selected lines (stable while parsing).
   std::string arena;
@@ -610,11 +611,16 @@ inline bool is_blank(const char* b, const char* e) {
 extern "C" {
 
 // Open a libsvm file for streamed batch reading.  shard_index/shard_count
-// implement round-robin line sharding by GLOBAL non-blank line index;
-// counter_start carries that index across files (data/pipeline.py threads
-// it through a multi-file, multi-epoch schedule).  Returns NULL on failure.
-void* fm_reader_open(const char* path, int64_t shard_index,
-                     int64_t shard_count, int64_t counter_start) {
+// implement block-cyclic line sharding by GLOBAL non-blank line index:
+// line i belongs to shard (i / shard_block) %% shard_count.  shard_block=1
+// is classic round-robin; shard_block=local_batch gives each process the
+// contiguous rows of its own slice of every global batch (the multi-host
+// input split — parallel/train_step.py's batch sharding is contiguous by
+// process).  counter_start carries the index across files (data/pipeline.py
+// threads it through a multi-file, multi-epoch schedule).  NULL on failure.
+void* fm_reader_open2(const char* path, int64_t shard_index,
+                      int64_t shard_count, int64_t shard_block,
+                      int64_t counter_start) {
   FILE* f = fopen(path, "rb");
   if (!f) return nullptr;
   FmReader* r = new FmReader();
@@ -622,8 +628,40 @@ void* fm_reader_open(const char* path, int64_t shard_index,
   r->buf.resize(1 << 22);  // 4 MiB read window
   r->shard_index = shard_index;
   r->shard_count = shard_count < 1 ? 1 : shard_count;
+  r->shard_block = shard_block < 1 ? 1 : shard_block;
   r->counter = counter_start;
   return r;
+}
+
+// Round-robin entry kept for ABI compatibility with older bindings.
+void* fm_reader_open(const char* path, int64_t shard_index,
+                     int64_t shard_count, int64_t counter_start) {
+  return fm_reader_open2(path, shard_index, shard_count, 1, counter_start);
+}
+
+// Count non-blank lines of a file, streaming (no parsing).  Multi-host
+// input sharding needs the GLOBAL line count up front so every process can
+// run the same number of collective steps per epoch.  Returns -1 on open
+// or read failure.
+int64_t fm_count_lines(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  FmReader r;
+  r.f = f;
+  r.buf.resize(1 << 22);
+  int64_t n = 0;
+  const char *b, *e;
+  while (next_line(&r, &b, &e)) {
+    if (!is_blank(b, e)) ++n;
+    if (r.tail_valid) {
+      r.tail.clear();
+      r.tail_valid = false;
+    }
+  }
+  fclose(f);
+  r.f = nullptr;
+  if (r.read_error) return -1;
+  return n;
 }
 
 // Global non-blank line counter after the lines consumed so far.
@@ -655,7 +693,8 @@ int64_t fm_reader_next(void* reader, int64_t want, int64_t width,
   while (static_cast<int64_t>(r->offsets.size()) < want && next_line(r, &b, &e)) {
     bool selected = false;
     if (!is_blank(b, e)) {
-      selected = (r->counter % r->shard_count) == r->shard_index;
+      selected =
+          ((r->counter / r->shard_block) % r->shard_count) == r->shard_index;
       ++r->counter;
     }
     if (selected) {

@@ -118,6 +118,16 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_int64,  # shard_count
         ctypes.c_int64,  # counter_start
     ]
+    lib.fm_reader_open2.restype = ctypes.c_void_p
+    lib.fm_reader_open2.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int64,  # shard_index
+        ctypes.c_int64,  # shard_count
+        ctypes.c_int64,  # shard_block
+        ctypes.c_int64,  # counter_start
+    ]
+    lib.fm_count_lines.restype = ctypes.c_int64
+    lib.fm_count_lines.argtypes = [ctypes.c_char_p]
     lib.fm_reader_counter.restype = ctypes.c_int64
     lib.fm_reader_counter.argtypes = [ctypes.c_void_p]
     lib.fm_reader_close.restype = None
@@ -211,21 +221,29 @@ def native_batch_stream(
     epochs: int = 1,
     shard_index: int = 0,
     shard_count: int = 1,
+    shard_block: int = 1,
     weights=None,
     drop_remainder: bool = False,
+    pad_to_batches: int | None = None,
 ):
     """Stream (ParsedBatch, example_weights) batches entirely through C++.
 
     Same contract as ``pipeline.batch_stream`` (epoch repeats, per-file
-    example weights, round-robin line sharding by global non-blank line
-    index, zero-padded short final batch with weight-0 rows), but the file
-    reading, line splitting, sharding, and parsing all happen inside
+    example weights, block-cyclic line sharding by global non-blank line
+    index, zero-padded short final batch with weight-0 rows, optional
+    pad_to_batches for fixed multi-host step counts), but the file reading,
+    line splitting, sharding, and parsing all happen inside
     ``fm_reader_next`` — the Python side only schedules files and yields
     filled NumPy buffers.  Batches freely span file and epoch boundaries,
     exactly like the Python generator chain.
     """
     if weights is not None and len(weights) != len(files):
         raise ValueError(f"weights has {len(weights)} entries for {len(files)} files")
+    if shard_block > 1 and epochs != 1:
+        raise ValueError(
+            "shard_block > 1 requires epochs == 1 (batch-aligned sharding "
+            "does not survive epoch boundaries); create one stream per epoch"
+        )
     lib = parser._lib
     width = int(max_nnz)
 
@@ -241,12 +259,17 @@ def native_batch_stream(
 
     labels, ids, vals, fields, nnz, w = alloc()
     filled = 0
+    emitted = 0
     counter = 0  # global non-blank line index, threaded through every file
     for _ in range(max(0, epochs)):
         for fi, path in enumerate(files):
             fw = 1.0 if weights is None else float(weights[fi])
-            handle = lib.fm_reader_open(
-                os.fspath(path).encode(), shard_index, shard_count, counter
+            handle = lib.fm_reader_open2(
+                os.fspath(path).encode(),
+                shard_index,
+                shard_count,
+                max(1, shard_block),
+                counter,
             )
             if not handle:
                 raise FileNotFoundError(path)
@@ -283,17 +306,44 @@ def native_batch_stream(
                     filled += int(got)
                     if filled == batch_size:
                         yield ParsedBatch(labels, ids, vals, fields, nnz), w
+                        emitted += 1
                         labels, ids, vals, fields, nnz, w = alloc()
                         filled = 0
+                        if pad_to_batches is not None and emitted >= pad_to_batches:
+                            return
                         continue
                     break  # got < want: file exhausted
             finally:
                 counter = int(lib.fm_reader_counter(handle))
                 lib.fm_reader_close(handle)
-    if filled and not drop_remainder:
+    if filled and not drop_remainder and (pad_to_batches is None or emitted < pad_to_batches):
         # Rows beyond `filled` are already zero (fresh buffers) and carry
         # weight 0 — identical to pipeline.pad_batch on the Python path.
         yield ParsedBatch(labels, ids, vals, fields, nnz), w
+        emitted += 1
+        filled = 0
+    if pad_to_batches is not None:
+        while emitted < pad_to_batches:
+            labels, ids, vals, fields, nnz, w = alloc()  # all-zero, weight-0
+            yield ParsedBatch(labels, ids, vals, fields, nnz), w
+            emitted += 1
+
+
+def count_lines(files) -> int:
+    """Total non-blank lines across ``files`` (C++ streaming count when the
+    native library is built, buffered Python otherwise)."""
+    native = load_native_parser()
+    total = 0
+    for path in files:
+        if native is not None:
+            n = int(native._lib.fm_count_lines(os.fspath(path).encode()))
+            if n < 0:
+                raise OSError(f"cannot read {path}")
+            total += n
+        else:
+            with open(path, "r") as f:
+                total += sum(1 for line in f if line.strip())
+    return total
 
 
 def _stale() -> bool:

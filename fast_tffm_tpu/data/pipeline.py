@@ -31,20 +31,34 @@ def line_stream(
     epochs: int = 1,
     shard_index: int = 0,
     shard_count: int = 1,
+    shard_block: int = 1,
     weights: Sequence[float] | None = None,
 ) -> Iterator[tuple[str, float]]:
     """Yield (line, example_weight) over ``files`` for ``epochs`` passes.
 
     ``weights`` gives a per-file example weight (reference: optional per-file
     weight list aligned with the train file list); default 1.0.  Sharding is
-    round-robin by line index across the whole file list so workers get
-    near-equal, disjoint slices without coordination.
+    block-cyclic by global line index (line i → shard (i // shard_block) %
+    shard_count): block 1 is classic round-robin; block = local batch size
+    hands each multi-host process the contiguous rows of its slice of every
+    global batch.  Workers get near-equal, disjoint slices either way.
+
+    ``shard_block > 1`` requires ``epochs == 1``: the counter runs across
+    epoch repeats, so a second pass would start mid-block and the shard →
+    global-batch-row alignment the block size exists for would silently
+    break.  Multi-host callers make one stream per epoch (see dist_train).
     """
     if weights is not None and len(weights) != len(files):
         raise ValueError(
             f"weights has {len(weights)} entries for {len(files)} files"
         )
+    if shard_block > 1 and epochs != 1:
+        raise ValueError(
+            "shard_block > 1 requires epochs == 1 (batch-aligned sharding "
+            "does not survive epoch boundaries); create one stream per epoch"
+        )
     counter = itertools.count()
+    block = max(1, shard_block)
     for _ in range(epochs):
         for fi, path in enumerate(files):
             w = 1.0 if weights is None else float(weights[fi])
@@ -53,7 +67,7 @@ def line_stream(
                     line = line.strip()
                     if not line:
                         continue
-                    if next(counter) % shard_count == shard_index:
+                    if (next(counter) // block) % shard_count == shard_index:
                         yield line, w
 
 
@@ -67,8 +81,10 @@ def batch_stream(
     epochs: int = 1,
     shard_index: int = 0,
     shard_count: int = 1,
+    shard_block: int = 1,
     weights: Sequence[float] | None = None,
     drop_remainder: bool = False,
+    pad_to_batches: int | None = None,
     parser=None,
 ) -> Iterator[tuple[ParsedBatch, np.ndarray]]:
     """Yield (ParsedBatch, example_weights[batch]) with static shapes.
@@ -80,11 +96,23 @@ def batch_stream(
     for a single XLA compilation.  If None, each batch is as wide as its
     widest row (fine for eval, recompiles on width change under jit).
 
+    ``pad_to_batches`` forces EXACTLY that many batches, appending all-empty
+    (weight-0) batches after the data runs out.  Multi-host input sharding
+    needs it: every process must run the same number of collective steps
+    per epoch even when its shard is a batch short.  Requires ``max_nnz``
+    so the pad batches match the data batches' static width.
+
     ``parser`` overrides the line parser (signature of
     ``libsvm.parse_lines``); data/native.py passes the C++ implementation.
     """
     from fast_tffm_tpu.data.libsvm import parse_lines
     from fast_tffm_tpu.data.native import NativeParser, native_batch_stream
+
+    if pad_to_batches is not None and max_nnz is None:
+        raise ValueError(
+            "pad_to_batches requires max_nnz (pad batches must share the "
+            "data batches' static feature width)"
+        )
 
     if isinstance(parser, NativeParser) and max_nnz is not None:
         # Full-native path: file reads, sharding, and parsing all in C++
@@ -99,8 +127,10 @@ def batch_stream(
             epochs=epochs,
             shard_index=shard_index,
             shard_count=shard_count,
+            shard_block=shard_block,
             weights=weights,
             drop_remainder=drop_remainder,
+            pad_to_batches=pad_to_batches,
         )
         return
 
@@ -110,14 +140,16 @@ def batch_stream(
         epochs=epochs,
         shard_index=shard_index,
         shard_count=shard_count,
+        shard_block=shard_block,
         weights=weights,
     )
+    emitted = 0
     while True:
         chunk = list(itertools.islice(stream, batch_size))
         if not chunk:
-            return
+            break
         if len(chunk) < batch_size and drop_remainder:
-            return
+            break
         lines = [c[0] for c in chunk]
         w = np.asarray([c[1] for c in chunk], np.float32)
         batch = parse(
@@ -130,3 +162,18 @@ def batch_stream(
             batch = pad_batch(batch, batch_size)
             w = np.concatenate([w, np.zeros((batch_size - len(chunk),), np.float32)])
         yield batch, w
+        emitted += 1
+        if pad_to_batches is not None and emitted >= pad_to_batches:
+            return
+    if pad_to_batches is not None:
+        width = max_nnz
+        while emitted < pad_to_batches:
+            empty = ParsedBatch(
+                labels=np.zeros((batch_size,), np.float32),
+                ids=np.zeros((batch_size, width), np.int64),
+                vals=np.zeros((batch_size, width), np.float32),
+                fields=np.zeros((batch_size, width), np.int32),
+                nnz=np.zeros((batch_size,), np.int32),
+            )
+            yield empty, np.zeros((batch_size,), np.float32)
+            emitted += 1

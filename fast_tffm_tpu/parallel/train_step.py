@@ -26,7 +26,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from fast_tffm_tpu.models.base import Batch
@@ -41,7 +41,38 @@ from fast_tffm_tpu.parallel.mesh import (
 )
 from fast_tffm_tpu.trainer import TrainState, init_state
 
-__all__ = ["init_sharded_state", "make_sharded_train_step", "make_sharded_predict_step"]
+__all__ = [
+    "init_sharded_state",
+    "make_sharded_train_step",
+    "make_sharded_predict_step",
+    "make_global_batch",
+]
+
+
+def make_global_batch(mesh: Mesh, parsed, w) -> Batch:
+    """Assemble a GLOBAL batch from this process's local input shard.
+
+    Multi-host input sharding: each process parses only rows
+    [p·B_local, (p+1)·B_local) of every global batch (pipeline
+    ``shard_block`` = B_local), then this stitches the per-process chunks
+    into one global jax.Array per field — each process contributes exactly
+    its addressable devices' slice, no cross-host data movement.  Works
+    because make_mesh lays devices process-contiguously in (data, row)
+    row-major order, so a process's slice of the leading batch dim is
+    contiguous.
+    """
+    import numpy as np
+
+    vec = NamedSharding(mesh, P(_BOTH))
+    mat = NamedSharding(mesh, P(_BOTH, None))
+    mk = jax.make_array_from_process_local_data
+    return Batch(
+        labels=mk(vec, np.ascontiguousarray(parsed.labels)),
+        ids=mk(mat, np.ascontiguousarray(parsed.ids.astype(np.int32))),
+        vals=mk(mat, np.ascontiguousarray(parsed.vals)),
+        fields=mk(mat, np.ascontiguousarray(parsed.fields)),
+        weights=mk(vec, np.ascontiguousarray(w)),
+    )
 
 
 def _state_specs():

@@ -50,17 +50,18 @@ def scan_max_nnz(cfg: Config) -> int:
     return widest
 
 
-def _stream(cfg: Config, files, max_nnz, epochs):
+def _stream(cfg: Config, files, max_nnz, epochs, batch_size=None, **shard_kw):
     return prefetch(
         batch_stream(
             files,
-            batch_size=cfg.batch_size,
+            batch_size=batch_size if batch_size is not None else cfg.batch_size,
             vocabulary_size=cfg.vocabulary_size,
             hash_feature_id=cfg.hash_feature_id,
             max_nnz=max_nnz,
             epochs=epochs,
             weights=cfg.weight_files if cfg.weight_files else None,
             parser=best_parser(cfg.thread_num),
+            **shard_kw,
         ),
         depth=cfg.queue_size,
     )
@@ -78,7 +79,25 @@ def _evaluate(cfg: Config, predict_step, state, files, max_nnz) -> float:
     return auc(np.concatenate(labels), np.concatenate(scores), np.concatenate(weights))
 
 
-def _run_training(cfg: Config, state, step_fn, predict_step, max_nnz, log=print):
+def _run_training(
+    cfg: Config,
+    state,
+    step_fn,
+    predict_step,
+    max_nnz,
+    log=print,
+    train_stream=None,
+    to_batch=None,
+    examples_per_step=None,
+):
+    """Shared step loop.  ``train_stream(epoch)`` overrides the per-epoch
+    input stream and ``to_batch(parsed, w)`` the host→device batch assembly
+    — the multi-host path plugs in sharded input + global-array stitching
+    here without forking the loop."""
+    if train_stream is None:
+        train_stream = lambda epoch: _stream(cfg, cfg.train_files, max_nnz, epochs=1)
+    if to_batch is None:
+        to_batch = Batch.from_parsed
     n_chips = jax.device_count()
     meter = Throughput()
     losses = []
@@ -123,8 +142,8 @@ def _run_training(cfg: Config, state, step_fn, predict_step, max_nnz, log=print)
         for epoch in range(cfg.epoch_num):
             if stop_requested.is_set():
                 break
-            for parsed, w in _stream(cfg, cfg.train_files, max_nnz, epochs=1):
-                b = Batch.from_parsed(parsed, w)
+            for parsed, w in train_stream(epoch):
+                b = to_batch(parsed, w)
                 tracer.on_step()
                 with step_trace("train", step_num):
                     state, loss = step_fn(state, b)
@@ -135,7 +154,7 @@ def _run_training(cfg: Config, state, step_fn, predict_step, max_nnz, log=print)
                     jax.block_until_ready(loss)
                     meter.reset()
                 losses.append(loss)  # device value; only sync at log points
-                meter.add(parsed.batch_size)
+                meter.add(examples_per_step or parsed.batch_size)
                 if stop_requested.is_set():
                     break
                 if len(losses) >= cfg.log_every:
@@ -203,9 +222,18 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
 
     One SPMD program over all visible chips; no job_name/task_index because
     there is no ps/worker split to schedule — the mesh IS the cluster.
+
+    Multi-host pods additionally shard the INPUT: process p parses only
+    rows [p·B/P, (p+1)·B/P) of each global batch (block-cyclic line
+    sharding), and the per-process chunks are stitched into global arrays —
+    host parse throughput scales with the host count, the way the
+    reference spread input files across its workers.  The global non-blank
+    line count is taken up front so every process runs the same number of
+    collective steps per epoch (short shards pad with weight-0 batches).
     """
     from fast_tffm_tpu.parallel import (
         init_sharded_state,
+        make_global_batch,
         make_mesh,
         make_sharded_predict_step,
         make_sharded_train_step,
@@ -228,4 +256,52 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
         log(f"resumed from {cfg.model_file} at step {int(state.step)}")
     step_fn = make_sharded_train_step(model, cfg.learning_rate, mesh)
     predict_step = make_sharded_predict_step(model, mesh)
-    return _run_training(cfg, state, step_fn, predict_step, max_nnz, log)
+
+    train_stream = to_batch = examples_per_step = None
+    nproc = jax.process_count()
+    if nproc > 1:
+        from fast_tffm_tpu.data.native import count_lines
+
+        if cfg.batch_size % nproc:
+            raise ValueError(
+                f"batch_size {cfg.batch_size} not divisible by "
+                f"{nproc} processes (it is the GLOBAL batch)"
+            )
+        local_bs = cfg.batch_size // nproc
+        total = count_lines(cfg.train_files)
+        steps_per_epoch = -(-total // cfg.batch_size)  # ceil
+        pid = jax.process_index()
+        log(
+            f"input sharding: {total} rows over {nproc} processes, "
+            f"{steps_per_epoch} steps/epoch, {local_bs} rows/process/step"
+        )
+
+        def train_stream(epoch):
+            return _stream(
+                cfg,
+                cfg.train_files,
+                max_nnz,
+                epochs=1,
+                batch_size=local_bs,
+                shard_index=pid,
+                shard_count=nproc,
+                shard_block=local_bs,
+                pad_to_batches=steps_per_epoch,
+            )
+
+        def to_batch(parsed, w):
+            return make_global_batch(mesh, parsed, w)
+
+        examples_per_step = cfg.batch_size
+
+    return _run_training(
+        cfg,
+        state,
+        step_fn,
+        predict_step,
+        max_nnz,
+        log,
+        train_stream=train_stream,
+        to_batch=to_batch,
+        examples_per_step=examples_per_step,
+    )

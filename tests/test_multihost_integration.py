@@ -7,9 +7,13 @@ collectives over the global mesh, orbax sharded checkpointing, lead-host
 mode was only checkable by hand-launching real ps/worker processes
 (SURVEY.md §5).
 
-The row axis spans both processes (row_parallel=2 with 2 local devices per
-process ⇒ each process holds half of every table row-shard pair), so the
-id all_gather + psum_scatter lookup genuinely crosses process boundaries.
+Also under test: multi-host INPUT sharding.  With >1 process, dist_train
+block-cyclically shards the line stream so process p parses only rows
+[p·B/P, (p+1)·B/P) of each global batch and stitches them into global
+arrays (`make_global_batch`).  The dataset size is chosen to leave a
+partial tail batch, exercising the fixed steps-per-epoch padding.  The
+final equivalence check trains the SAME data single-process and compares
+tables — sharded input must not change the math.
 """
 
 import os
@@ -22,6 +26,8 @@ import numpy as np
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N_ROWS = 336  # 10.5 global batches of 32: exercises the padded tail step
 
 WORKER = textwrap.dedent(
     """
@@ -61,7 +67,7 @@ def _free_port() -> int:
 
 def _write_data(tmp_path):
     rng = np.random.default_rng(0)
-    for name, n in [("train", 320), ("valid", 96)]:
+    for name, n in [("train", N_ROWS), ("valid", 96)]:
         with open(tmp_path / f"{name}.libsvm", "w") as f:
             for _ in range(n):
                 ids = rng.choice(128, size=5, replace=False)
@@ -90,12 +96,13 @@ def test_two_process_dist_train_and_cross_mesh_restore(tmp_path):
     for p in procs:
         out, _ = p.communicate(timeout=420)
         outs.append(out)
+    steps_per_epoch = -(-N_ROWS // 32)
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {i} failed:\n{out}"
-        assert f"[{i}] DONE step=20" in out, out
+        assert f"[{i}] DONE step={2 * steps_per_epoch}" in out, out
     assert "mesh: {'data': 2, 'row': 2} on 4 devices" in outs[0]
+    assert f"input sharding: {N_ROWS} rows over 2 processes" in outs[0]
     assert "validation auc" in outs[0]
-    # Lead process owns the logging; worker 1 stays quiet except its own marker.
     assert os.path.isdir(tmp_path / "model.orbax")
 
     # Cross-mesh restore: the 2x2-mesh orbax checkpoint loads onto a plain
@@ -106,10 +113,35 @@ def test_two_process_dist_train_and_cross_mesh_restore(tmp_path):
 
     import jax
 
-    assert latest_step(str(tmp_path / "model.orbax")) == 20
+    assert latest_step(str(tmp_path / "model.orbax")) == 2 * steps_per_epoch
     model = FMModel(vocabulary_size=128, factor_num=4)
     like = init_state(model, jax.random.key(0))
     restored = restore_checkpoint(str(tmp_path / "model.orbax"), like)
-    assert int(restored.step) == 20
+    assert int(restored.step) == 2 * steps_per_epoch
     assert np.isfinite(np.asarray(restored.table)).all()
-    assert not np.array_equal(np.asarray(restored.table), np.asarray(like.table))
+
+    # Input-sharding equivalence: single-process training over the same
+    # data must land on (numerically) the same table — sharded input and
+    # cross-host collectives change reduction order, not the math.
+    from fast_tffm_tpu.config import Config
+    from fast_tffm_tpu.train import train
+
+    cfg = Config(
+        model="fm",
+        factor_num=4,
+        vocabulary_size=128,
+        model_file=str(tmp_path / "single.ckpt"),
+        train_files=(str(tmp_path / "train.libsvm"),),
+        epoch_num=2,
+        batch_size=32,
+        learning_rate=0.1,
+        log_every=10**9,
+    ).validate()
+    single = train(cfg, log=lambda *_: None)
+    assert int(single.step) == 2 * steps_per_epoch
+    np.testing.assert_allclose(
+        np.asarray(restored.table),
+        np.asarray(single.table),
+        rtol=2e-4,
+        atol=2e-6,
+    )
