@@ -38,7 +38,7 @@ def main():
     batch_size = 16384
     nnz = 39  # Criteo field count
     vocab = 1 << 20
-    warmup, iters = 5, 30
+    iters = 30
 
     model = FMModel(vocabulary_size=vocab, factor_num=8, order=2)
     state = init_state(model, jax.random.key(0))
@@ -47,18 +47,30 @@ def main():
     rng = np.random.default_rng(0)
     batches = [make_batch(rng, batch_size, nnz, vocab) for _ in range(8)]
 
-    for i in range(warmup):
+    # Warm until steady state (>= 2s past compile): a fresh process pays
+    # device/tunnel spin-up for its first dispatches, and a fixed 5-step
+    # warmup was observed under-reporting a cold run by ~2.5x.
+    state, loss = step(state, batches[0])
+    jax.block_until_ready(loss)  # compile finishes before the clock starts
+    deadline = time.perf_counter() + 2.0
+    i = 1
+    while time.perf_counter() < deadline:
         state, loss = step(state, batches[i % len(batches)])
+        i += 1
     jax.block_until_ready(loss)
 
-    t0 = time.perf_counter()
-    for i in range(iters):
-        state, loss = step(state, batches[i % len(batches)])
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
+    # Best of 3 measurement windows (min is the noise-robust choice for a
+    # single-line report: slowdowns are contamination, never speedups).
+    best_dt = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for i in range(iters):
+            state, loss = step(state, batches[i % len(batches)])
+        jax.block_until_ready(loss)
+        best_dt = min(best_dt, time.perf_counter() - t0)
 
     n_chips = jax.device_count()
-    value = batch_size * iters / dt / n_chips
+    value = batch_size * iters / best_dt / n_chips
     print(
         json.dumps(
             {

@@ -23,30 +23,66 @@ from fast_tffm_tpu.utils.prefetch import prefetch
 __all__ = ["predict", "dist_predict"]
 
 
-def _run_predict(cfg: Config, state, predict_step, max_nnz, log=print) -> str:
+def _run_predict(cfg: Config, state, predict_step, max_nnz, log=print, mesh=None) -> str:
     if not cfg.predict_files:
         raise ValueError("no predict_files configured")
     # Multi-host: the sharded predict step is ONE SPMD program over the
-    # global mesh, so every process must feed identical batches (the mesh
-    # shards them internally over all chips — that IS the work split, the
-    # reference's dist_predict file sharding done at chip granularity);
-    # replicated scores come back on every process, process 0 writes them.
+    # global mesh; replicated scores come back on every process and process
+    # 0 writes them.  When the batch size divides evenly, the INPUT is also
+    # sharded — process p parses only rows [p·B/P, (p+1)·B/P) of each
+    # global batch (the reference's dist_predict spread input files across
+    # workers; here parse throughput scales with the host count the same
+    # way).  Otherwise every process parses identical full batches and the
+    # mesh still shards the compute at chip granularity.
+    nproc = jax.process_count()
     is_lead = jax.process_index() == 0
+    shard_input = mesh is not None and nproc > 1 and cfg.batch_size % nproc == 0
+    stream_kw = {}
+    to_batch = Batch.from_parsed
+    remaining = None
+    bs = cfg.batch_size  # per-process stream batch size
+    if shard_input:
+        from fast_tffm_tpu.data.native import count_lines
+        from fast_tffm_tpu.parallel import make_global_batch
+
+        total = count_lines(cfg.predict_files)
+        bs = cfg.batch_size // nproc
+        # The stream's batch size MUST equal shard_block: block-cyclic line
+        # selection is aligned to global batch slots only at that size.
+        stream_kw = dict(
+            shard_index=jax.process_index(),
+            shard_count=nproc,
+            shard_block=bs,
+            pad_to_batches=-(-total // cfg.batch_size),  # ceil
+        )
+        to_batch = lambda parsed, w: make_global_batch(mesh, parsed, w)
+        # Padding (short final batch + all-empty tail batches) sits strictly
+        # after the data rows, so the real scores are exactly the first
+        # `total` of the concatenated stream — no global weight mask needed.
+        remaining = total
+        if is_lead:
+            log(f"predict input sharding: {total} rows over {nproc} processes")
     n = 0
     out = open(cfg.score_path, "w") if is_lead else None
     try:
         stream = batch_stream(
             cfg.predict_files,
-            batch_size=cfg.batch_size,
+            batch_size=bs,
             vocabulary_size=cfg.vocabulary_size,
             hash_feature_id=cfg.hash_feature_id,
             max_nnz=max_nnz,
             parser=best_parser(cfg.thread_num),
+            **stream_kw,
         )
         for parsed, w in prefetch(stream, depth=cfg.queue_size):
-            b = Batch.from_parsed(parsed, w)
+            b = to_batch(parsed, w)
             scores = np.asarray(predict_step(state, b))
-            real = w > 0  # drop batch-size padding rows
+            if remaining is not None:
+                take = min(remaining, len(scores))
+                remaining -= take
+                real = np.arange(len(scores)) < take
+            else:
+                real = w > 0  # drop batch-size padding rows
             if out is not None:
                 for s in scores[real]:
                     out.write(f"{s:.6f}\n")
@@ -86,4 +122,6 @@ def dist_predict(cfg: Config, log=print, mesh=None) -> str:
         mesh = make_mesh(data, row)
     state = init_sharded_state(model, mesh, jax.random.key(0), cfg.init_accumulator_value)
     state = restore_checkpoint(cfg.model_file, state)
-    return _run_predict(cfg, state, make_sharded_predict_step(model, mesh), max_nnz, log)
+    return _run_predict(
+        cfg, state, make_sharded_predict_step(model, mesh), max_nnz, log, mesh=mesh
+    )

@@ -213,6 +213,13 @@ class TestNativeStream:
                 "shard_count": 2,
                 "weights": [1.5, 3.0],
             },
+            {"shard_index": 1, "shard_count": 2, "shard_block": 32},
+            {
+                "shard_index": 0,
+                "shard_count": 2,
+                "shard_block": 64,
+                "pad_to_batches": 9,
+            },
         ],
     )
     def test_matches_python_stream(self, tmp_path, kw):
@@ -363,3 +370,95 @@ def test_number_parsing_fuzz_matches_python():
     a = parse_lines(lines, vocabulary_size=len(toks))
     b = native(lines, vocabulary_size=len(toks))
     np.testing.assert_array_equal(a.vals.view(np.uint32), b.vals.view(np.uint32))
+
+
+def _write_lines(path, rows, rng, vocab=1000):
+    with open(path, "w") as f:
+        for _ in range(rows):
+            m = int(rng.integers(1, 6))
+            feats = " ".join(f"{rng.integers(0, vocab)}:{rng.random():.4f}" for _ in range(m))
+            f.write(f"{rng.integers(0, 2)} {feats}\n")
+
+
+def test_count_lines_native_matches_python(tmp_path):
+    from fast_tffm_tpu.data import native as native_mod
+
+    rng = np.random.default_rng(11)
+    paths = []
+    for name, n in [("a.libsvm", 257), ("b.libsvm", 100)]:
+        p = tmp_path / name
+        _write_lines(p, n, rng)
+        paths.append(str(p))
+    with open(paths[1], "a") as f:
+        f.write("\n  \n0 3:1.0")  # blank lines + unterminated final line
+    assert native_mod.count_lines(paths) == 257 + 101
+    # The Python fallback (native lib absent) must agree.
+    orig = native_mod.load_native_parser
+    native_mod.load_native_parser = lambda: None
+    try:
+        assert native_mod.count_lines(paths) == 257 + 101
+    finally:
+        native_mod.load_native_parser = orig
+
+
+def test_shard_block_reassembles_global_batches(tmp_path):
+    """The multi-host alignment invariant: with shard_block = B/P, stacking
+    each process's local batch g recovers EXACTLY global batch g of the
+    unsharded stream — this is what make_global_batch relies on."""
+    path = tmp_path / "d.libsvm"
+    _write_lines(path, 200, np.random.default_rng(5))  # 200 = 6.25 batches of 32
+    kw = dict(vocabulary_size=1000, max_nnz=8)
+    whole = list(batch_stream([str(path)], batch_size=32, **kw))
+    nproc, local = 2, 16
+    shards = [
+        list(
+            batch_stream(
+                [str(path)],
+                batch_size=local,
+                shard_index=p,
+                shard_count=nproc,
+                shard_block=local,
+                pad_to_batches=len(whole),
+                **kw,
+            )
+        )
+        for p in range(nproc)
+    ]
+    assert all(len(s) == len(whole) for s in shards)
+    for g, (gb, gw) in enumerate(whole):
+        for f in ("labels", "ids", "vals", "fields", "nnz"):
+            stacked = np.concatenate([getattr(shards[p][g][0], f) for p in range(nproc)])
+            np.testing.assert_array_equal(stacked, getattr(gb, f))
+        np.testing.assert_array_equal(
+            np.concatenate([shards[p][g][1] for p in range(nproc)]), gw
+        )
+
+
+def test_shard_block_multi_epoch_rejected(tmp_path):
+    path = tmp_path / "d.libsvm"
+    _write_lines(path, 10, np.random.default_rng(0))
+    for parser in [None] + ([native] if native else []):
+        with pytest.raises(ValueError, match="epochs == 1"):
+            next(
+                batch_stream(
+                    [str(path)],
+                    batch_size=4,
+                    vocabulary_size=1000,
+                    max_nnz=8,
+                    epochs=2,
+                    shard_count=2,
+                    shard_block=4,
+                    parser=parser,
+                )
+            )
+
+
+def test_pad_to_batches_requires_max_nnz(tmp_path):
+    path = tmp_path / "d.libsvm"
+    _write_lines(path, 10, np.random.default_rng(0))
+    with pytest.raises(ValueError, match="max_nnz"):
+        next(
+            batch_stream(
+                [str(path)], batch_size=4, vocabulary_size=1000, pad_to_batches=5
+            )
+        )

@@ -55,6 +55,18 @@ WORKER = textwrap.dedent(
     ).validate()
     state = dist_train(cfg, log=lambda m: print(f"[{{pid}}] {{m}}", flush=True))
     print(f"[{{pid}}] DONE step={{int(state.step)}}", flush=True)
+
+    # Same processes, predict side: sharded-input dist_predict on the
+    # checkpoint just written (valid.libsvm's 96 rows = 3 global batches).
+    import dataclasses
+    from fast_tffm_tpu.predict import dist_predict
+    pcfg = dataclasses.replace(
+        cfg,
+        predict_files=(f"{{tmp}}/valid.libsvm",),
+        score_path=f"{{tmp}}/scores_dist.txt",
+    )
+    dist_predict(pcfg, log=lambda m: print(f"[{{pid}}] {{m}}", flush=True))
+    print(f"[{{pid}}] PREDICT DONE", flush=True)
     """
 ).format(repo=REPO)
 
@@ -145,3 +157,26 @@ def test_two_process_dist_train_and_cross_mesh_restore(tmp_path):
         rtol=2e-4,
         atol=2e-6,
     )
+
+    # Sharded-input dist_predict: the two-process run wrote one score per
+    # valid.libsvm row; single-process prediction from the same checkpoint
+    # must agree (1-ulp prints allowed — different meshes reduce in a
+    # different order).
+    assert "predict input sharding: 96 rows over 2 processes" in outs[0]
+    assert "[0] PREDICT DONE" in outs[0] and "[1] PREDICT DONE" in outs[1]
+    import dataclasses
+
+    from fast_tffm_tpu.predict import predict
+
+    pcfg = dataclasses.replace(
+        cfg,
+        model_file=str(tmp_path / "model.orbax"),
+        checkpoint_format="orbax",
+        predict_files=(str(tmp_path / "valid.libsvm"),),
+        score_path=str(tmp_path / "scores_single.txt"),
+    )
+    predict(pcfg, log=lambda *_: None)
+    dist = np.loadtxt(tmp_path / "scores_dist.txt")
+    one = np.loadtxt(tmp_path / "scores_single.txt")
+    assert dist.shape == one.shape == (96,)
+    np.testing.assert_allclose(dist, one, atol=5e-5)
