@@ -50,7 +50,14 @@ def scan_max_nnz(cfg: Config) -> int:
     return widest
 
 
-def _stream(cfg: Config, files, max_nnz, epochs, batch_size=None, **shard_kw):
+_TRAIN_WEIGHTS = object()  # sentinel: apply cfg.weight_files (train files only)
+
+
+def _stream(
+    cfg: Config, files, max_nnz, epochs, batch_size=None, weights=_TRAIN_WEIGHTS, **shard_kw
+):
+    if weights is _TRAIN_WEIGHTS:
+        weights = cfg.weight_files if cfg.weight_files else None
     return prefetch(
         batch_stream(
             files,
@@ -59,7 +66,7 @@ def _stream(cfg: Config, files, max_nnz, epochs, batch_size=None, **shard_kw):
             hash_feature_id=cfg.hash_feature_id,
             max_nnz=max_nnz,
             epochs=epochs,
-            weights=cfg.weight_files if cfg.weight_files else None,
+            weights=weights,
             parser=best_parser(cfg.thread_num),
             **shard_kw,
         ),
@@ -67,13 +74,28 @@ def _stream(cfg: Config, files, max_nnz, epochs, batch_size=None, **shard_kw):
     )
 
 
-def _evaluate(cfg: Config, predict_step, state, files, max_nnz) -> float:
+def _evaluate(
+    cfg: Config, predict_step, state, files, max_nnz, stream=None, to_batch=None, fetch=None
+) -> float:
+    """AUC over ``files``.  ``stream``/``to_batch``/``fetch`` parameterize the
+    multi-host sharded path (sharded input, global-array stitching, device
+    all-gather of the label/weight vectors); defaults are the local path.
+
+    weight_files aligns with TRAIN files; validation examples weigh 1.0
+    (only batch-padding rows carry 0, and ``auc`` drops them)."""
+    if stream is None:
+        stream = _stream(cfg, files, max_nnz, epochs=1, weights=None)
+    if to_batch is None:
+        to_batch = Batch.from_parsed
+    if fetch is None:
+        fetch = lambda b, parsed, w: (parsed.labels, w)
     scores, labels, weights = [], [], []
-    for parsed, w in _stream(cfg, files, max_nnz, epochs=1):
-        b = Batch.from_parsed(parsed, w)
+    for parsed, w in stream:
+        b = to_batch(parsed, w)
         scores.append(np.asarray(predict_step(state, b)))
-        labels.append(parsed.labels)
-        weights.append(w)
+        lab, ww = fetch(b, parsed, w)
+        labels.append(lab)
+        weights.append(ww)
     if not scores:
         return float("nan")
     return auc(np.concatenate(labels), np.concatenate(scores), np.concatenate(weights))
@@ -89,15 +111,18 @@ def _run_training(
     train_stream=None,
     to_batch=None,
     examples_per_step=None,
+    evaluate=None,
 ):
     """Shared step loop.  ``train_stream(epoch)`` overrides the per-epoch
-    input stream and ``to_batch(parsed, w)`` the host→device batch assembly
-    — the multi-host path plugs in sharded input + global-array stitching
-    here without forking the loop."""
+    input stream, ``to_batch(parsed, w)`` the host→device batch assembly,
+    and ``evaluate`` the validation pass — the multi-host path plugs in
+    sharded input + global-array stitching here without forking the loop."""
     if train_stream is None:
         train_stream = lambda epoch: _stream(cfg, cfg.train_files, max_nnz, epochs=1)
     if to_batch is None:
         to_batch = Batch.from_parsed
+    if evaluate is None:
+        evaluate = _evaluate
     n_chips = jax.device_count()
     meter = Throughput()
     losses = []
@@ -177,7 +202,7 @@ def _run_training(
             if stop_requested.is_set():
                 break
             if cfg.validation_files:
-                val_auc = _evaluate(cfg, predict_step, state, cfg.validation_files, max_nnz)
+                val_auc = evaluate(cfg, predict_step, state, cfg.validation_files, max_nnz)
                 log(f"epoch {epoch} validation auc {val_auc:.5f}")
                 metrics.log(step=int(state.step), epoch=epoch, validation_auc=round(val_auc, 6))
             if cfg.save_every_epochs and (epoch + 1) % cfg.save_every_epochs == 0:
@@ -257,7 +282,7 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
     step_fn = make_sharded_train_step(model, cfg.learning_rate, mesh)
     predict_step = make_sharded_predict_step(model, mesh)
 
-    train_stream = to_batch = examples_per_step = None
+    train_stream = to_batch = examples_per_step = evaluate = None
     nproc = jax.process_count()
     if nproc > 1:
         from fast_tffm_tpu.data.native import count_lines
@@ -294,6 +319,47 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
 
         examples_per_step = cfg.batch_size
 
+        # Validation is sharded the same way.  Scores come back replicated
+        # from the sharded predict step; the (tiny, [B]) label/weight
+        # vectors are resharded to replicated on device so every process
+        # can compute the GLOBAL AUC (weight-0 padding rows drop out).
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        replicate = jax.jit(
+            lambda x: x, out_shardings=NamedSharding(mesh, PartitionSpec())
+        )
+        val_steps = (
+            -(-count_lines(cfg.validation_files) // cfg.batch_size)
+            if cfg.validation_files
+            else 0
+        )
+
+        def evaluate(cfg, predict_step, state, files, max_nnz):
+            return _evaluate(
+                cfg,
+                predict_step,
+                state,
+                files,
+                max_nnz,
+                stream=_stream(
+                    cfg,
+                    files,
+                    max_nnz,
+                    epochs=1,
+                    weights=None,
+                    batch_size=local_bs,
+                    shard_index=pid,
+                    shard_count=nproc,
+                    shard_block=local_bs,
+                    pad_to_batches=val_steps,
+                ),
+                to_batch=to_batch,
+                fetch=lambda b, parsed, w: (
+                    np.asarray(replicate(b.labels)),
+                    np.asarray(replicate(b.weights)),
+                ),
+            )
+
     return _run_training(
         cfg,
         state,
@@ -304,4 +370,5 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
         train_stream=train_stream,
         to_batch=to_batch,
         examples_per_step=examples_per_step,
+        evaluate=evaluate,
     )

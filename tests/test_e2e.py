@@ -144,3 +144,20 @@ def test_cli_train_predict_subprocess(workdir):
     assert r.returncode == 0, r.stderr
     assert "ignoring legacy cluster args" in r.stderr
     assert (workdir / "scores.txt").exists()
+
+
+def test_weight_files_do_not_apply_to_validation(workdir, tmp_path):
+    # weight_files aligns with TRAIN files; a validation list of a different
+    # length must neither crash the eval stream nor weight its AUC.
+    (tmp_path / "train2.libsvm").write_text("1 0:1.0\n0 1:1.0\n" * 16)
+    cfg = load_config(str(workdir / "run.cfg"))
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        cfg,
+        train_files=cfg.train_files + (str(tmp_path / "train2.libsvm"),),
+        weight_files=(1.0, 2.5),  # 2 train files, 1 validation file
+    ).validate()
+    logs = []
+    train(cfg, log=logs.append)
+    assert any("validation auc" in l for l in logs)

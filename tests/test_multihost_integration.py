@@ -158,6 +158,20 @@ def test_two_process_dist_train_and_cross_mesh_restore(tmp_path):
         atol=2e-6,
     )
 
+    # Sharded validation: the final multi-host AUC (computed from sharded
+    # input + replicated scores) must match a single-process evaluation of
+    # the restored checkpoint on the same files.
+    from fast_tffm_tpu.train import _evaluate
+    from fast_tffm_tpu.trainer import make_predict_step
+
+    logged_auc = float(
+        [l for l in outs[0].splitlines() if "validation auc" in l][-1].rsplit(" ", 1)[1]
+    )
+    single_auc = _evaluate(
+        cfg, make_predict_step(model), restored, (str(tmp_path / "valid.libsvm"),), 5
+    )
+    assert abs(single_auc - logged_auc) < 5e-5, (single_auc, logged_auc)
+
     # Sharded-input dist_predict: the two-process run wrote one score per
     # valid.libsvm row; single-process prediction from the same checkpoint
     # must agree (1-ulp prints allowed — different meshes reduce in a
