@@ -462,3 +462,33 @@ def test_pad_to_batches_requires_max_nnz(tmp_path):
                 [str(path)], batch_size=4, vocabulary_size=1000, pad_to_batches=5
             )
         )
+
+
+def test_hash_golden_values_pinned():
+    """The FNV-1a feature hash is part of the CHECKPOINT contract: a saved
+    model's rows are only addressable if every future version hashes
+    identically (SURVEY.md §7 "hash compatibility").  These pins fail on any
+    accidental change to the hash or its mod-vocab mapping."""
+    assert fnv1a64(b"") == 14695981039346656037
+    assert fnv1a64(b"a") == 12638187200555641996
+    assert fnv1a64(b"userid_12345") == 13650338251897614555
+    v = 1 << 24
+    assert hash_feature_id("", v) == 2237221
+    assert hash_feature_id("userid_12345", v) == 4763867
+    assert hash_feature_id("click:ctr", v) == 4568902
+    assert hash_feature_id("feat_é", v) == 2652822  # non-ASCII goes UTF-8
+
+
+def test_hash_collision_rate_within_birthday_bound():
+    """200k distinct tokens into 2^24 slots: a healthy hash stays at or
+    below ~2x the birthday-bound expectation (n^2/2V ~ 1192)."""
+    n, v = 200_000, 1 << 24
+    seen = set()
+    collisions = 0
+    for i in range(n):
+        h = hash_feature_id(f"token_{i}", v)
+        if h in seen:
+            collisions += 1
+        else:
+            seen.add(h)
+    assert collisions < 2 * (n * n / (2 * v)), collisions
