@@ -104,3 +104,19 @@ def test_auc_metric():
     assert abs(auc(labels, np.full(5, 0.5)) - 0.5) < 1e-9
     w = np.asarray([1, 1, 0, 1, 1], np.float32)
     assert auc(labels, perfect, w) == 1.0
+
+
+def test_auc_matches_bruteforce_pairwise_with_ties():
+    # auc = (#[s_pos > s_neg] + 0.5 #[s_pos == s_neg]) / (n_pos n_neg);
+    # integer scores force heavy ties through the average-rank path.
+    rng = np.random.default_rng(123)
+    for _ in range(5):
+        labels = (rng.random(200) < 0.3).astype(np.float32)
+        scores = rng.integers(0, 10, size=200).astype(np.float32)
+        if labels.sum() in (0, 200):
+            continue
+        p, n = scores[labels > 0.5], scores[labels <= 0.5]
+        brute = ((p[:, None] > n[None, :]).sum() + 0.5 * (p[:, None] == n[None, :]).sum()) / (
+            len(p) * len(n)
+        )
+        np.testing.assert_allclose(auc(labels, scores), brute, rtol=1e-12)
