@@ -249,6 +249,19 @@ struct LineSpan {
   const char* end;
 };
 
+// Whitespace-separated token count of one line (the shared tokenizer for
+// the shape scans; parse_line has its own fused scan).
+inline int64_t count_tokens(const char* q, const char* end) {
+  int64_t toks = 0;
+  while (q < end) {
+    while (q < end && is_space(*q)) ++q;
+    if (q >= end) break;
+    ++toks;
+    while (q < end && !is_space(*q)) ++q;
+  }
+  return toks;
+}
+
 // Collect non-blank line spans (at most n when n >= 0).
 inline void collect_lines(const char* buf, int64_t n,
                           std::vector<LineSpan>* out) {
@@ -454,15 +467,7 @@ void fm_parse_shape(const char* buf, int64_t* n_lines, int64_t* widest) {
   while (*p) {
     const char* eol = strchr(p, '\n');
     const char* end = eol ? eol : p + strlen(p);
-    // Count whitespace-separated tokens on the line.
-    int64_t toks = 0;
-    const char* q = p;
-    while (q < end) {
-      while (q < end && is_space(*q)) ++q;
-      if (q >= end) break;
-      ++toks;
-      while (q < end && !is_space(*q)) ++q;
-    }
+    const int64_t toks = count_tokens(p, end);
     if (toks > 0) {
       ++lines;
       if (toks - 1 > wide) wide = toks - 1;
@@ -639,10 +644,43 @@ void* fm_reader_open(const char* path, int64_t shard_index,
   return fm_reader_open2(path, shard_index, shard_count, 1, counter_start);
 }
 
-// Count non-blank lines of a file, streaming (no parsing).  Multi-host
-// input sharding needs the GLOBAL line count up front so every process can
-// run the same number of collective steps per epoch.  Returns -1 on open
-// or read failure.
+// Stream a file once and report BOTH the non-blank line count and the
+// widest row's nnz (token count minus the label).  Multi-host input
+// sharding needs the global line count up front (same number of collective
+// steps on every process) and the static-shape batch width needs the
+// widest row — one C++ pass serves both instead of two Python passes.
+// Returns 0, or -1 on open/read failure.
+int32_t fm_scan_file(const char* path, int64_t* n_lines, int64_t* widest) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  FmReader r;
+  r.f = f;
+  r.buf.resize(1 << 22);
+  int64_t n = 0, wide = 0;
+  const char *b, *e;
+  while (next_line(&r, &b, &e)) {
+    const int64_t toks = count_tokens(b, e);
+    if (toks > 0) {
+      ++n;
+      if (toks - 1 > wide) wide = toks - 1;
+    }
+    if (r.tail_valid) {
+      r.tail.clear();
+      r.tail_valid = false;
+    }
+  }
+  fclose(f);
+  r.f = nullptr;
+  if (r.read_error) return -1;
+  *n_lines = n;
+  *widest = wide;
+  return 0;
+}
+
+// Count non-blank lines of a file, streaming.  The narrow entry for
+// count-only callers: checks only each line's leading whitespace
+// (is_blank) instead of tokenizing every byte the way fm_scan_file must.
+// Returns -1 on open or read failure.
 int64_t fm_count_lines(const char* path) {
   FILE* f = fopen(path, "rb");
   if (!f) return -1;

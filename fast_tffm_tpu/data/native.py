@@ -128,6 +128,12 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     ]
     lib.fm_count_lines.restype = ctypes.c_int64
     lib.fm_count_lines.argtypes = [ctypes.c_char_p]
+    lib.fm_scan_file.restype = ctypes.c_int32
+    lib.fm_scan_file.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64),  # n_lines
+        ctypes.POINTER(ctypes.c_int64),  # widest
+    ]
     lib.fm_reader_counter.restype = ctypes.c_int64
     lib.fm_reader_counter.argtypes = [ctypes.c_void_p]
     lib.fm_reader_close.restype = None
@@ -329,14 +335,71 @@ def native_batch_stream(
             emitted += 1
 
 
+# (path, mtime_ns, size) -> (n_lines, widest).  Startup calls scan_files /
+# count_lines on overlapping file sets (static width scan, then multi-host
+# steps-per-epoch on train and again on validation files); caching per file
+# keeps that one streaming pass each.  Entries invalidate when the file
+# changes; the table stays tiny (one tuple per data file).
+_scan_cache: dict[tuple[str, int, int], tuple[int, int]] = {}
+
+
+def _scan_one(path) -> tuple[int, int]:
+    path = os.fspath(path)
+    st = os.stat(path)
+    key = (path, st.st_mtime_ns, st.st_size)
+    hit = _scan_cache.get(key)
+    if hit is not None:
+        return hit
+    native = load_native_parser()
+    if native is not None:
+        n = ctypes.c_int64()
+        w = ctypes.c_int64()
+        if native._lib.fm_scan_file(path.encode(), ctypes.byref(n), ctypes.byref(w)):
+            raise OSError(f"cannot read {path}")
+        out = (n.value, w.value)
+    else:
+        total, widest = 0, 0
+        with open(path, "r") as f:
+            for line in f:
+                toks = len(line.split())
+                if toks > 0:
+                    total += 1
+                    widest = max(widest, toks - 1)
+        out = (total, widest)
+    _scan_cache[key] = out
+    return out
+
+
+def scan_files(files) -> tuple[int, int]:
+    """(total non-blank lines, widest row nnz) across ``files`` in ONE
+    streaming pass per file (C++ when the native library is built, buffered
+    Python otherwise; per-file results cached by (path, mtime, size)).
+    Serves both the multi-host steps-per-epoch count and the static batch
+    width (``max_nnz = 0`` config scan)."""
+    total, widest = 0, 0
+    for path in files:
+        n, w = _scan_one(path)
+        total += n
+        widest = max(widest, w)
+    return total, widest
+
+
 def count_lines(files) -> int:
-    """Total non-blank lines across ``files`` (C++ streaming count when the
-    native library is built, buffered Python otherwise)."""
+    """Total non-blank lines across ``files``.
+
+    Uses cached scan_files results when present; a cold count-only call
+    takes the cheaper fm_count_lines path (per-line is_blank check instead
+    of tokenizing every byte)."""
     native = load_native_parser()
     total = 0
     for path in files:
-        if native is not None:
-            n = int(native._lib.fm_count_lines(os.fspath(path).encode()))
+        path = os.fspath(path)
+        st = os.stat(path)
+        hit = _scan_cache.get((path, st.st_mtime_ns, st.st_size))
+        if hit is not None:
+            total += hit[0]
+        elif native is not None:
+            n = int(native._lib.fm_count_lines(path.encode()))
             if n < 0:
                 raise OSError(f"cannot read {path}")
             total += n

@@ -37,17 +37,16 @@ __all__ = ["train", "dist_train", "scan_max_nnz"]
 
 
 def scan_max_nnz(cfg: Config) -> int:
-    """Fix the static feature width: cfg.max_nnz, or a scan of the files."""
+    """Fix the static feature width: cfg.max_nnz, or a scan of the files
+    (one C++ streaming pass per file when the native parser is built)."""
     if cfg.max_nnz > 0:
         return cfg.max_nnz
-    widest = 1
-    for path in (*cfg.train_files, *cfg.validation_files, *cfg.predict_files):
-        with open(path) as f:
-            for line in f:
-                n = len(line.split()) - 1
-                if n > widest:
-                    widest = n
-    return widest
+    from fast_tffm_tpu.data.native import scan_files
+
+    _, widest = scan_files(
+        (*cfg.train_files, *cfg.validation_files, *cfg.predict_files)
+    )
+    return max(1, widest)
 
 
 _TRAIN_WEIGHTS = object()  # sentinel: apply cfg.weight_files (train files only)

@@ -380,7 +380,7 @@ def _write_lines(path, rows, rng, vocab=1000):
             f.write(f"{rng.integers(0, 2)} {feats}\n")
 
 
-def test_count_lines_native_matches_python(tmp_path):
+def test_scan_files_native_matches_python(tmp_path):
     from fast_tffm_tpu.data import native as native_mod
 
     rng = np.random.default_rng(11)
@@ -390,15 +390,25 @@ def test_count_lines_native_matches_python(tmp_path):
         _write_lines(p, n, rng)
         paths.append(str(p))
     with open(paths[1], "a") as f:
-        f.write("\n  \n0 3:1.0")  # blank lines + unterminated final line
-    assert native_mod.count_lines(paths) == 257 + 101
-    # The Python fallback (native lib absent) must agree.
+        # blank/whitespace lines, a CRLF line, and a 9-feature widest row
+        # on an unterminated final line.
+        f.write("\n  \n1 0:1.0 1:1\r\n0 " + " ".join(f"{i}:1" for i in range(9)))
+    expect = (257 + 102, 9)
+    assert native_mod.count_lines(paths) == expect[0]  # cold fm_count_lines path
+    assert native_mod.scan_files(paths) == expect
+    assert native_mod.count_lines(paths) == expect[0]  # cache-hit path
+    # The Python fallback (native lib absent) must agree; clear the scan
+    # cache so the fallback really runs instead of reusing native results.
     orig = native_mod.load_native_parser
     native_mod.load_native_parser = lambda: None
+    native_mod._scan_cache.clear()
     try:
-        assert native_mod.count_lines(paths) == 257 + 101
+        assert native_mod.scan_files(paths) == expect
+        native_mod._scan_cache.clear()
+        assert native_mod.count_lines(paths) == expect[0]
     finally:
         native_mod.load_native_parser = orig
+        native_mod._scan_cache.clear()
 
 
 def test_shard_block_reassembles_global_batches(tmp_path):
