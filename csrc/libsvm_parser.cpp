@@ -277,9 +277,14 @@ inline void collect_lines(const char* buf, int64_t n,
 }
 
 // Parse one line into row `li` of the output buffers.  Returns an ErrorCode.
+// IdT is the feature-id output type: int64_t mirrors the Python parser's
+// dtype; int32_t feeds the device batch directly (TPU ids are int32), which
+// halves the largest host->device transfer.  The vocabulary bound check
+// keeps either type exact (callers pick int32 only when vocab fits).
+template <typename IdT>
 inline int32_t parse_line(const char* p, const char* end, int64_t li,
                           int64_t width, int64_t vocabulary_size,
-                          int32_t hash_feature_id, float* labels, int64_t* ids,
+                          int32_t hash_feature_id, float* labels, IdT* ids,
                           float* vals, int32_t* fields, int32_t* nnz) {
   const char* q = p;
   while (q < end && is_space(*q)) ++q;
@@ -304,7 +309,7 @@ inline int32_t parse_line(const char* p, const char* end, int64_t li,
   // path walks each token exactly once — the digit scans both segment and
   // parse; only exotic tokens fall back to a find-token-end + slow re-parse.
   int64_t m = 0;
-  int64_t* row_ids = ids + li * width;
+  IdT* row_ids = ids + li * width;
   float* row_vals = vals + li * width;
   int32_t* row_fields = fields + li * width;
   while (q < end) {
@@ -376,7 +381,7 @@ inline int32_t parse_line(const char* p, const char* end, int64_t li,
       }
     }
     if (m >= width) return kRowTooWide;
-    row_ids[m] = fid;
+    row_ids[m] = static_cast<IdT>(fid);
     row_vals[m] = static_cast<float>(v);
     row_fields[m] = static_cast<int32_t>(field);
     ++m;
@@ -385,9 +390,10 @@ inline int32_t parse_line(const char* p, const char* end, int64_t li,
   return kOk;
 }
 
+template <typename IdT>
 int32_t parse_span_range(const std::vector<LineSpan>& spans, int64_t lo,
                          int64_t hi, int64_t width, int64_t vocabulary_size,
-                         int32_t hash_feature_id, float* labels, int64_t* ids,
+                         int32_t hash_feature_id, float* labels, IdT* ids,
                          float* vals, int32_t* fields, int32_t* nnz,
                          int64_t* error_line) {
   for (int64_t li = lo; li < hi; ++li) {
@@ -405,9 +411,10 @@ int32_t parse_span_range(const std::vector<LineSpan>& spans, int64_t lo,
 // Parse every span, spreading rows over a std::thread pool when it pays.
 // Threads write disjoint row ranges; the FIRST error by line index wins,
 // matching single-threaded reporting order.
+template <typename IdT>
 int32_t parse_spans_mt(const std::vector<LineSpan>& spans, int64_t width,
                        int64_t vocabulary_size, int32_t hash_feature_id,
-                       int32_t threads, float* labels, int64_t* ids,
+                       int32_t threads, float* labels, IdT* ids,
                        float* vals, int32_t* fields, int32_t* nnz,
                        int64_t* error_line) {
   const int64_t rows = static_cast<int64_t>(spans.size());
@@ -713,16 +720,17 @@ void fm_reader_close(void* reader) {
   delete r;
 }
 
-// Fill up to `want` rows of the caller's batch buffers (each sized for at
-// least `want` rows).  Returns the number of rows produced; fewer than
-// `want` means the file is exhausted.  On a parse error returns -1 and sets
-// *error_code (ErrorCode) and *error_line (this-shard row index within the
-// current call).
-int64_t fm_reader_next(void* reader, int64_t want, int64_t width,
-                       int64_t vocabulary_size, int32_t hash_feature_id,
-                       int32_t threads, float* labels, int64_t* ids,
-                       float* vals, int32_t* fields, int32_t* nnz,
-                       int32_t* error_code, int64_t* error_line) {
+}  // extern "C"
+
+namespace {
+
+// Shared body of fm_reader_next / fm_reader_next32 (IdT = id output dtype).
+template <typename IdT>
+int64_t reader_next_impl(void* reader, int64_t want, int64_t width,
+                         int64_t vocabulary_size, int32_t hash_feature_id,
+                         int32_t threads, float* labels, IdT* ids, float* vals,
+                         int32_t* fields, int32_t* nnz, int32_t* error_code,
+                         int64_t* error_line) {
   FmReader* r = static_cast<FmReader*>(reader);
   r->arena.clear();
   r->offsets.clear();
@@ -753,7 +761,7 @@ int64_t fm_reader_next(void* reader, int64_t want, int64_t width,
 
   const int64_t rows = static_cast<int64_t>(r->offsets.size());
   if (rows == 0) return 0;
-  memset(ids, 0, sizeof(int64_t) * rows * width);
+  memset(ids, 0, sizeof(IdT) * rows * width);
   memset(vals, 0, sizeof(float) * rows * width);
   memset(fields, 0, sizeof(int32_t) * rows * width);
   memset(nnz, 0, sizeof(int32_t) * rows);
@@ -773,6 +781,38 @@ int64_t fm_reader_next(void* reader, int64_t want, int64_t width,
     return -1;
   }
   return rows;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Fill up to `want` rows of the caller's batch buffers (each sized for at
+// least `want` rows).  Returns the number of rows produced; fewer than
+// `want` means the file is exhausted.  On a parse error returns -1 and sets
+// *error_code (ErrorCode) and *error_line (this-shard row index within the
+// current call).
+int64_t fm_reader_next(void* reader, int64_t want, int64_t width,
+                       int64_t vocabulary_size, int32_t hash_feature_id,
+                       int32_t threads, float* labels, int64_t* ids,
+                       float* vals, int32_t* fields, int32_t* nnz,
+                       int32_t* error_code, int64_t* error_line) {
+  return reader_next_impl(reader, want, width, vocabulary_size,
+                          hash_feature_id, threads, labels, ids, vals, fields,
+                          nnz, error_code, error_line);
+}
+
+// Same, writing int32 feature ids — the dtype the device batch wants (TPU
+// gathers index with int32), halving the largest host->device transfer.
+// Caller must ensure vocabulary_size <= INT32_MAX.
+int64_t fm_reader_next32(void* reader, int64_t want, int64_t width,
+                         int64_t vocabulary_size, int32_t hash_feature_id,
+                         int32_t threads, float* labels, int32_t* ids,
+                         float* vals, int32_t* fields, int32_t* nnz,
+                         int32_t* error_code, int64_t* error_line) {
+  return reader_next_impl(reader, want, width, vocabulary_size,
+                          hash_feature_id, threads, labels, ids, vals, fields,
+                          nnz, error_code, error_line);
 }
 
 }  // extern "C"

@@ -69,6 +69,14 @@ class Config:
             raise ValueError("order must be >= 2")
         if self.vocabulary_size <= 0 or self.batch_size <= 0:
             raise ValueError("vocabulary_size and batch_size must be positive")
+        if self.vocabulary_size > 2**31 - 1:
+            # Device feature ids are int32 (TPU gathers index with int32);
+            # a larger vocabulary would silently wrap when batches narrow
+            # to the device dtype.  Hash mode folds any id space into range.
+            raise ValueError(
+                f"vocabulary_size {self.vocabulary_size} exceeds int32 "
+                "(2**31 - 1), the device feature-id dtype"
+            )
         if self.checkpoint_format not in ("npz", "orbax"):
             raise ValueError(f"unknown checkpoint_format {self.checkpoint_format!r}")
         if self.compute_dtype not in ("float32", "bfloat16"):

@@ -34,7 +34,10 @@ class ParsedBatch:
     Attributes:
       labels:  [batch] float32 in {0, 1} (reference accepts 0/1 and ±1;
                −1 is mapped to 0).
-      ids:     [batch, max_nnz] int64 feature ids (0-padded).
+      ids:     [batch, max_nnz] feature ids, 0-padded.  int64 from the
+               line parsers (Python-int parity); the native STREAM emits
+               int32 when the vocabulary fits (the device batch dtype —
+               consumers must accept either).
       vals:    [batch, max_nnz] float32 feature values (0-padded; padding is
                identified by vals == 0, never by ids).
       fields:  [batch, max_nnz] int32 field ids (0-padded; all-zero for plain
@@ -149,7 +152,7 @@ def pad_batch(batch: ParsedBatch, batch_size: int) -> ParsedBatch:
     pad = batch_size - n
     return ParsedBatch(
         labels=np.concatenate([batch.labels, np.zeros((pad,), np.float32)]),
-        ids=np.concatenate([batch.ids, np.zeros((pad, batch.max_nnz), np.int64)]),
+        ids=np.concatenate([batch.ids, np.zeros((pad, batch.max_nnz), batch.ids.dtype)]),
         vals=np.concatenate([batch.vals, np.zeros((pad, batch.max_nnz), np.float32)]),
         fields=np.concatenate([batch.fields, np.zeros((pad, batch.max_nnz), np.int32)]),
         nnz=np.concatenate([batch.nnz, np.zeros((pad,), np.int32)]),

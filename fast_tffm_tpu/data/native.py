@@ -154,6 +154,22 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_int32),  # error_code
         ctypes.POINTER(ctypes.c_int64),  # error_line
     ]
+    lib.fm_reader_next32.restype = ctypes.c_int64
+    lib.fm_reader_next32.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int64,  # want
+        ctypes.c_int64,  # width
+        ctypes.c_int64,  # vocabulary_size
+        ctypes.c_int32,  # hash_feature_id
+        ctypes.c_int32,  # threads
+        np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),  # labels
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),  # ids (int32!)
+        np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),  # vals
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),  # fields
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),  # nnz
+        ctypes.POINTER(ctypes.c_int32),  # error_code
+        ctypes.POINTER(ctypes.c_int64),  # error_line
+    ]
     return lib
 
 
@@ -252,11 +268,16 @@ def native_batch_stream(
         )
     lib = parser._lib
     width = int(max_nnz)
+    # int32 ids whenever the vocabulary fits (always, for the device batch:
+    # TPU gathers index with int32) — halves the largest buffer/transfer
+    # and skips the astype copy in Batch.from_parsed.
+    ids_dtype = np.int32 if vocabulary_size <= np.iinfo(np.int32).max else np.int64
+    reader_next = lib.fm_reader_next32 if ids_dtype is np.int32 else lib.fm_reader_next
 
     def alloc():
         return (
             np.zeros((batch_size,), np.float32),
-            np.zeros((batch_size, width), np.int64),
+            np.zeros((batch_size, width), ids_dtype),
             np.zeros((batch_size, width), np.float32),
             np.zeros((batch_size, width), np.int32),
             np.zeros((batch_size,), np.int32),
@@ -284,7 +305,7 @@ def native_batch_stream(
                     want = batch_size - filled
                     ec = ctypes.c_int32(0)
                     el = ctypes.c_int64(-1)
-                    got = lib.fm_reader_next(
+                    got = reader_next(
                         handle,
                         want,
                         width,

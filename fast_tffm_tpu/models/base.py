@@ -40,7 +40,7 @@ class Batch:
         w = np.ones_like(parsed.labels) if weights is None else weights
         return Batch(
             labels=jnp.asarray(parsed.labels),
-            ids=jnp.asarray(parsed.ids.astype(np.int32)),
+            ids=jnp.asarray(parsed.ids.astype(np.int32, copy=False)),
             vals=jnp.asarray(parsed.vals),
             fields=jnp.asarray(parsed.fields),
             weights=jnp.asarray(w),

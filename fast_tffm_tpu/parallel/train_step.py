@@ -68,7 +68,7 @@ def make_global_batch(mesh: Mesh, parsed, w) -> Batch:
     mk = jax.make_array_from_process_local_data
     return Batch(
         labels=mk(vec, np.ascontiguousarray(parsed.labels)),
-        ids=mk(mat, np.ascontiguousarray(parsed.ids.astype(np.int32))),
+        ids=mk(mat, np.ascontiguousarray(parsed.ids.astype(np.int32, copy=False))),
         vals=mk(mat, np.ascontiguousarray(parsed.vals)),
         fields=mk(mat, np.ascontiguousarray(parsed.fields)),
         weights=mk(vec, np.ascontiguousarray(w)),

@@ -139,3 +139,11 @@ def test_file_globs_expand(tmp_path):
     ]
     # No-match patterns stay literal so downstream errors name the path.
     assert cfg.validation_files == (f"{tmp_path}/missing-*.libsvm",)
+
+
+def test_vocabulary_size_above_int32_rejected():
+    from fast_tffm_tpu.config import Config
+
+    with pytest.raises(ValueError, match="int32"):
+        Config(vocabulary_size=2**31).validate()
+    Config(vocabulary_size=2**31 - 1).validate()

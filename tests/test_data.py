@@ -502,3 +502,20 @@ def test_hash_collision_rate_within_birthday_bound():
         else:
             seen.add(h)
     assert collisions < 2 * (n * n / (2 * v)), collisions
+
+
+@pytest.mark.skipif(native is None, reason="C++ parser not built (make -C csrc)")
+def test_native_stream_id_dtype_follows_vocab(tmp_path):
+    # int32 ids when the vocabulary fits (device batch dtype, half the
+    # transfer); int64 beyond INT32_MAX.
+    path = tmp_path / "d.libsvm"
+    path.write_text("1 0:1.0 5:2.0\n0 3:1.5\n")
+    for vocab, dtype in [(1000, np.int32), (2**31, np.int64)]:
+        (b, w), = list(
+            batch_stream(
+                [str(path)], batch_size=2, vocabulary_size=vocab, max_nnz=4, parser=native
+            )
+        )
+        assert b.ids.dtype == dtype, (vocab, b.ids.dtype)
+        np.testing.assert_array_equal(b.ids[0], [0, 5, 0, 0])
+        np.testing.assert_array_equal(b.nnz, [2, 1])
