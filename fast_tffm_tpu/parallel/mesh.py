@@ -68,3 +68,16 @@ def pad_vocab(vocabulary_size: int, row_parallel: int) -> int:
     """Round the table row count up so every row shard is equal-sized."""
     r = row_parallel
     return ((vocabulary_size + r - 1) // r) * r
+
+
+def check_batch_divides(batch_size: int, mesh: Mesh) -> None:
+    """Fail fast when the global batch cannot split over every chip.
+
+    The train/predict steps shard the batch over BOTH mesh axes; catching
+    the mismatch here gives a config-level message instead of a shard_map
+    axis-divisibility error from inside the first step."""
+    if batch_size % mesh.devices.size:
+        raise ValueError(
+            f"batch_size {batch_size} not divisible by the "
+            f"{mesh.devices.size}-device mesh"
+        )

@@ -107,6 +107,7 @@ def predict(cfg: Config, log=print) -> str:
 def dist_predict(cfg: Config, log=print, mesh=None) -> str:
     """Mesh-sharded prediction — the reference's `dist_predict` mode."""
     from fast_tffm_tpu.parallel import (
+        check_batch_divides,
         init_sharded_state,
         make_mesh,
         make_sharded_predict_step,
@@ -120,6 +121,7 @@ def dist_predict(cfg: Config, log=print, mesh=None) -> str:
         row = cfg.row_parallel or cfg.vocabulary_block_num
         data = cfg.data_parallel or None
         mesh = make_mesh(data, row)
+    check_batch_divides(cfg.batch_size, mesh)
     state = init_sharded_state(model, mesh, jax.random.key(0), cfg.init_accumulator_value)
     state = restore_checkpoint(cfg.model_file, state)
     return _run_predict(

@@ -256,6 +256,7 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
     collective steps per epoch (short shards pad with weight-0 batches).
     """
     from fast_tffm_tpu.parallel import (
+        check_batch_divides,
         init_sharded_state,
         make_global_batch,
         make_mesh,
@@ -274,6 +275,7 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
         data = cfg.data_parallel or None
         mesh = make_mesh(data, row)
     log(f"mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))} on {mesh.devices.size} devices")
+    check_batch_divides(cfg.batch_size, mesh)
     state = init_sharded_state(model, mesh, jax.random.key(0), cfg.init_accumulator_value)
     if resume:
         state = restore_checkpoint(cfg.model_file, state)

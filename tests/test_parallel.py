@@ -106,3 +106,25 @@ def test_table_actually_sharded():
     state = init_sharded_state(model, mesh, jax.random.key(0))
     shard_shapes = {s.data.shape for s in state.table.addressable_shards}
     assert shard_shapes == {(V // 4, 5)}
+
+
+def test_dist_batch_size_must_divide_mesh(tmp_path):
+    # batch_size that doesn't split over every chip must fail with the
+    # config-level message, not a shard_map axis error inside step one.
+    from fast_tffm_tpu.config import Config
+    from fast_tffm_tpu.train import dist_train
+    from fast_tffm_tpu.predict import dist_predict
+
+    f = tmp_path / "d.libsvm"
+    f.write_text("1 0:1.0\n0 1:1.0\n" * 8)
+    n = jax.device_count()
+    cfg = Config(
+        model="fm", factor_num=2, vocabulary_size=16,
+        model_file=str(tmp_path / "m.ckpt"),
+        train_files=(str(f),), predict_files=(str(f),),
+        score_path=str(tmp_path / "s.txt"),
+        epoch_num=1, batch_size=n + 1,  # never divisible by n > 1 devices
+    ).validate()
+    for fn in (dist_train, dist_predict):
+        with pytest.raises(ValueError, match=f"not divisible by the {n}-device mesh"):
+            fn(cfg, log=lambda *_: None)
