@@ -161,3 +161,25 @@ def test_weight_files_do_not_apply_to_validation(workdir, tmp_path):
     logs = []
     train(cfg, log=logs.append)
     assert any("validation auc" in l for l in logs)
+
+
+def test_bundled_sample_cfg_quick_start(tmp_path, monkeypatch):
+    # The out-of-the-box story: `python fast_tffm.py train sample.cfg` on the
+    # committed data/ sample must train and predict (reference shipped its
+    # sample.cfg + data file the same way).  Outputs redirect to tmp.
+    import dataclasses
+
+    monkeypatch.chdir(REPO)  # sample.cfg paths are repo-relative
+    cfg = load_config(os.path.join(REPO, "sample.cfg"))
+    cfg = dataclasses.replace(
+        cfg,
+        model_file=str(tmp_path / "model.ckpt"),
+        score_path=str(tmp_path / "scores.txt"),
+        epoch_num=1,
+    ).validate()
+    logs = []
+    train(cfg, log=logs.append)
+    assert any("validation auc" in l for l in logs)
+    predict(cfg, log=logs.append)
+    scores = (tmp_path / "scores.txt").read_text().split()
+    assert len(scores) == 120
