@@ -43,7 +43,7 @@ def _oracle_score(rows, vals, order):
     return out
 
 
-@pytest.mark.parametrize("order", [2, 3, 4])
+@pytest.mark.parametrize("order", [2, 3, 4, 5])
 def test_score_matches_bruteforce(order):
     rng = np.random.default_rng(0)
     rows, vals = _rand_batch(rng)
@@ -68,7 +68,7 @@ def test_anova_kernel_degree1_is_sum():
     np.testing.assert_allclose(got, want, rtol=1e-5)
 
 
-@pytest.mark.parametrize("order", [2, 3, 4])
+@pytest.mark.parametrize("order", [2, 3, 4, 5])
 def test_custom_vjp_matches_autodiff(order):
     rng = np.random.default_rng(3)
     rows, vals = _rand_batch(rng)
