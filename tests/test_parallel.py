@@ -100,6 +100,34 @@ def test_sharded_deepfm_matches_single_device():
         )
 
 
+
+def test_sharded_ffm_matches_single_device():
+    from fast_tffm_tpu.models import FFMModel
+
+    model = FFMModel(vocabulary_size=V, num_fields=4, factor_num=3)
+    mesh = make_mesh(4, 2)
+    rng = np.random.default_rng(2)
+    batches = _batches(rng, n=3)
+
+    ref_state = init_state(model, jax.random.key(5))
+    ref_step = make_train_step(model, learning_rate=0.05)
+    sh_state = init_sharded_state(model, mesh, jax.random.key(5))
+    sh_step = make_sharded_train_step(model, 0.05, mesh)
+
+    for b in batches:
+        ref_state, ref_loss = ref_step(ref_state, b)
+        sh_state, sh_loss = sh_step(sh_state, b)
+        np.testing.assert_allclose(float(sh_loss), float(ref_loss), rtol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(sh_state.table)[:V], np.asarray(ref_state.table), rtol=1e-4, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(make_sharded_predict_step(model, mesh)(sh_state, batches[0])),
+        np.asarray(make_predict_step(model)(ref_state, batches[0])),
+        rtol=1e-4,
+    )
+
+
 def test_table_actually_sharded():
     model = FMModel(vocabulary_size=V, factor_num=4)
     mesh = make_mesh(2, 4)
