@@ -183,3 +183,33 @@ def test_bundled_sample_cfg_quick_start(tmp_path, monkeypatch):
     predict(cfg, log=logs.append)
     scores = (tmp_path / "scores.txt").read_text().split()
     assert len(scores) == 120
+
+
+def test_checkpoint_format_conversion_roundtrip(workdir, tmp_path):
+    # tools/convert_checkpoint.py: npz -> orbax -> npz preserves the state.
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from convert_checkpoint import main as convert
+
+    cfg = load_config(str(workdir / "run.cfg"))
+    state = train(cfg, log=lambda *_: None)
+
+    orbax_path = str(tmp_path / "conv.orbax")
+    npz_path = str(tmp_path / "back.npz")
+    for src, dst in [(cfg.model_file, orbax_path), (orbax_path, npz_path)]:
+        assert convert([str(workdir / "run.cfg"), src, dst]) == 0
+
+    from fast_tffm_tpu.config import build_model
+    from fast_tffm_tpu.trainer import init_state
+    import jax
+
+    like = init_state(build_model(cfg), jax.random.key(0))
+    a = restore_checkpoint(cfg.model_file, like)
+    b = restore_checkpoint(npz_path, like)
+    np.testing.assert_array_equal(np.asarray(a.table), np.asarray(b.table))
+    np.testing.assert_array_equal(
+        np.asarray(a.table_opt.accum), np.asarray(b.table_opt.accum)
+    )
+    assert jax.tree.structure(a.dense) == jax.tree.structure(b.dense)
+    for x, y in zip(jax.tree.leaves(a.dense), jax.tree.leaves(b.dense)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    assert int(a.step) == int(b.step) == int(state.step)

@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""Convert a checkpoint between the npz and orbax formats.
+
+    python tools/convert_checkpoint.py <cfg> <src_ckpt> <dst_ckpt> [--format npz|orbax]
+
+The config supplies the model shape (vocabulary_size, factor_num, model,
+...) that sizes the state to restore into.  Restoring already handles both
+formats and mesh-shape changes (checkpoint.py), so conversion is
+restore → save.  Typical use: pull a pod-scale orbax directory down to a
+single .npz for a one-host predict box, or seed a pod run from an npz.
+
+Destination format defaults by suffix: a path ending in ``.orbax`` or
+``/`` writes orbax, anything else npz (same rule as
+``checkpoint.save_checkpoint``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Convert a checkpoint between the npz and orbax formats."
+    )
+    ap.add_argument("config", help="INI config describing the model (see sample.cfg)")
+    ap.add_argument("src", help="source checkpoint (npz file or orbax dir)")
+    ap.add_argument("dst", help="destination checkpoint path")
+    ap.add_argument(
+        "--format",
+        choices=("auto", "npz", "orbax"),
+        default="auto",
+        help="destination format (auto = by suffix: .orbax/trailing slash = orbax)",
+    )
+    args = ap.parse_args(argv)
+
+    import jax
+
+    from fast_tffm_tpu.checkpoint import restore_checkpoint, save_checkpoint
+    from fast_tffm_tpu.config import build_model, load_config
+    from fast_tffm_tpu.trainer import init_state
+
+    cfg = load_config(args.config)
+    model = build_model(cfg)
+    like = init_state(model, jax.random.key(0), cfg.init_accumulator_value)
+    state = restore_checkpoint(args.src, like)
+    save_checkpoint(args.dst, state, args.format)
+    print(f"converted {args.src} -> {args.dst} (step {int(state.step)})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
