@@ -31,16 +31,11 @@ __all__ = [
 ]
 
 
-def __getattr__(name):
-    # train/predict drivers import lazily: they pull the full driver stack
-    # (checkpointing, pipelines), which library users of just the kernels
-    # and models should not pay for at import time.
-    if name in ("train", "dist_train"):
-        import fast_tffm_tpu.train as _t
-
-        return getattr(_t, name)
-    if name in ("predict", "dist_predict"):
-        import fast_tffm_tpu.predict as _p
-
-        return getattr(_p, name)
-    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+# Driver modules are named training/prediction — NOT train/predict — so the
+# package-level FUNCTIONS (the reference's entrypoint vocabulary) never
+# collide with a submodule attribute: `from fast_tffm_tpu import train` is
+# always the function, and `fast_tffm_tpu.training.scan_max_nnz`-style
+# module access keeps working.  Heavy optional deps (orbax) stay lazy
+# inside the driver modules.
+from fast_tffm_tpu.prediction import dist_predict, predict  # noqa: F401, E402
+from fast_tffm_tpu.training import dist_train, train  # noqa: F401, E402

@@ -41,19 +41,19 @@ def main(argv: list[str] | None = None) -> int:
         )
 
     if args.mode == "train":
-        from fast_tffm_tpu.train import train
+        from fast_tffm_tpu.training import train
 
         train(cfg, resume=args.resume)
     elif args.mode == "dist_train":
-        from fast_tffm_tpu.train import dist_train
+        from fast_tffm_tpu.training import dist_train
 
         dist_train(cfg, resume=args.resume)
     elif args.mode == "predict":
-        from fast_tffm_tpu.predict import predict
+        from fast_tffm_tpu.prediction import predict
 
         predict(cfg)
     else:
-        from fast_tffm_tpu.predict import dist_predict
+        from fast_tffm_tpu.prediction import dist_predict
 
         dist_predict(cfg)
     return 0

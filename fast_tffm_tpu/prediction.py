@@ -16,7 +16,7 @@ from fast_tffm_tpu.config import Config, build_model
 from fast_tffm_tpu.data.native import best_parser
 from fast_tffm_tpu.data.pipeline import batch_stream
 from fast_tffm_tpu.models.base import Batch
-from fast_tffm_tpu.train import scan_max_nnz
+from fast_tffm_tpu.training import scan_max_nnz
 from fast_tffm_tpu.trainer import init_state, make_predict_step
 from fast_tffm_tpu.utils.prefetch import prefetch
 

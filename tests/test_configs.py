@@ -19,8 +19,8 @@ sys.path.insert(0, os.path.join(REPO, "tools"))
 from gen_synthetic import generate  # noqa: E402
 
 from fast_tffm_tpu.config import load_config  # noqa: E402
-from fast_tffm_tpu.predict import predict  # noqa: E402
-from fast_tffm_tpu.train import train  # noqa: E402
+from fast_tffm_tpu.prediction import predict  # noqa: E402
+from fast_tffm_tpu.training import train  # noqa: E402
 
 CONFIGS = sorted(glob.glob(os.path.join(REPO, "configs", "baseline*.cfg")))
 

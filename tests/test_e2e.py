@@ -14,8 +14,8 @@ import pytest
 
 from fast_tffm_tpu.checkpoint import latest_step, restore_checkpoint, save_checkpoint
 from fast_tffm_tpu.config import load_config
-from fast_tffm_tpu.predict import predict
-from fast_tffm_tpu.train import train
+from fast_tffm_tpu.prediction import predict
+from fast_tffm_tpu.training import train
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -213,3 +213,16 @@ def test_checkpoint_format_conversion_roundtrip(workdir, tmp_path):
     for x, y in zip(jax.tree.leaves(a.dense), jax.tree.leaves(b.dense)):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
     assert int(a.step) == int(b.step) == int(state.step)
+
+
+def test_package_level_drivers_are_functions():
+    # `from fast_tffm_tpu import train` must yield the FUNCTION even after
+    # the same-named submodule has been imported (the submodule attribute
+    # must not shadow the driver — a real regression we hit).
+    import importlib
+
+    import fast_tffm_tpu
+    importlib.import_module("fast_tffm_tpu.training")
+    importlib.import_module("fast_tffm_tpu.prediction")
+    for name in ("train", "dist_train", "predict", "dist_predict"):
+        assert callable(getattr(fast_tffm_tpu, name)), name

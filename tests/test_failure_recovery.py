@@ -125,7 +125,7 @@ def test_abort_and_resume(tmp_path):
     import dataclasses
 
     cfg = dataclasses.replace(cfg, epoch_num=1)
-    from fast_tffm_tpu.train import train
+    from fast_tffm_tpu.training import train
 
     state = train(cfg, resume=True, log=lambda *_: None)
     assert int(state.step) > step_before
@@ -142,7 +142,7 @@ def test_sigterm_checkpoints_and_stops(tmp_path):
 
     from fast_tffm_tpu.checkpoint import latest_step
     from fast_tffm_tpu.config import Config
-    from fast_tffm_tpu.train import train
+    from fast_tffm_tpu.training import train
 
     rng = np.random.default_rng(0)
     f = tmp_path / "t.libsvm"

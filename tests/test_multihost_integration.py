@@ -43,7 +43,7 @@ WORKER = textwrap.dedent(
     assert jax.device_count() == 2 * nproc, jax.devices()
 
     from fast_tffm_tpu.config import Config
-    from fast_tffm_tpu.train import dist_train
+    from fast_tffm_tpu.training import dist_train
 
     cfg = Config(
         model="fm", factor_num=4, vocabulary_size=128,
@@ -59,7 +59,7 @@ WORKER = textwrap.dedent(
     # Same processes, predict side: sharded-input dist_predict on the
     # checkpoint just written (valid.libsvm's 96 rows = 3 global batches).
     import dataclasses
-    from fast_tffm_tpu.predict import dist_predict
+    from fast_tffm_tpu.prediction import dist_predict
     pcfg = dataclasses.replace(
         cfg,
         predict_files=(f"{{tmp}}/valid.libsvm",),
@@ -136,7 +136,7 @@ def test_two_process_dist_train_and_cross_mesh_restore(tmp_path):
     # data must land on (numerically) the same table — sharded input and
     # cross-host collectives change reduction order, not the math.
     from fast_tffm_tpu.config import Config
-    from fast_tffm_tpu.train import train
+    from fast_tffm_tpu.training import train
 
     cfg = Config(
         model="fm",
@@ -161,7 +161,7 @@ def test_two_process_dist_train_and_cross_mesh_restore(tmp_path):
     # Sharded validation: the final multi-host AUC (computed from sharded
     # input + replicated scores) must match a single-process evaluation of
     # the restored checkpoint on the same files.
-    from fast_tffm_tpu.train import _evaluate
+    from fast_tffm_tpu.training import _evaluate
     from fast_tffm_tpu.trainer import make_predict_step
 
     logged_auc = float(
@@ -180,7 +180,7 @@ def test_two_process_dist_train_and_cross_mesh_restore(tmp_path):
     assert "[0] PREDICT DONE" in outs[0] and "[1] PREDICT DONE" in outs[1]
     import dataclasses
 
-    from fast_tffm_tpu.predict import predict
+    from fast_tffm_tpu.prediction import predict
 
     pcfg = dataclasses.replace(
         cfg,

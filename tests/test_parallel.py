@@ -140,8 +140,8 @@ def test_dist_batch_size_must_divide_mesh(tmp_path):
     # batch_size that doesn't split over every chip must fail with the
     # config-level message, not a shard_map axis error inside step one.
     from fast_tffm_tpu.config import Config
-    from fast_tffm_tpu.train import dist_train
-    from fast_tffm_tpu.predict import dist_predict
+    from fast_tffm_tpu.training import dist_train
+    from fast_tffm_tpu.prediction import dist_predict
 
     f = tmp_path / "d.libsvm"
     f.write_text("1 0:1.0\n0 1:1.0\n" * 8)

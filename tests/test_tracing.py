@@ -6,7 +6,7 @@ import os
 import numpy as np
 
 from fast_tffm_tpu.config import load_config
-from fast_tffm_tpu.train import train
+from fast_tffm_tpu.training import train
 from fast_tffm_tpu.utils.tracing import MetricsLogger, maybe_trace, step_trace
 from tests.test_e2e import _write_cfg, _write_dataset
 
