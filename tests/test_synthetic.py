@@ -1,0 +1,59 @@
+"""tools/gen_synthetic.py: the planted-model contract the benchmarks rely on."""
+
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import gen_synthetic  # noqa: E402
+
+from fast_tffm_tpu.data.pipeline import batch_stream  # noqa: E402
+from fast_tffm_tpu.metrics import auc  # noqa: E402
+
+
+def _parse_all(path, vocab, fields):
+    labels, ids, vals = [], [], []
+    for b, w in batch_stream([path], batch_size=4096, vocabulary_size=vocab, max_nnz=fields):
+        n = int((w > 0).sum())
+        labels.append(b.labels[:n])
+        ids.append(np.asarray(b.ids)[:n])
+        vals.append(b.vals[:n])
+    return np.concatenate(labels), np.concatenate(ids), np.concatenate(vals)
+
+
+def test_planted_score_is_the_label_oracle(tmp_path):
+    """planted_score replayed over the PARSED file must rank the labels at
+    the generator's oracle level — this is the contract bench_convergence's
+    oracle ceiling rests on.  Low label noise (spread=4) makes the check
+    tight and cheap."""
+    path = str(tmp_path / "d.libsvm")
+    vocab, fields = 1 << 10, 8
+    gen_synthetic.generate(path, rows=4000, fields=fields, vocab=vocab, seed=3, spread=4.0)
+    labels, ids, vals = _parse_all(path, vocab, fields)
+    scores = gen_synthetic.planted_score(ids, vals)
+    assert auc(labels, scores) > 0.9
+
+
+def test_planted_model_is_stateless_across_files(tmp_path):
+    """Files generated with different --seed but one --model-seed share the
+    planted model: held-out ranking works across files (the reason
+    _id_normal is a pure function of the id)."""
+    a, b = str(tmp_path / "a.libsvm"), str(tmp_path / "b.libsvm")
+    vocab, fields = 1 << 10, 8
+    gen_synthetic.generate(a, rows=3000, fields=fields, vocab=vocab, seed=0, spread=4.0)
+    gen_synthetic.generate(b, rows=3000, fields=fields, vocab=vocab, seed=9, spread=4.0)
+    labels_b, ids_b, vals_b = _parse_all(b, vocab, fields)
+    assert auc(labels_b, gen_synthetic.planted_score(ids_b, vals_b)) > 0.9
+
+
+def test_spread_controls_label_noise(tmp_path):
+    noisy, clean = str(tmp_path / "n.libsvm"), str(tmp_path / "c.libsvm")
+    vocab, fields = 1 << 10, 8
+    gen_synthetic.generate(noisy, rows=4000, fields=fields, vocab=vocab, seed=1, spread=0.5)
+    gen_synthetic.generate(clean, rows=4000, fields=fields, vocab=vocab, seed=1, spread=6.0)
+    auc_n = auc(*(lambda l, i, v: (l, gen_synthetic.planted_score(i, v)))(*_parse_all(noisy, vocab, fields)))
+    auc_c = auc(*(lambda l, i, v: (l, gen_synthetic.planted_score(i, v)))(*_parse_all(clean, vocab, fields)))
+    assert auc_c > auc_n + 0.1

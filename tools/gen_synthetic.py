@@ -62,6 +62,25 @@ def _id_normal(ids: np.ndarray, salt: int) -> np.ndarray:
     return z.astype(np.float32)
 
 
+def planted_score(ids: np.ndarray, vals: np.ndarray, factor_num: int = 4,
+                  model_seed: int = 1234) -> np.ndarray:
+    """Score rows with the planted FM (bias + order-2 interactions).
+
+    The single source of the planted model's math and constants — generate()
+    labels with it and benchmark oracles replay it on parsed files.  AUC
+    consumers can use these raw scores directly: generate()'s affine
+    calibration is rank-preserving.  ids/vals: [rows, nnz]."""
+    bias = 0.6 * _id_normal(ids, model_seed)
+    fac = np.stack(
+        [0.45 * _id_normal(ids, model_seed + 7 + j) for j in range(factor_num)],
+        axis=-1,
+    )
+    vx = fac * np.asarray(vals, np.float32)[..., None]
+    s1 = vx.sum(axis=1)
+    inter = 0.5 * ((s1 * s1).sum(-1) - (vx * vx).sum(axis=(1, 2)))
+    return (bias * vals).sum(axis=1) + inter
+
+
 def generate(
     out: str,
     rows: int,
@@ -72,6 +91,7 @@ def generate(
     seed: int = 0,
     binary_vals: bool = False,
     model_seed: int = 1234,
+    spread: float = 1.5,
 ) -> None:
     rng = np.random.default_rng(seed)
     # Field f owns the id range [f*vocab//fields, (f+1)*vocab//fields).
@@ -92,17 +112,10 @@ def generate(
     # Hidden FM: per-id bias + factors as a stateless function of (id,
     # model_seed) — files generated with different --seed but the same
     # --model-seed share one planted model, so held-out AUC is meaningful.
-    bias = 0.6 * _id_normal(ids, model_seed).reshape(rows, fields)
-    fac = np.stack(
-        [0.45 * _id_normal(ids, model_seed + 7 + j) for j in range(factor_num)],
-        axis=-1,
-    ).reshape(rows, fields, factor_num)
-
-    vx = fac * vals[..., None]
-    s1 = vx.sum(axis=1)
-    inter = 0.5 * ((s1 * s1).sum(-1) - (vx * vx).sum(axis=(1, 2)))
-    score = (bias * vals).sum(axis=1) + inter
-    score = (score - score.mean()) / (score.std() + 1e-6) * 1.5  # calibrated spread
+    score = planted_score(ids, vals, factor_num, model_seed)
+    # Calibrated spread: bigger -> labels closer to deterministic (higher
+    # oracle AUC, cleaner learning signal); 1.5 looks like real CTR noise.
+    score = (score - score.mean()) / (score.std() + 1e-6) * spread
     labels = (rng.random(rows) < 1.0 / (1.0 + np.exp(-score))).astype(np.int64)
 
     os.makedirs(os.path.dirname(os.path.abspath(out)), exist_ok=True)
@@ -141,6 +154,12 @@ def main(argv=None) -> int:
         default=1234,
         help="seed of the PLANTED model (keep equal across train/valid/test splits)",
     )
+    ap.add_argument(
+        "--spread",
+        type=float,
+        default=1.5,
+        help="planted score std; bigger = less label noise, higher oracle AUC",
+    )
     a = ap.parse_args(argv)
     generate(
         a.out,
@@ -152,6 +171,7 @@ def main(argv=None) -> int:
         a.seed,
         a.binary_vals,
         a.model_seed,
+        a.spread,
     )
     print(f"wrote {a.rows} rows ({a.fields} fields, vocab {a.vocab}, {a.format}) -> {a.out}")
     return 0
