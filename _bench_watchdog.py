@@ -1,0 +1,33 @@
+"""Hang watchdog for the benchmark drivers (stdlib-only).
+
+The TPU here sits behind a tunnel that has been observed to hang outright
+(device RPCs block forever, load average ~0) — sometimes as early as
+backend initialization inside ``import jax``.  A hung benchmark is worse
+than a missing one: it stalls the whole harness.  Both bench scripts arm
+this BEFORE importing jax/fast_tffm_tpu and cancel it once their last
+result line is printed.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+
+DEFAULT_SECS = 600.0
+
+
+def arm(seconds: float = DEFAULT_SECS, what: str = "bench") -> threading.Timer:
+    def fire():
+        print(
+            f"{what} watchdog: no result after {seconds:.0f}s — device "
+            "backend appears hung (tunnel down?); aborting without a number",
+            file=sys.stderr,
+            flush=True,
+        )
+        os._exit(2)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t

@@ -14,9 +14,15 @@ vs_baseline is against the BASELINE.json north-star ≥500k examples/sec/chip.
 import json
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+import _bench_watchdog
+
+# Armed before jax/fast_tffm_tpu imports: backend init inside `import jax`
+# is itself a known hang point behind a dead tunnel.
+_watchdog = _bench_watchdog.arm(what="bench.py")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
 
 from fast_tffm_tpu.models import Batch, FMModel
 from fast_tffm_tpu.trainer import init_state, make_train_step
@@ -71,6 +77,7 @@ def main():
 
     n_chips = jax.device_count()
     value = batch_size * iters / best_dt / n_chips
+    _watchdog.cancel()
     print(
         json.dumps(
             {

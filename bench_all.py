@@ -18,12 +18,18 @@ data-layer tests; device throughput is what the north star counts).
 import json
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+import _bench_watchdog
 
-from fast_tffm_tpu.models import Batch, DeepFMModel, FFMModel, FMModel
-from fast_tffm_tpu.trainer import init_state, make_train_step
+# Armed before jax/fast_tffm_tpu imports (backend init can hang behind a
+# dead tunnel); generous budget — the full sweep is ~15 min healthy.
+_watchdog = _bench_watchdog.arm(seconds=2400, what="bench_all.py")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from fast_tffm_tpu.models import Batch, DeepFMModel, FFMModel, FMModel  # noqa: E402
+from fast_tffm_tpu.trainer import init_state, make_train_step  # noqa: E402
 
 BASELINE = 500_000.0  # examples/sec/chip north star
 
@@ -120,6 +126,7 @@ def main():
     bench_input()
     bench_end_to_end()
     bench_convergence()
+    _watchdog.cancel()
 
 
 def _gen_tools():
