@@ -1,1 +1,1 @@
-from fast_tffm_tpu.utils.prefetch import parallel_map, prefetch  # noqa: F401
+from fast_tffm_tpu.utils.prefetch import prefetch  # noqa: F401

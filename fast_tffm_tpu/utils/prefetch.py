@@ -1,15 +1,13 @@
-"""Host-side pipeline concurrency: background prefetch + parallel parsing.
+"""Host-side pipeline concurrency: background prefetch.
 
 The JAX-era replacement for the reference's TF queue runners
 (`renyi533/fast_tffm` :: trainer module: filename/string queues with
-cfg-driven thread and queue sizes).  Two pieces:
-
-  * ``prefetch(it, depth)`` — run an iterator in a daemon thread with a
-    bounded queue so host parsing overlaps device steps;
-  * ``ParallelMapIterator`` — order-preserving parallel map over an
-    iterator with a worker pool (used to spread libsvm parsing over
-    ``thread_num`` workers; the C++ parser releases the GIL implicitly by
-    doing its work in a single ctypes call, so threads scale).
+cfg-driven thread and queue sizes): ``prefetch(it, depth)`` runs an
+iterator in a daemon thread with a bounded queue so host parsing overlaps
+device steps.  Parse-thread parallelism (the cfg ``thread_num``) lives
+inside the C++ kernel's std::thread pool (csrc/libsvm_parser.cpp), not in
+Python — a Python-side thread map cannot beat the GIL for the pure-Python
+fallback parser and is redundant for the GIL-releasing native one.
 """
 
 from __future__ import annotations
@@ -17,9 +15,8 @@ from __future__ import annotations
 import queue
 import threading
 from collections.abc import Iterable, Iterator
-from concurrent.futures import ThreadPoolExecutor
 
-__all__ = ["prefetch", "parallel_map"]
+__all__ = ["prefetch"]
 
 _SENTINEL = object()
 
@@ -49,29 +46,3 @@ def prefetch(it: Iterable, depth: int = 8) -> Iterator:
         yield item
 
 
-def parallel_map(fn, it: Iterable, workers: int, depth: int = 8) -> Iterator:
-    """Order-preserving parallel ``map(fn, it)`` with ``workers`` threads."""
-    if workers <= 1:
-        yield from map(fn, it)
-        return
-    with ThreadPoolExecutor(max_workers=workers) as pool:
-        pending: queue.Queue = queue.Queue()
-        it = iter(it)
-
-        def submit_next() -> bool:
-            try:
-                item = next(it)
-            except StopIteration:
-                return False
-            pending.put(pool.submit(fn, item))
-            return True
-
-        live = True
-        for _ in range(max(1, depth)):
-            live = submit_next()
-            if not live:
-                break
-        while not pending.empty():
-            fut = pending.get()
-            submit_next()
-            yield fut.result()
