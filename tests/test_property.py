@@ -1,0 +1,69 @@
+"""Property tests (hypothesis): parser parity and sharding invariants.
+
+The hand-written fuzz in test_data.py covers curated edge cases; these
+let hypothesis search the input space and shrink failures.
+"""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from fast_tffm_tpu.data.libsvm import parse_lines
+from fast_tffm_tpu.data.native import load_native_parser
+from fast_tffm_tpu.data.pipeline import line_stream
+
+native = load_native_parser()
+
+# Decimal-number token grammar: sign, digits, optional fraction/exponent —
+# everything Python float() accepts that CTR data plausibly contains.
+_number = st.from_regex(r"[+-]?[0-9]{1,25}(\.[0-9]{0,20})?([eE][+-]?[0-9]{1,3})?", fullmatch=True)
+_ws = st.sampled_from([" ", "  ", "\t", " \t "])
+
+
+@pytest.mark.skipif(native is None, reason="C++ parser not built (make -C csrc)")
+@settings(max_examples=150, deadline=None)
+@given(
+    labels=st.lists(_number, min_size=1, max_size=4),
+    ids=st.lists(st.integers(0, 999), min_size=1, max_size=6),
+    vals=st.lists(_number, min_size=6, max_size=6),
+    sep=_ws,
+)
+def test_parser_parity_random_numbers(labels, ids, vals, sep):
+    """Python and C++ parsers agree bit-for-bit on arbitrary numeric tokens
+    and whitespace (labels, values, separators all drawn from the grammar)."""
+    lines = [
+        lab + sep + sep.join(f"{i}:{v}" for i, v in zip(ids, vals))
+        for lab in labels
+    ]
+    a = parse_lines(lines, vocabulary_size=1000)
+    b = native(lines, vocabulary_size=1000)
+    np.testing.assert_array_equal(a.labels, b.labels)
+    np.testing.assert_array_equal(a.ids, b.ids)
+    np.testing.assert_array_equal(a.vals.view(np.uint32), b.vals.view(np.uint32))
+    np.testing.assert_array_equal(a.nnz, b.nnz)
+
+
+@settings(max_examples=60, deadline=None)
+@given(
+    n_rows=st.integers(1, 200),
+    shard_count=st.integers(1, 5),
+    shard_block=st.integers(1, 17),
+)
+def test_block_cyclic_shards_partition_the_stream(tmp_path_factory, n_rows, shard_count, shard_block):
+    """For ANY (count, block): shards are disjoint and cover every line,
+    and each shard preserves file order."""
+    td = tmp_path_factory.mktemp("prop")
+    p = td / "d.libsvm"
+    p.write_text("".join(f"{i % 2} {i}:1.0\n" for i in range(n_rows)))
+    seen = []
+    for idx in range(shard_count):
+        shard = [
+            line
+            for line, _ in line_stream(
+                [str(p)], shard_index=idx, shard_count=shard_count, shard_block=shard_block
+            )
+        ]
+        ranks = [int(l.split()[1].split(":")[0]) for l in shard]
+        assert ranks == sorted(ranks)  # order preserved within a shard
+        seen.extend(ranks)
+    assert sorted(seen) == list(range(n_rows))  # disjoint cover
