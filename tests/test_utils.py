@@ -1,0 +1,40 @@
+"""Host-pipeline utilities: prefetch semantics and the file-scan cache."""
+
+import pytest
+
+from fast_tffm_tpu.utils.prefetch import prefetch
+
+
+def test_prefetch_preserves_order_and_completes():
+    assert list(prefetch(iter(range(100)), depth=4)) == list(range(100))
+
+
+def test_prefetch_propagates_worker_exception():
+    def gen():
+        yield 1
+        yield 2
+        raise RuntimeError("boom in worker thread")
+
+    it = prefetch(gen(), depth=2)
+    got = []
+    with pytest.raises(RuntimeError, match="boom in worker"):
+        for x in it:
+            got.append(x)
+    assert got == [1, 2]  # items before the failure are delivered in order
+
+
+def test_prefetch_empty_iterator():
+    assert list(prefetch(iter(()), depth=1)) == []
+
+
+def test_scan_cache_invalidates_on_file_change(tmp_path):
+    from fast_tffm_tpu.data import native as native_mod
+
+    p = tmp_path / "d.libsvm"
+    p.write_text("1 0:1.0\n0 1:1.0 2:2.0\n")
+    native_mod._scan_cache.clear()
+    assert native_mod.scan_files([str(p)]) == (2, 2)
+    # Rewrite with different content; the (path, mtime, size) key must miss.
+    p.write_text("1 0:1.0 1:1.0 2:1.0 3:1.0\n" * 3)
+    assert native_mod.scan_files([str(p)]) == (3, 4)
+    native_mod._scan_cache.clear()
