@@ -6,7 +6,9 @@ let hypothesis search the input space and shrink failures.
 
 import numpy as np
 import pytest
-from hypothesis import given, settings, strategies as st
+
+pytest.importorskip("hypothesis", reason="pip install fast-tffm-tpu[test]")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from fast_tffm_tpu.data.libsvm import parse_lines
 from fast_tffm_tpu.data.native import load_native_parser
