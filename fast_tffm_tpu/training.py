@@ -230,6 +230,14 @@ def train(cfg: Config, *, resume: bool = False, log=print):
     """Local (single-device) training — the reference's `train` mode."""
     if not cfg.train_files:
         raise ValueError("no train_files configured")
+    if cfg.weight_files and len(cfg.weight_files) != len(cfg.train_files):
+        # Checked here, not in Config.validate: a shared config must still
+        # LOAD on predict-only machines where train-file globs match
+        # differently (or not at all).
+        raise ValueError(
+            f"weight_files has {len(cfg.weight_files)} entries for "
+            f"{len(cfg.train_files)} train_files (they align per-file)"
+        )
     model = build_model(cfg)
     max_nnz = scan_max_nnz(cfg)
     state = init_state(model, jax.random.key(0), cfg.init_accumulator_value)
@@ -267,6 +275,14 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
 
     if not cfg.train_files:
         raise ValueError("no train_files configured")
+    if cfg.weight_files and len(cfg.weight_files) != len(cfg.train_files):
+        # Checked here, not in Config.validate: a shared config must still
+        # LOAD on predict-only machines where train-file globs match
+        # differently (or not at all).
+        raise ValueError(
+            f"weight_files has {len(cfg.weight_files)} entries for "
+            f"{len(cfg.train_files)} train_files (they align per-file)"
+        )
     maybe_initialize_distributed(cfg.coordinator_address, cfg.num_processes, cfg.process_id)
     model = build_model(cfg)
     max_nnz = scan_max_nnz(cfg)

@@ -147,3 +147,20 @@ def test_vocabulary_size_above_int32_rejected():
     with pytest.raises(ValueError, match="int32"):
         Config(vocabulary_size=2**31).validate()
     Config(vocabulary_size=2**31 - 1).validate()
+
+
+def test_weight_files_length_checked_at_train_entry(tmp_path):
+    # Checked in the TRAIN drivers, not validate(): a shared config must
+    # still load on predict-only machines whose train-file globs differ.
+    from fast_tffm_tpu.config import Config
+    from fast_tffm_tpu.training import train
+
+    Config(train_files=("a",), weight_files=(1.0, 2.0)).validate()  # loads fine
+    f = tmp_path / "t.libsvm"
+    f.write_text("1 0:1.0\n")
+    cfg = Config(
+        model="fm", vocabulary_size=8, model_file=str(tmp_path / "m.ckpt"),
+        train_files=(str(f),), weight_files=(1.0, 2.0), epoch_num=1, batch_size=2,
+    ).validate()
+    with pytest.raises(ValueError, match="align per-file"):
+        train(cfg, log=lambda *_: None)
