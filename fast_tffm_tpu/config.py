@@ -56,6 +56,8 @@ class Config:
     # [Distributed]
     data_parallel: int = 0  # 0 = all devices / row_parallel
     row_parallel: int = 0  # 0 = vocabulary_block_num
+    lookup: str = "allgather"  # embedding lookup collective (| alltoall)
+    lookup_capacity_factor: float = 2.0  # alltoall per-destination slack
     coordinator_address: str = ""  # multi-host: host:port of process 0
     num_processes: int = 0  # multi-host: total process count
     process_id: int = -1  # multi-host: this process's index
@@ -81,6 +83,8 @@ class Config:
             raise ValueError(f"unknown checkpoint_format {self.checkpoint_format!r}")
         if self.compute_dtype not in ("float32", "bfloat16"):
             raise ValueError(f"unknown compute_dtype {self.compute_dtype!r}")
+        if self.lookup not in ("allgather", "alltoall"):
+            raise ValueError(f"unknown lookup {self.lookup!r} (allgather | alltoall)")
         return self
 
 
@@ -168,6 +172,10 @@ def load_config(path: str) -> Config:
     d = "Distributed"
     cfg.data_parallel = get(d, "data_parallel", int, cfg.data_parallel)
     cfg.row_parallel = get(d, "row_parallel", int, cfg.row_parallel)
+    cfg.lookup = get(d, "lookup", str, cfg.lookup).lower()
+    cfg.lookup_capacity_factor = get(
+        d, "lookup_capacity_factor", float, cfg.lookup_capacity_factor
+    )
     cfg.coordinator_address = get(d, "coordinator_address", str, cfg.coordinator_address)
     cfg.num_processes = get(d, "num_processes", int, cfg.num_processes)
     cfg.process_id = get(d, "process_id", int, cfg.process_id)

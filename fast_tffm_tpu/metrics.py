@@ -26,6 +26,11 @@ def auc(labels: np.ndarray, scores: np.ndarray, weights: np.ndarray | None = Non
     n_neg = labels.size - n_pos
     if n_pos == 0 or n_neg == 0:
         return float("nan")
+    if np.isnan(scores).any():
+        # Ranking NaNs (argsort puts them last) would fabricate a finite
+        # AUC from poisoned scores (e.g. an alltoall-lookup capacity
+        # overflow or a diverged model).  Surface nan instead.
+        return float("nan")
     order = np.argsort(scores, kind="mergesort")
     ranks = np.empty_like(scores)
     ranks[order] = np.arange(1, scores.size + 1, dtype=np.float64)

@@ -1,0 +1,103 @@
+"""All-to-all routed embedding lookup (SparseCore-style id routing).
+
+Optional alternative to ``embedding.sharded_gather`` (config key
+``lookup = alltoall``).  The default all-gather scheme ships every chip's
+full masked ``[R·B_local, N, D]`` contribution through ``psum_scatter`` —
+R× the minimal bytes, because each row has exactly one owner.  Here each
+chip instead routes its ids to their home shards and gets back only its
+own rows:
+
+  1. owner = id // shard_rows (contiguous row shards, same layout as the
+     all-gather path — checkpoints are interchangeable);
+  2. ids sort by owner into a ``[R, C]`` send buffer (C = capacity per
+     destination), `lax.all_to_all` delivers each shard its requests;
+  3. each shard serves its rows locally and a second all_to_all returns
+     them; an inverse permutation restores batch order.
+
+ICI bytes per chip: ~2·R·C·D ≈ 2·slack·M·D instead of R·M·D — an
+~(R/2·slack)× reduction that grows with the mesh (R=64 on a v5e-64).
+
+**Capacity and skew.**  Static shapes force a fixed per-destination
+capacity C = ceil(capacity_factor · M / R).  With ``hash_feature_id``
+(the 10B-row regime this path exists for) ids are uniform and
+capacity_factor=2 overflows with negligible probability.  Zipf-skewed
+RAW ids on contiguous shards can overflow; overflow is NEVER silent —
+every affected row poisons to NaN, so the loss goes NaN on the first
+overflowing step (test-pinned).  Raise capacity_factor or use the
+default all-gather lookup for skewed id spaces.
+
+These functions run INSIDE a shard_map body (parallel/train_step.py).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from fast_tffm_tpu.parallel.mesh import ROW_AXIS
+
+__all__ = ["routed_gather", "capacity_for"]
+
+
+def capacity_for(ids_per_chip: int, row_parallel: int, capacity_factor: float) -> int:
+    """Per-destination slot count for M ids over R destinations.
+
+    factor·M/R covers systematic imbalance; the additive 4·√(M/R) + 8 term
+    covers the binomial tail, which dominates when M/R is small (without
+    it, even uniform ids overflow a thin bucket with noticeable
+    probability at toy sizes).  Rounded to a multiple of 8, capped at M
+    (C = M can never overflow)."""
+    mean = ids_per_chip / row_parallel
+    c = int(capacity_factor * mean + 4.0 * mean**0.5 + 8.0)
+    c = ((c + 7) // 8) * 8
+    return max(8, min(c, ids_per_chip))
+
+
+def routed_gather(table_shard: jnp.ndarray, ids: jnp.ndarray, capacity: int) -> jnp.ndarray:
+    """Assemble this chip's rows via all-to-all id routing.
+
+    table_shard: [V/R, D] contiguous row shard.
+    ids:         [B_local, N] global row ids for THIS chip's micro-batch.
+    capacity:    static per-destination slot count (see capacity_for).
+    Returns:     [B_local, N, D] rows (NaN-poisoned if any destination
+                 overflowed its capacity — never silently wrong).
+    """
+    shard_rows = table_shard.shape[0]
+    base = lax.axis_index(ROW_AXIS) * shard_rows
+    R = lax.axis_size(ROW_AXIS)
+    B, N = ids.shape
+    M = B * N
+    flat = ids.reshape(M)
+    owner = flat // shard_rows  # [M] in [0, R)
+
+    # Stable sort by owner; position of each element within its bucket.
+    order = jnp.argsort(owner, stable=True)
+    sorted_ids = flat[order]
+    sorted_owner = owner[order]
+    counts = jnp.bincount(owner, length=R)  # [R]
+    starts = jnp.cumsum(counts) - counts
+    pos = jnp.arange(M) - starts[sorted_owner]  # [M] slot within bucket
+    overflow = jnp.any(counts > capacity)
+
+    # Scatter into the [R, C] send buffer; slots beyond capacity drop (their
+    # rows are poisoned below), unused slots carry an out-of-range sentinel.
+    sentinel = jnp.int32(shard_rows * R)
+    send_ids = jnp.full((R, capacity), sentinel, dtype=flat.dtype)
+    send_pos = jnp.where(pos < capacity, pos, capacity)  # capacity → dropped
+    send_ids = send_ids.at[sorted_owner, send_pos].set(sorted_ids, mode="drop")
+
+    # Exchange requests; serve locally; exchange answers.
+    recv_ids = lax.all_to_all(send_ids, ROW_AXIS, 0, 0, tiled=True)  # [R, C]
+    local = recv_ids - base
+    ok = (local >= 0) & (local < shard_rows)  # sentinels fail
+    served = table_shard[jnp.where(ok, local, 0)] * ok[..., None].astype(table_shard.dtype)
+    recv_rows = lax.all_to_all(served, ROW_AXIS, 0, 0, tiled=True)  # [R, C, D]
+
+    # recv_rows[s, c] answers MY request in send slot [s, c]; invert the
+    # bucket placement, then the sort.
+    in_cap = pos < capacity
+    mine_sorted = recv_rows[sorted_owner, jnp.minimum(pos, capacity - 1)]
+    mine_sorted = mine_sorted * in_cap[:, None].astype(mine_sorted.dtype)
+    out = jnp.zeros((M, table_shard.shape[-1]), table_shard.dtype).at[order].set(mine_sorted)
+    out = jnp.where(overflow, jnp.nan, out)
+    return out.reshape(B, N, -1)

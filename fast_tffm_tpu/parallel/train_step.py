@@ -126,18 +126,44 @@ def init_sharded_state(model, mesh: Mesh, key, init_accumulator_value: float = 0
     )
 
 
-def make_sharded_train_step(model, learning_rate: float, mesh: Mesh):
+def _make_gather(mesh: Mesh, local_ids_shape, lookup: str, capacity_factor: float):
+    """Pick the lookup collective: all-gather (default) or all-to-all routing.
+
+    ``local_ids_shape`` is the PER-CHIP [B_local, N] shape (this is called
+    from inside the shard_map body at trace time)."""
+    if lookup == "allgather":
+        return sharded_gather
+    if lookup != "alltoall":
+        raise ValueError(f"unknown lookup {lookup!r} (allgather | alltoall)")
+    from fast_tffm_tpu.parallel.alltoall import capacity_for, routed_gather
+
+    b_local, n = local_ids_shape
+    cap = capacity_for(b_local * n, mesh.shape[ROW_AXIS], capacity_factor)
+    return lambda table, ids: routed_gather(table, ids, cap)
+
+
+def make_sharded_train_step(
+    model, learning_rate: float, mesh: Mesh, *, lookup: str = "allgather",
+    capacity_factor: float = 2.0
+):
     """Returns jitted SPMD ``step(state, batch) -> (state, global mean loss)``.
 
     Batch arrays must have leading dim divisible by the total device count
-    (the batch splits over both mesh axes).
+    (the batch splits over both mesh axes).  ``lookup`` picks the embedding
+    lookup collective: ``allgather`` (default; robust to any id skew) or
+    ``alltoall`` (SparseCore-style routing — ~R× fewer ICI bytes; needs
+    near-uniform ids, see parallel/alltoall.py).
     """
     model = _pad_model_vocab(model, mesh)
     num_rows_global = model.vocabulary_size
     from fast_tffm_tpu.trainer import batch_loss
 
     def shard_body(table, accum, dense, dense_acc, batch: Batch):
-        rows = sharded_gather(table, batch.ids)
+        # Built per trace: the capacity is sized from THIS trace's batch
+        # shape (a cached closure would pin a stale capacity across jit
+        # retraces with bigger batches and spuriously overflow).
+        gather = _make_gather(mesh, batch.ids.shape, lookup, capacity_factor)
+        rows = gather(table, batch.ids)
 
         def loss_fn(rows, dense):
             scores = model.score(rows, dense, batch)
@@ -194,12 +220,15 @@ def make_sharded_train_step(model, learning_rate: float, mesh: Mesh):
     return step
 
 
-def make_sharded_predict_step(model, mesh: Mesh):
+def make_sharded_predict_step(
+    model, mesh: Mesh, *, lookup: str = "allgather", capacity_factor: float = 2.0
+):
     """Returns jitted SPMD ``predict(state, batch) -> sigmoid scores [B]``."""
     model = _pad_model_vocab(model, mesh)
 
     def shard_body(table, dense, batch: Batch):
-        rows = sharded_gather(table, batch.ids)
+        gather = _make_gather(mesh, batch.ids.shape, lookup, capacity_factor)
+        rows = gather(table, batch.ids)
         scores = jax.nn.sigmoid(model.score(rows, dense, batch))
         # Replicate the (tiny, [B]) score vector so the result is fetchable
         # on every process of a multi-host mesh — a P(('data','row'))-sharded

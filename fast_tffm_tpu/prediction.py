@@ -77,6 +77,13 @@ def _run_predict(cfg: Config, state, predict_step, max_nnz, log=print, mesh=None
         for parsed, w in prefetch(stream, depth=cfg.queue_size):
             b = to_batch(parsed, w)
             scores = np.asarray(predict_step(state, b))
+            if not np.isfinite(scores).all():
+                raise RuntimeError(
+                    "non-finite scores — an alltoall-lookup capacity overflow "
+                    "(raise lookup_capacity_factor or use lookup=allgather) "
+                    "or a diverged model; refusing to write a poisoned "
+                    f"score file to {cfg.score_path}"
+                )
             if remaining is not None:
                 take = min(remaining, len(scores))
                 remaining -= take
@@ -125,5 +132,12 @@ def dist_predict(cfg: Config, log=print, mesh=None) -> str:
     state = init_sharded_state(model, mesh, jax.random.key(0), cfg.init_accumulator_value)
     state = restore_checkpoint(cfg.model_file, state)
     return _run_predict(
-        cfg, state, make_sharded_predict_step(model, mesh), max_nnz, log, mesh=mesh
+        cfg,
+        state,
+        make_sharded_predict_step(
+            model, mesh, lookup=cfg.lookup, capacity_factor=cfg.lookup_capacity_factor
+        ),
+        max_nnz,
+        log,
+        mesh=mesh,
     )

@@ -49,6 +49,22 @@ def scan_max_nnz(cfg: Config) -> int:
     return max(1, widest)
 
 
+def _check_finite(loss: float, cfg: Config) -> None:
+    """Abort on a non-finite loss instead of training on (and eventually
+    checkpointing) poisoned state."""
+    if not np.isfinite(loss):
+        hint = (
+            "an alltoall-lookup capacity overflow — raise "
+            "lookup_capacity_factor or use lookup=allgather"
+            if cfg.lookup == "alltoall"
+            else "a diverged model — lower learning_rate"
+        )
+        raise RuntimeError(
+            f"training loss is {loss}; likely {hint}.  Aborting before the "
+            "next checkpoint overwrites the last good state."
+        )
+
+
 _TRAIN_WEIGHTS = object()  # sentinel: apply cfg.weight_files (train files only)
 
 
@@ -184,6 +200,7 @@ def _run_training(
                 if len(losses) >= cfg.log_every:
                     rate = meter.rate()
                     mean_loss = np.mean([float(l) for l in losses])
+                    _check_finite(mean_loss, cfg)
                     log(
                         f"step {int(state.step)} epoch {epoch} "
                         f"loss {mean_loss:.5f} "
@@ -200,6 +217,11 @@ def _run_training(
                     meter.reset()
             if stop_requested.is_set():
                 break
+            if losses:
+                # Epoch boundary syncs anyway (validation / checkpoint); a
+                # poisoned state must abort BEFORE the save below replaces
+                # the last good checkpoint.
+                _check_finite(float(losses[-1]), cfg)
             if cfg.validation_files:
                 val_auc = evaluate(cfg, predict_step, state, cfg.validation_files, max_nnz)
                 log(f"epoch {epoch} validation auc {val_auc:.5f}")
@@ -296,8 +318,13 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
     if resume:
         state = restore_checkpoint(cfg.model_file, state)
         log(f"resumed from {cfg.model_file} at step {int(state.step)}")
-    step_fn = make_sharded_train_step(model, cfg.learning_rate, mesh)
-    predict_step = make_sharded_predict_step(model, mesh)
+    step_fn = make_sharded_train_step(
+        model, cfg.learning_rate, mesh,
+        lookup=cfg.lookup, capacity_factor=cfg.lookup_capacity_factor,
+    )
+    predict_step = make_sharded_predict_step(
+        model, mesh, lookup=cfg.lookup, capacity_factor=cfg.lookup_capacity_factor
+    )
 
     train_stream = to_batch = examples_per_step = evaluate = None
     nproc = jax.process_count()

@@ -156,3 +156,86 @@ def test_dist_batch_size_must_divide_mesh(tmp_path):
     for fn in (dist_train, dist_predict):
         with pytest.raises(ValueError, match=f"not divisible by the {n}-device mesh"):
             fn(cfg, log=lambda *_: None)
+
+
+@pytest.mark.parametrize(
+    "mesh_shape", [(1, 8), (2, 4), (4, 2)], ids=lambda s: f"data{s[0]}xrow{s[1]}"
+)
+def test_alltoall_lookup_matches_allgather(mesh_shape):
+    """The routed (all_to_all) lookup must produce the SAME training
+    trajectory as the all-gather lookup — same collectives semantics,
+    fewer bytes.  Uniform ids keep every destination within capacity."""
+    model = FMModel(vocabulary_size=V, factor_num=4, order=2)
+    mesh = make_mesh(*mesh_shape)
+    rng = np.random.default_rng(4)
+    batches = _batches(rng, n=3)
+
+    ag_state = init_sharded_state(model, mesh, jax.random.key(9))
+    ag_step = make_sharded_train_step(model, 0.1, mesh)
+    aa_state = init_sharded_state(model, mesh, jax.random.key(9))
+    aa_step = make_sharded_train_step(model, 0.1, mesh, lookup="alltoall")
+
+    for b in batches:
+        ag_state, ag_loss = ag_step(ag_state, b)
+        aa_state, aa_loss = aa_step(aa_state, b)
+        np.testing.assert_allclose(float(aa_loss), float(ag_loss), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(aa_state.table), np.asarray(ag_state.table), rtol=1e-5, atol=1e-7
+    )
+
+    ag_pred = make_sharded_predict_step(model, mesh)
+    aa_pred = make_sharded_predict_step(model, mesh, lookup="alltoall")
+    np.testing.assert_allclose(
+        np.asarray(aa_pred(aa_state, batches[0])),
+        np.asarray(ag_pred(ag_state, batches[0])),
+        rtol=1e-5,
+    )
+
+
+def test_alltoall_overflow_poisons_not_corrupts():
+    """Skewed ids that exceed a destination's capacity must surface as NaN
+    (visible failure), never as silently wrong rows."""
+    model = FMModel(vocabulary_size=V, factor_num=4)
+    mesh = make_mesh(1, 8)
+    step = make_sharded_train_step(model, 0.1, mesh, lookup="alltoall", capacity_factor=1.0)
+    rng = np.random.default_rng(0)
+    # Large batch so capacity (factor·M/R + tail slack) sits well below M,
+    # then slam every id onto shard 0's row range.
+    b = _batches(rng, n=1, B=256)[0]
+    skewed = Batch(
+        labels=b.labels,
+        ids=jnp.zeros_like(b.ids),
+        vals=b.vals,
+        fields=b.fields,
+        weights=b.weights,
+    )
+    _, loss = step(init_sharded_state(model, mesh, jax.random.key(0)), skewed)
+    assert np.isnan(float(loss))
+    # The same batch through the default lookup is finite (fresh state —
+    # the train step donates its input state).
+    _, ok_loss = make_sharded_train_step(model, 0.1, mesh)(
+        init_sharded_state(model, mesh, jax.random.key(0)), skewed
+    )
+    assert np.isfinite(float(ok_loss))
+
+
+def test_alltoall_overflow_aborts_training_before_checkpoint(tmp_path):
+    """End-to-end: a capacity overflow must abort the RUN (RuntimeError
+    naming the remedy), not keep training on NaN state or overwrite the
+    checkpoint with it."""
+    from fast_tffm_tpu.config import Config
+    from fast_tffm_tpu.training import dist_train
+
+    f = tmp_path / "skew.libsvm"
+    # Every row: 8 occurrences of id 0 — all routed to shard 0.
+    f.write_text("".join("1 " + " ".join("0:1.0" for _ in range(8)) + "\n" for _ in range(64)))
+    cfg = Config(
+        model="fm", factor_num=2, vocabulary_size=64,
+        model_file=str(tmp_path / "m.ckpt"),
+        train_files=(str(f),),
+        epoch_num=1, batch_size=64, learning_rate=0.1, log_every=1,
+        row_parallel=8, lookup="alltoall", lookup_capacity_factor=0.5,
+    ).validate()
+    with pytest.raises(RuntimeError, match="lookup_capacity_factor"):
+        dist_train(cfg, log=lambda *_: None)
+    assert not (tmp_path / "m.ckpt").exists()  # no poisoned checkpoint
