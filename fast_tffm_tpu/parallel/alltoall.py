@@ -34,9 +34,9 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
-from fast_tffm_tpu.parallel.mesh import ROW_AXIS
+from fast_tffm_tpu.parallel.mesh import DATA_AXIS, ROW_AXIS
 
-__all__ = ["routed_gather", "capacity_for"]
+__all__ = ["routed_gather", "routed_update", "capacity_for"]
 
 
 def capacity_for(ids_per_chip: int, row_parallel: int, capacity_factor: float) -> int:
@@ -51,6 +51,30 @@ def capacity_for(ids_per_chip: int, row_parallel: int, capacity_factor: float) -
     c = int(capacity_factor * mean + 4.0 * mean**0.5 + 8.0)
     c = ((c + 7) // 8) * 8
     return max(8, min(c, ids_per_chip))
+
+
+def _bucketize(owner: jnp.ndarray, n_buckets: int, capacity: int):
+    """Stable-sort elements by ``owner`` and assign each a send-buffer slot.
+
+    Shared by the lookup and update routes (they must agree exactly —
+    both directions use one capacity).  Owners >= n_buckets (sentinels)
+    are excluded from counts and land on out-of-range scatter indices.
+
+    Returns (order, sorted_owner, send_pos, in_cap_sorted, overflow):
+    ``order`` is the sort permutation; element ``order[j]`` goes to slot
+    ``[sorted_owner[j], send_pos[j]]`` (send_pos == capacity → caller
+    scatters with mode='drop'); ``overflow`` is True when any bucket
+    exceeded capacity."""
+    m = owner.shape[0]
+    order = jnp.argsort(owner, stable=True)
+    sorted_owner = owner[order]
+    counts = jnp.bincount(owner, length=n_buckets)
+    starts = jnp.cumsum(counts) - counts
+    pos = jnp.arange(m) - starts[jnp.minimum(sorted_owner, n_buckets - 1)]
+    in_cap = pos < capacity
+    send_pos = jnp.where(in_cap, pos, capacity)
+    overflow = jnp.any(counts > capacity)
+    return order, sorted_owner, send_pos, in_cap, overflow
 
 
 def routed_gather(table_shard: jnp.ndarray, ids: jnp.ndarray, capacity: int) -> jnp.ndarray:
@@ -69,21 +93,13 @@ def routed_gather(table_shard: jnp.ndarray, ids: jnp.ndarray, capacity: int) -> 
     M = B * N
     flat = ids.reshape(M)
     owner = flat // shard_rows  # [M] in [0, R)
-
-    # Stable sort by owner; position of each element within its bucket.
-    order = jnp.argsort(owner, stable=True)
+    order, sorted_owner, send_pos, in_cap, overflow = _bucketize(owner, R, capacity)
     sorted_ids = flat[order]
-    sorted_owner = owner[order]
-    counts = jnp.bincount(owner, length=R)  # [R]
-    starts = jnp.cumsum(counts) - counts
-    pos = jnp.arange(M) - starts[sorted_owner]  # [M] slot within bucket
-    overflow = jnp.any(counts > capacity)
 
     # Scatter into the [R, C] send buffer; slots beyond capacity drop (their
     # rows are poisoned below), unused slots carry an out-of-range sentinel.
     sentinel = jnp.int32(shard_rows * R)
     send_ids = jnp.full((R, capacity), sentinel, dtype=flat.dtype)
-    send_pos = jnp.where(pos < capacity, pos, capacity)  # capacity → dropped
     send_ids = send_ids.at[sorted_owner, send_pos].set(sorted_ids, mode="drop")
 
     # Exchange requests; serve locally; exchange answers.
@@ -95,9 +111,71 @@ def routed_gather(table_shard: jnp.ndarray, ids: jnp.ndarray, capacity: int) -> 
 
     # recv_rows[s, c] answers MY request in send slot [s, c]; invert the
     # bucket placement, then the sort.
-    in_cap = pos < capacity
-    mine_sorted = recv_rows[sorted_owner, jnp.minimum(pos, capacity - 1)]
+    mine_sorted = recv_rows[sorted_owner, jnp.minimum(send_pos, capacity - 1)]
     mine_sorted = mine_sorted * in_cap[:, None].astype(mine_sorted.dtype)
     out = jnp.zeros((M, table_shard.shape[-1]), table_shard.dtype).at[order].set(mine_sorted)
     out = jnp.where(overflow, jnp.nan, out)
     return out.reshape(B, N, -1)
+
+
+def routed_update(
+    table_shard: jnp.ndarray,
+    accum_shard: jnp.ndarray,
+    ids: jnp.ndarray,
+    row_grads: jnp.ndarray,
+    lr: float,
+    num_rows_global: int,
+    capacity: int,
+):
+    """Sparse Adagrad update via routed gradients (the all-to-all analog of
+    ``embedding.sharded_sparse_adagrad_update``).
+
+    Per chip: dedup local occurrences, route each (id, summed grad) to its
+    home shard over ROW (all_to_all, capacity C per destination), then
+    all_gather the received buffers over DATA only — every replica of a
+    row shard sees the identical union of contributions, dedups it once
+    more, and applies Adagrad exactly once per row.  ICI bytes
+    ~ data·(R·C)·D ≈ data·slack·M·D instead of data·row·M·D.
+
+    Returns (table, accum, overflow) — ``overflow`` is a GLOBAL flag
+    (psum over both axes): any chip that had to drop contributions raises
+    it, and the caller must poison its loss with it so the run aborts
+    before a silently-partial update is ever checkpointed.  (Dropped
+    entries leave the tables CONSISTENT across replicas — every replica
+    sees the same post-drop union — just not the full-batch update.)
+    """
+    from fast_tffm_tpu.optim import dedup_rows
+
+    D = table_shard.shape[-1]
+    shard_rows = table_shard.shape[0]
+    base = lax.axis_index(ROW_AXIS) * shard_rows
+    R = lax.axis_size(ROW_AXIS)
+    uids, gsum = dedup_rows(ids.reshape(-1), row_grads.reshape(-1, D), num_rows_global)
+    # Sentinel uids (== num_rows_global) route to owner R: excluded from
+    # counts (bincount length R) and dropped by the out-of-range scatter.
+    owner = jnp.where(uids >= num_rows_global, R, uids // shard_rows)
+    order, sorted_owner, send_pos, _in_cap, overflow = _bucketize(owner, R, capacity)
+    sorted_ids = uids[order]
+    sorted_g = gsum[order]
+
+    sentinel = jnp.asarray(num_rows_global, uids.dtype)
+    send_ids = jnp.full((R, capacity), sentinel, dtype=uids.dtype)
+    send_g = jnp.zeros((R, capacity, D), gsum.dtype)
+    send_ids = send_ids.at[sorted_owner, send_pos].set(sorted_ids, mode="drop")
+    send_g = send_g.at[sorted_owner, send_pos].set(sorted_g, mode="drop")
+
+    recv_ids = lax.all_to_all(send_ids, ROW_AXIS, 0, 0, tiled=True)  # [R, C]
+    recv_g = lax.all_to_all(send_g, ROW_AXIS, 0, 0, tiled=True)  # [R, C, D]
+    # Data-axis union: every replica of this row shard must apply the SAME
+    # update, so gather all data-peers' received contributions.
+    all_ids = lax.all_gather(recv_ids.reshape(-1), DATA_AXIS, tiled=True)
+    all_g = lax.all_gather(recv_g.reshape(-1, D), DATA_AXIS, tiled=True)
+    guids, ggsum = dedup_rows(all_ids, all_g, num_rows_global)
+
+    from fast_tffm_tpu.parallel.embedding import apply_shard_adagrad
+
+    table_shard, accum_shard = apply_shard_adagrad(
+        table_shard, accum_shard, guids, ggsum, lr, base
+    )
+    overflow = lax.psum(overflow.astype(jnp.int32), (DATA_AXIS, ROW_AXIS)) > 0
+    return table_shard, accum_shard, overflow

@@ -33,7 +33,25 @@ from jax import lax
 from fast_tffm_tpu.optim import AdagradState, dedup_rows
 from fast_tffm_tpu.parallel.mesh import DATA_AXIS, ROW_AXIS
 
-__all__ = ["sharded_gather", "sharded_sparse_adagrad_update"]
+__all__ = ["sharded_gather", "sharded_sparse_adagrad_update", "apply_shard_adagrad"]
+
+
+def apply_shard_adagrad(table_shard, accum_shard, guids, ggsum, lr, base):
+    """Adagrad on this shard's rows from globally-combined unique grads.
+
+    The one place the sharded Adagrad math lives — the all-gather update
+    below and the all-to-all routed update (parallel/alltoall.py) must
+    stay numerically identical, and both end here.  ``guids`` out of this
+    shard's range (other shards' rows, dedup sentinels) drop."""
+    shard_rows = table_shard.shape[0]
+    local = guids - base
+    owned = (local >= 0) & (local < shard_rows)
+    local = jnp.where(owned, local, shard_rows)  # out of range → mode='drop'
+    acc_rows = accum_shard[jnp.minimum(local, shard_rows - 1)] + ggsum * ggsum
+    upd_rows = table_shard[jnp.minimum(local, shard_rows - 1)] - lr * ggsum / jnp.sqrt(acc_rows)
+    accum_shard = accum_shard.at[local].set(acc_rows, mode="drop")
+    table_shard = table_shard.at[local].set(upd_rows, mode="drop")
+    return table_shard, accum_shard
 
 
 def sharded_gather(table_shard: jax.Array, ids: jax.Array) -> jax.Array:
@@ -82,14 +100,5 @@ def sharded_sparse_adagrad_update(
     # segment and are dropped again below.
     guids, ggsum = dedup_rows(all_uids, all_gsum, num_rows_global)
 
-    shard_rows = table_shard.shape[0]
-    base = lax.axis_index(ROW_AXIS) * shard_rows
-    local = guids - base
-    owned = (local >= 0) & (local < shard_rows)
-    local = jnp.where(owned, local, shard_rows)  # out of range → mode='drop'
-
-    acc_rows = accum_shard[jnp.minimum(local, shard_rows - 1)] + ggsum * ggsum
-    upd_rows = table_shard[jnp.minimum(local, shard_rows - 1)] - lr * ggsum / jnp.sqrt(acc_rows)
-    accum_shard = accum_shard.at[local].set(acc_rows, mode="drop")
-    table_shard = table_shard.at[local].set(upd_rows, mode="drop")
-    return table_shard, accum_shard
+    base = lax.axis_index(ROW_AXIS) * table_shard.shape[0]
+    return apply_shard_adagrad(table_shard, accum_shard, guids, ggsum, lr, base)

@@ -130,16 +130,19 @@ def _make_gather(mesh: Mesh, local_ids_shape, lookup: str, capacity_factor: floa
     """Pick the lookup collective: all-gather (default) or all-to-all routing.
 
     ``local_ids_shape`` is the PER-CHIP [B_local, N] shape (this is called
-    from inside the shard_map body at trace time)."""
+    from inside the shard_map body at trace time).  Returns
+    ``(gather_fn, capacity)`` — capacity is None on the all-gather path
+    and is THE single sizing both all-to-all directions share (the routed
+    update must use the same value)."""
     if lookup == "allgather":
-        return sharded_gather
+        return sharded_gather, None
     if lookup != "alltoall":
         raise ValueError(f"unknown lookup {lookup!r} (allgather | alltoall)")
     from fast_tffm_tpu.parallel.alltoall import capacity_for, routed_gather
 
     b_local, n = local_ids_shape
     cap = capacity_for(b_local * n, mesh.shape[ROW_AXIS], capacity_factor)
-    return lambda table, ids: routed_gather(table, ids, cap)
+    return (lambda table, ids: routed_gather(table, ids, cap)), cap
 
 
 def make_sharded_train_step(
@@ -150,8 +153,9 @@ def make_sharded_train_step(
 
     Batch arrays must have leading dim divisible by the total device count
     (the batch splits over both mesh axes).  ``lookup`` picks the embedding
-    lookup collective: ``allgather`` (default; robust to any id skew) or
-    ``alltoall`` (SparseCore-style routing — ~R× fewer ICI bytes; needs
+    collective for BOTH directions: ``allgather`` (default; robust to any
+    id skew) or ``alltoall`` (SparseCore-style routing for the lookup AND
+    the gradient update — ~R× fewer ICI bytes each way; needs
     near-uniform ids, see parallel/alltoall.py).
     """
     model = _pad_model_vocab(model, mesh)
@@ -162,7 +166,7 @@ def make_sharded_train_step(
         # Built per trace: the capacity is sized from THIS trace's batch
         # shape (a cached closure would pin a stale capacity across jit
         # retraces with bigger batches and spuriously overflow).
-        gather = _make_gather(mesh, batch.ids.shape, lookup, capacity_factor)
+        gather, cap = _make_gather(mesh, batch.ids.shape, lookup, capacity_factor)
         rows = gather(table, batch.ids)
 
         def loss_fn(rows, dense):
@@ -180,9 +184,19 @@ def make_sharded_train_step(
         grad_fn = jax.value_and_grad(loss_fn, argnums=(0, 1), has_aux=True)
         (_, data_loss_local), (g_rows, g_dense) = grad_fn(rows, dense)
 
-        table, accum = sharded_sparse_adagrad_update(
-            table, accum, batch.ids, g_rows, learning_rate, num_rows_global
-        )
+        if lookup == "alltoall":
+            from fast_tffm_tpu.parallel.alltoall import routed_update
+
+            table, accum, overflow = routed_update(
+                table, accum, batch.ids, g_rows, learning_rate, num_rows_global, cap
+            )
+            # A dropped contribution must never persist silently: NaN the
+            # loss so the training loop aborts before checkpointing.
+            data_loss_local = jnp.where(overflow, jnp.nan, data_loss_local)
+        else:
+            table, accum = sharded_sparse_adagrad_update(
+                table, accum, batch.ids, g_rows, learning_rate, num_rows_global
+            )
         if jax.tree.leaves(dense):
             g_dense = lax.psum(g_dense, _BOTH)
             dense, dense_acc = dense_adagrad_update(
@@ -227,7 +241,7 @@ def make_sharded_predict_step(
     model = _pad_model_vocab(model, mesh)
 
     def shard_body(table, dense, batch: Batch):
-        gather = _make_gather(mesh, batch.ids.shape, lookup, capacity_factor)
+        gather, _cap = _make_gather(mesh, batch.ids.shape, lookup, capacity_factor)
         rows = gather(table, batch.ids)
         scores = jax.nn.sigmoid(model.score(rows, dense, batch))
         # Replicate the (tiny, [B]) score vector so the result is fetchable
