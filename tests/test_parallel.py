@@ -239,3 +239,23 @@ def test_alltoall_overflow_aborts_training_before_checkpoint(tmp_path):
     with pytest.raises(RuntimeError, match="lookup_capacity_factor"):
         dist_train(cfg, log=lambda *_: None)
     assert not (tmp_path / "m.ckpt").exists()  # no poisoned checkpoint
+
+
+def test_lookup_choice_changes_emitted_collectives():
+    """The compiled HLO must actually contain the intended collectives:
+    all-gather + reduce-scatter for the default lookup; all-to-all (and no
+    row reduce-scatter) for the routed one."""
+    model = FMModel(vocabulary_size=V, factor_num=4)
+    mesh = make_mesh(2, 4)
+    state = init_sharded_state(model, mesh, jax.random.key(0))
+    rng = np.random.default_rng(0)
+    b = _batches(rng, n=1)[0]
+
+    def hlo_for(lookup):
+        step = make_sharded_train_step(model, 0.1, mesh, lookup=lookup)
+        return jax.jit(lambda s, bb: step(s, bb)).lower(state, b).compile().as_text()
+
+    ag = hlo_for("allgather")
+    assert "all-to-all" not in ag and "reduce-scatter" in ag
+    aa = hlo_for("alltoall")
+    assert "all-to-all" in aa and "reduce-scatter" not in aa
