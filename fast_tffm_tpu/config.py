@@ -44,6 +44,7 @@ class Config:
     bias_lambda: float = 0.0
     init_accumulator_value: float = 0.1
     thread_num: int = 1  # host-side parse workers (reference: queue threads)
+    binary_cache: bool = False  # parse text once into <file>.fmb, stream that
     queue_size: int = 8  # prefetch depth
     log_every: int = 100
     save_every_epochs: int = 1
@@ -158,6 +159,7 @@ def load_config(path: str) -> Config:
         t, "init_accumulator_value", float, cfg.init_accumulator_value
     )
     cfg.thread_num = get(t, "thread_num", int, cfg.thread_num)
+    cfg.binary_cache = get(t, "binary_cache", ini._convert_to_boolean, cfg.binary_cache)
     cfg.queue_size = get(t, "queue_size", int, cfg.queue_size)
     cfg.log_every = get(t, "log_every", int, cfg.log_every)
     cfg.save_every_epochs = get(t, "save_every_epochs", int, cfg.save_every_epochs)

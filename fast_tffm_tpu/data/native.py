@@ -343,17 +343,12 @@ def native_batch_stream(
             finally:
                 counter = int(lib.fm_reader_counter(handle))
                 lib.fm_reader_close(handle)
-    if filled and not drop_remainder and (pad_to_batches is None or emitted < pad_to_batches):
-        # Rows beyond `filled` are already zero (fresh buffers) and carry
-        # weight 0 — identical to pipeline.pad_batch on the Python path.
-        yield ParsedBatch(labels, ids, vals, fields, nnz), w
-        emitted += 1
-        filled = 0
-    if pad_to_batches is not None:
-        while emitted < pad_to_batches:
-            labels, ids, vals, fields, nnz, w = alloc()  # all-zero, weight-0
-            yield ParsedBatch(labels, ids, vals, fields, nnz), w
-            emitted += 1
+    from fast_tffm_tpu.data.pipeline import emit_assembled_tail
+
+    yield from emit_assembled_tail(
+        alloc, (labels, ids, vals, fields, nnz, w), filled, emitted,
+        drop_remainder, pad_to_batches,
+    )
 
 
 # (path, mtime_ns, size) -> (n_lines, widest).  Startup calls scan_files /
@@ -371,6 +366,16 @@ def _scan_one(path) -> tuple[int, int]:
     hit = _scan_cache.get(key)
     if hit is not None:
         return hit
+    from fast_tffm_tpu.data.binary import is_fmb, open_fmb
+
+    if is_fmb(path):
+        f = open_fmb(path)
+        # Stored width is the file's widest row only when the converter was
+        # not given an explicit (larger) max_nnz; either way it bounds the
+        # widest row, which is all scan callers need.
+        out = (f.n_rows, f.width)
+        _scan_cache[key] = out
+        return out
     native = load_native_parser()
     if native is not None:
         n = ctypes.c_int64()
@@ -419,6 +424,11 @@ def count_lines(files) -> int:
         hit = _scan_cache.get((path, st.st_mtime_ns, st.st_size))
         if hit is not None:
             total += hit[0]
+            continue
+        from fast_tffm_tpu.data.binary import is_fmb
+
+        if is_fmb(path):
+            total += _scan_one(path)[0]
         elif native is not None:
             n = int(native._lib.fm_count_lines(path.encode()))
             if n < 0:

@@ -22,7 +22,30 @@ import numpy as np
 
 from fast_tffm_tpu.data.libsvm import ParsedBatch, pad_batch
 
-__all__ = ["line_stream", "batch_stream"]
+__all__ = ["line_stream", "batch_stream", "emit_assembled_tail"]
+
+
+def emit_assembled_tail(alloc, buffers, filled, emitted, drop_remainder, pad_to_batches):
+    """Shared end-of-stream semantics for the buffer-assembling streams
+    (native.native_batch_stream, binary.fmb_batch_stream).
+
+    ``buffers`` is the (labels, ids, vals, fields, nnz, weights) tuple with
+    ``filled`` real rows; rows beyond ``filled`` are zero with weight 0
+    (fresh ``alloc()`` output), which is exactly ``pad_batch``'s padding.
+    Emits the short remainder batch unless ``drop_remainder``, then all-
+    empty weight-0 batches up to ``pad_to_batches`` (fixed multi-host step
+    counts).  One definition so the three streams cannot drift — their
+    bit-identical-batches contract is also pinned by the parity tests.
+    """
+    labels, ids, vals, fields, nnz, w = buffers
+    if filled and not drop_remainder and (pad_to_batches is None or emitted < pad_to_batches):
+        yield ParsedBatch(labels, ids, vals, fields, nnz), w
+        emitted += 1
+    if pad_to_batches is not None:
+        while emitted < pad_to_batches:
+            labels, ids, vals, fields, nnz, w = alloc()  # all-zero, weight-0
+            yield ParsedBatch(labels, ids, vals, fields, nnz), w
+            emitted += 1
 
 
 def line_stream(
@@ -86,6 +109,7 @@ def batch_stream(
     drop_remainder: bool = False,
     pad_to_batches: int | None = None,
     parser=None,
+    binary_cache: bool = False,
 ) -> Iterator[tuple[ParsedBatch, np.ndarray]]:
     """Yield (ParsedBatch, example_weights[batch]) with static shapes.
 
@@ -104,7 +128,14 @@ def batch_stream(
 
     ``parser`` overrides the line parser (signature of
     ``libsvm.parse_lines``); data/native.py passes the C++ implementation.
+
+    FMB files (data/binary.py) route to the memmap stream — no parsing at
+    all; a mix of text and FMB in one list is rejected (the two halves
+    would disagree about what a "line" is under sharding).
+    ``binary_cache=True`` converts text files to ``<file>.fmb`` caches
+    first (reused while fresh) and streams those.
     """
+    from fast_tffm_tpu.data.binary import ensure_fmb_cache, fmb_batch_stream, is_fmb
     from fast_tffm_tpu.data.libsvm import parse_lines
     from fast_tffm_tpu.data.native import NativeParser, native_batch_stream
 
@@ -113,6 +144,37 @@ def batch_stream(
             "pad_to_batches requires max_nnz (pad batches must share the "
             "data batches' static feature width)"
         )
+
+    if binary_cache:
+        files = ensure_fmb_cache(
+            files,
+            vocabulary_size=vocabulary_size,
+            hash_feature_id=hash_feature_id,
+            max_nnz=max_nnz,
+            parser=parser,
+        )
+    fmb = [is_fmb(p) for p in files]
+    if any(fmb):
+        if not all(fmb):
+            raise ValueError(
+                "cannot mix FMB and text files in one stream: "
+                f"{[p for p, b in zip(files, fmb) if not b]} are not FMB"
+            )
+        yield from fmb_batch_stream(
+            files,
+            batch_size=batch_size,
+            vocabulary_size=vocabulary_size,
+            hash_feature_id=hash_feature_id,
+            max_nnz=max_nnz,
+            epochs=epochs,
+            shard_index=shard_index,
+            shard_count=shard_count,
+            shard_block=shard_block,
+            weights=weights,
+            drop_remainder=drop_remainder,
+            pad_to_batches=pad_to_batches,
+        )
+        return
 
     if isinstance(parser, NativeParser) and max_nnz is not None:
         # Full-native path: file reads, sharding, and parsing all in C++

@@ -30,25 +30,38 @@ class Batch:
     labels: jax.Array  # [B] f32
     ids: jax.Array  # [B, N] i32
     vals: jax.Array  # [B, N] f32 (0 = padding)
-    fields: jax.Array  # [B, N] i32
+    fields: jax.Array  # [B, N] i32 ([B, 0] when the model ignores fields)
     weights: jax.Array  # [B] f32 example weights (0 = padded row)
 
     @staticmethod
-    def from_parsed(parsed, weights=None):
+    def from_parsed(parsed, weights=None, *, with_fields: bool = True):
+        """Host ParsedBatch → device Batch (the per-step H2D transfer).
+
+        ``with_fields=False`` ships a [B, 0] placeholder instead of the
+        [B, N] field matrix — only FFM reads ``fields`` (Model.uses_fields),
+        and for the other models the all-zero int32 matrix is a third of
+        the transferred bytes on an input-bound host.
+        """
         import numpy as np
 
         w = np.ones_like(parsed.labels) if weights is None else weights
+        fields = (
+            parsed.fields
+            if with_fields
+            else np.zeros((parsed.fields.shape[0], 0), np.int32)
+        )
         return Batch(
             labels=jnp.asarray(parsed.labels),
             ids=jnp.asarray(parsed.ids.astype(np.int32, copy=False)),
             vals=jnp.asarray(parsed.vals),
-            fields=jnp.asarray(parsed.fields),
+            fields=jnp.asarray(fields),
             weights=jnp.asarray(w),
         )
 
 
 class Model(Protocol):
     vocabulary_size: int
+    uses_fields: bool  # True when score() reads batch.fields (FFM only)
 
     @property
     def row_dim(self) -> int:

@@ -39,6 +39,8 @@ class DeepFMModel:
     # gathers + VPU elementwise work, not MXU work.
     compute_dtype: str = "float32"  # float32 | bfloat16
 
+    uses_fields = False  # slots are positional (num_fields = max_nnz)
+
     @property
     def row_dim(self) -> int:
         return 1 + self.factor_num

@@ -36,6 +36,8 @@ class FFMModel:
     factor_lambda: float = 0.0
     bias_lambda: float = 0.0
 
+    uses_fields = True  # score() one-hots batch.fields per slot
+
     @property
     def row_dim(self) -> int:
         return 1 + self.num_fields * self.factor_num

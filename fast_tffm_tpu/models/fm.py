@@ -26,6 +26,8 @@ class FMModel:
     factor_lambda: float = 0.0
     bias_lambda: float = 0.0
 
+    uses_fields = False  # score() never reads batch.fields
+
     @property
     def row_dim(self) -> int:
         return 1 + self.factor_num

@@ -49,7 +49,7 @@ __all__ = [
 ]
 
 
-def make_global_batch(mesh: Mesh, parsed, w) -> Batch:
+def make_global_batch(mesh: Mesh, parsed, w, *, with_fields: bool = True) -> Batch:
     """Assemble a GLOBAL batch from this process's local input shard.
 
     Multi-host input sharding: each process parses only rows
@@ -66,11 +66,16 @@ def make_global_batch(mesh: Mesh, parsed, w) -> Batch:
     vec = NamedSharding(mesh, P(_BOTH))
     mat = NamedSharding(mesh, P(_BOTH, None))
     mk = jax.make_array_from_process_local_data
+    fields = (
+        np.ascontiguousarray(parsed.fields)
+        if with_fields
+        else np.zeros((parsed.fields.shape[0], 0), np.int32)
+    )
     return Batch(
         labels=mk(vec, np.ascontiguousarray(parsed.labels)),
         ids=mk(mat, np.ascontiguousarray(parsed.ids.astype(np.int32, copy=False))),
         vals=mk(mat, np.ascontiguousarray(parsed.vals)),
-        fields=mk(mat, np.ascontiguousarray(parsed.fields)),
+        fields=mk(mat, fields),
         weights=mk(vec, np.ascontiguousarray(w)),
     )
 

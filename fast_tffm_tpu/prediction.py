@@ -23,7 +23,9 @@ from fast_tffm_tpu.utils.prefetch import prefetch
 __all__ = ["predict", "dist_predict"]
 
 
-def _run_predict(cfg: Config, state, predict_step, max_nnz, log=print, mesh=None) -> str:
+def _run_predict(
+    cfg: Config, state, predict_step, max_nnz, log=print, mesh=None, with_fields=True
+) -> str:
     if not cfg.predict_files:
         raise ValueError("no predict_files configured")
     # Multi-host: the sharded predict step is ONE SPMD program over the
@@ -38,7 +40,7 @@ def _run_predict(cfg: Config, state, predict_step, max_nnz, log=print, mesh=None
     is_lead = jax.process_index() == 0
     shard_input = mesh is not None and nproc > 1 and cfg.batch_size % nproc == 0
     stream_kw = {}
-    to_batch = Batch.from_parsed
+    to_batch = lambda parsed, w: Batch.from_parsed(parsed, w, with_fields=with_fields)
     remaining = None
     bs = cfg.batch_size  # per-process stream batch size
     if shard_input:
@@ -55,7 +57,7 @@ def _run_predict(cfg: Config, state, predict_step, max_nnz, log=print, mesh=None
             shard_block=bs,
             pad_to_batches=-(-total // cfg.batch_size),  # ceil
         )
-        to_batch = lambda parsed, w: make_global_batch(mesh, parsed, w)
+        to_batch = lambda parsed, w: make_global_batch(mesh, parsed, w, with_fields=with_fields)
         # Padding (short final batch + all-empty tail batches) sits strictly
         # after the data rows, so the real scores are exactly the first
         # `total` of the concatenated stream — no global weight mask needed.
@@ -72,6 +74,7 @@ def _run_predict(cfg: Config, state, predict_step, max_nnz, log=print, mesh=None
             hash_feature_id=cfg.hash_feature_id,
             max_nnz=max_nnz,
             parser=best_parser(cfg.thread_num),
+            binary_cache=cfg.binary_cache,
             **stream_kw,
         )
         for parsed, w in prefetch(stream, depth=cfg.queue_size):
@@ -108,7 +111,9 @@ def predict(cfg: Config, log=print) -> str:
     max_nnz = scan_max_nnz(cfg)
     state = init_state(model, jax.random.key(0), cfg.init_accumulator_value)
     state = restore_checkpoint(cfg.model_file, state)
-    return _run_predict(cfg, state, make_predict_step(model), max_nnz, log)
+    return _run_predict(
+        cfg, state, make_predict_step(model), max_nnz, log, with_fields=model.uses_fields
+    )
 
 
 def dist_predict(cfg: Config, log=print, mesh=None) -> str:
@@ -140,4 +145,5 @@ def dist_predict(cfg: Config, log=print, mesh=None) -> str:
         max_nnz,
         log,
         mesh=mesh,
+        with_fields=model.uses_fields,
     )

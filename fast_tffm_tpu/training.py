@@ -83,6 +83,7 @@ def _stream(
             epochs=epochs,
             weights=weights,
             parser=best_parser(cfg.thread_num),
+            binary_cache=cfg.binary_cache,
             **shard_kw,
         ),
         depth=cfg.queue_size,
@@ -137,7 +138,10 @@ def _run_training(
     if to_batch is None:
         to_batch = Batch.from_parsed
     if evaluate is None:
-        evaluate = _evaluate
+        # Validation ships batches the same way training does (in particular
+        # the fields-skipping transfer for models that never read fields).
+        def evaluate(cfg, predict_step, state, files, max_nnz):
+            return _evaluate(cfg, predict_step, state, files, max_nnz, to_batch=to_batch)
     n_chips = jax.device_count()
     meter = Throughput()
     losses = []
@@ -268,7 +272,8 @@ def train(cfg: Config, *, resume: bool = False, log=print):
         log(f"resumed from {cfg.model_file} at step {int(state.step)}")
     step_fn = make_train_step(model, cfg.learning_rate)
     predict_step = make_predict_step(model)
-    return _run_training(cfg, state, step_fn, predict_step, max_nnz, log)
+    to_batch = lambda parsed, w: Batch.from_parsed(parsed, w, with_fields=model.uses_fields)
+    return _run_training(cfg, state, step_fn, predict_step, max_nnz, log, to_batch=to_batch)
 
 
 def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
@@ -326,7 +331,8 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
         model, mesh, lookup=cfg.lookup, capacity_factor=cfg.lookup_capacity_factor
     )
 
-    train_stream = to_batch = examples_per_step = evaluate = None
+    train_stream = examples_per_step = evaluate = None
+    to_batch = lambda parsed, w: Batch.from_parsed(parsed, w, with_fields=model.uses_fields)
     nproc = jax.process_count()
     if nproc > 1:
         from fast_tffm_tpu.data.native import count_lines
@@ -359,7 +365,7 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
             )
 
         def to_batch(parsed, w):
-            return make_global_batch(mesh, parsed, w)
+            return make_global_batch(mesh, parsed, w, with_fields=model.uses_fields)
 
         examples_per_step = cfg.batch_size
 

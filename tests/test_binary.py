@@ -1,0 +1,267 @@
+"""FMB packed binary dataset format: parity with the text pipelines.
+
+The contract under test: for the same source data and stream arguments, the
+FMB stream emits batches BIT-IDENTICAL to the text `batch_stream` (which is
+itself parity-tested against the native C++ stream) — across epochs,
+per-file weights, block-cyclic sharding, tail padding, and pad_to_batches.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from fast_tffm_tpu.data.binary import (
+    ensure_fmb_cache,
+    fmb_batch_stream,
+    is_fmb,
+    open_fmb,
+    write_fmb,
+)
+from fast_tffm_tpu.data.pipeline import batch_stream
+
+
+def _write_text(path, rows, rng, vocab=1000, ffm=False):
+    with open(path, "w") as f:
+        for _ in range(rows):
+            label = rng.integers(0, 2)
+            nnz = rng.integers(1, 8)
+            toks = []
+            for _ in range(nnz):
+                fid = rng.integers(0, vocab)
+                val = round(float(rng.normal()), 4)
+                if ffm:
+                    toks.append(f"{rng.integers(0, 5)}:{fid}:{val}")
+                else:
+                    toks.append(f"{fid}:{val}")
+            f.write(f"{label} {' '.join(toks)}\n")
+    return str(path)
+
+
+def _collect(stream):
+    out = []
+    for parsed, w in stream:
+        out.append(
+            (parsed.labels, parsed.ids, parsed.vals, parsed.fields, parsed.nnz, w)
+        )
+    return out
+
+
+def _assert_streams_equal(a, b):
+    assert len(a) == len(b)
+    for (l1, i1, v1, f1, n1, w1), (l2, i2, v2, f2, n2, w2) in zip(a, b):
+        np.testing.assert_array_equal(l1, l2)
+        np.testing.assert_array_equal(np.asarray(i1, np.int64), np.asarray(i2, np.int64))
+        np.testing.assert_array_equal(v1, v2)  # bit-exact float32
+        np.testing.assert_array_equal(f1, f2)
+        np.testing.assert_array_equal(n1, n2)
+        np.testing.assert_array_equal(w1, w2)
+
+
+@pytest.fixture
+def dataset(tmp_path):
+    rng = np.random.default_rng(7)
+    a = _write_text(tmp_path / "a.libsvm", 53, rng)
+    b = _write_text(tmp_path / "b.libsvm", 31, rng)
+    return a, b
+
+
+def test_write_and_open_roundtrip(dataset):
+    a, _ = dataset
+    out = write_fmb(a, a + ".fmb", vocabulary_size=1000)
+    f = open_fmb(out)
+    assert is_fmb(out) and not is_fmb(a)
+    assert f.n_rows == 53
+    assert f.ids.dtype == np.int32  # vocab fits int32 -> device dtype
+    # Row 0 matches a direct parse of line 0.
+    from fast_tffm_tpu.data.libsvm import parse_lines
+
+    with open(a) as fh:
+        line0 = fh.readline().strip()
+    p = parse_lines([line0], vocabulary_size=1000, max_nnz=f.width)
+    np.testing.assert_array_equal(f.ids[0], p.ids[0])
+    np.testing.assert_array_equal(f.vals[0], p.vals[0])
+    assert f.labels[0] == p.labels[0]
+
+
+@pytest.mark.parametrize(
+    "kw",
+    [
+        dict(batch_size=16, epochs=1),
+        dict(batch_size=16, epochs=3),  # batches span epoch boundaries
+        dict(batch_size=16, epochs=1, weights=(2.0, 0.5)),
+        dict(batch_size=16, epochs=1, drop_remainder=True),
+        dict(batch_size=16, epochs=1, shard_index=1, shard_count=3),
+        dict(batch_size=8, epochs=1, shard_index=1, shard_count=2, shard_block=8,
+             pad_to_batches=6),
+        dict(batch_size=64, epochs=1),  # single short batch
+    ],
+)
+def test_stream_parity_with_text(dataset, kw):
+    a, b = dataset
+    fa = write_fmb(a, a + ".fmb", vocabulary_size=1000)
+    fb = write_fmb(b, b + ".fmb", vocabulary_size=1000)
+    common = dict(vocabulary_size=1000, max_nnz=9)
+    text = _collect(batch_stream([a, b], **common, **kw))
+    fmb = _collect(fmb_batch_stream([fa, fb], **common, **kw))
+    _assert_streams_equal(text, fmb)
+
+
+def test_stream_parity_ffm_fields(tmp_path):
+    rng = np.random.default_rng(3)
+    src = _write_text(tmp_path / "f.libffm", 40, rng, ffm=True)
+    out = write_fmb(src, src + ".fmb", vocabulary_size=1000)
+    common = dict(batch_size=16, vocabulary_size=1000, max_nnz=9)
+    _assert_streams_equal(
+        _collect(batch_stream([src], **common)),
+        _collect(fmb_batch_stream([out], **common)),
+    )
+
+
+def test_stream_parity_hashed(tmp_path):
+    rng = np.random.default_rng(5)
+    path = tmp_path / "h.libsvm"
+    with open(path, "w") as f:
+        for i in range(37):
+            f.write(f"{i % 2} user_{i}:1.0 ad_{i % 7}:0.5\n")
+    src = str(path)
+    out = write_fmb(src, src + ".fmb", vocabulary_size=512, hash_feature_id=True)
+    common = dict(batch_size=10, vocabulary_size=512, hash_feature_id=True, max_nnz=4)
+    _assert_streams_equal(
+        _collect(batch_stream([src], **common)),
+        _collect(fmb_batch_stream([out], **common)),
+    )
+
+
+def test_batch_stream_routes_fmb(dataset):
+    """pipeline.batch_stream transparently streams FMB paths."""
+    a, b = dataset
+    fa = write_fmb(a, a + ".fmb", vocabulary_size=1000)
+    common = dict(batch_size=16, vocabulary_size=1000, max_nnz=9)
+    _assert_streams_equal(
+        _collect(batch_stream([a], **common)),
+        _collect(batch_stream([fa], **common)),
+    )
+    with pytest.raises(ValueError, match="cannot mix"):
+        list(batch_stream([fa, b], **common))
+
+
+def test_header_mismatch_rejected(dataset):
+    a, _ = dataset
+    fa = write_fmb(a, a + ".fmb", vocabulary_size=1000)
+    with pytest.raises(ValueError, match="hash_feature_id"):
+        list(fmb_batch_stream([fa], batch_size=8, vocabulary_size=1000,
+                              hash_feature_id=True))
+    with pytest.raises(ValueError, match="re-convert"):
+        # Raw ids validated against 1000 cannot serve a smaller vocabulary.
+        list(fmb_batch_stream([fa], batch_size=8, vocabulary_size=100))
+    # A LARGER raw vocabulary is safe (ids stay in range).
+    assert _collect(fmb_batch_stream([fa], batch_size=8, vocabulary_size=2000))
+    h = write_fmb(a, a + ".h.fmb", vocabulary_size=512, hash_feature_id=True)
+    with pytest.raises(ValueError, match="re-convert"):
+        # Hashed ids are bound to their modulus exactly.
+        list(fmb_batch_stream([h], batch_size=8, vocabulary_size=1024,
+                              hash_feature_id=True))
+
+
+def test_width_overflow_rejected(dataset):
+    a, _ = dataset
+    fa = write_fmb(a, a + ".fmb", vocabulary_size=1000)
+    wid = open_fmb(fa).width
+    with pytest.raises(ValueError, match="max_nnz"):
+        list(fmb_batch_stream([fa], batch_size=8, vocabulary_size=1000,
+                              max_nnz=wid - 1))
+
+
+def test_truncated_file_rejected(dataset):
+    a, _ = dataset
+    fa = write_fmb(a, a + ".fmb", vocabulary_size=1000)
+    data = open(fa, "rb").read()
+    with open(fa, "wb") as f:
+        f.write(data[: len(data) // 2])
+    with pytest.raises(ValueError, match="truncated"):
+        open_fmb(fa)
+
+
+def test_cache_build_reuse_and_invalidation(dataset):
+    a, _ = dataset
+    (c1,) = ensure_fmb_cache([a], vocabulary_size=1000)
+    assert c1 == a + ".fmb" and is_fmb(c1)
+    stamp = os.stat(c1).st_mtime_ns
+    (c2,) = ensure_fmb_cache([a], vocabulary_size=1000)
+    assert os.stat(c2).st_mtime_ns == stamp  # fresh cache reused
+
+    # Source change -> rebuild.
+    with open(a, "a") as f:
+        f.write("1 5:1.0\n")
+    (c3,) = ensure_fmb_cache([a], vocabulary_size=1000)
+    assert open_fmb(c3).n_rows == 54
+
+    # Config change (hashing) -> rebuild.
+    (c4,) = ensure_fmb_cache([a], vocabulary_size=1000, hash_feature_id=True)
+    assert open_fmb(c4).hashed
+
+    # FMB inputs pass through untouched.
+    assert ensure_fmb_cache([c4], vocabulary_size=1000, hash_feature_id=True) == (c4,)
+
+
+def test_binary_cache_via_batch_stream(dataset):
+    a, b = dataset
+    common = dict(batch_size=16, vocabulary_size=1000, max_nnz=9)
+    text = _collect(batch_stream([a, b], **common))
+    cached = _collect(batch_stream([a, b], **common, binary_cache=True))
+    _assert_streams_equal(text, cached)
+    assert is_fmb(a + ".fmb") and is_fmb(b + ".fmb")
+
+
+def test_scan_and_count_read_fmb_headers(dataset):
+    from fast_tffm_tpu.data.native import count_lines, scan_files
+
+    a, _ = dataset
+    fa = write_fmb(a, a + ".fmb", vocabulary_size=1000)
+    n_text, w_text = scan_files([a])
+    n_fmb, w_fmb = scan_files([fa])
+    assert (n_text, w_text) == (n_fmb, w_fmb) == (53, w_text)
+    assert count_lines([fa]) == count_lines([a]) == 53
+
+
+def test_empty_weights_mismatch_and_block_epochs_guards(dataset):
+    a, _ = dataset
+    fa = write_fmb(a, a + ".fmb", vocabulary_size=1000)
+    with pytest.raises(ValueError, match="weights"):
+        list(fmb_batch_stream([fa], batch_size=8, vocabulary_size=1000,
+                              weights=(1.0, 2.0)))
+    with pytest.raises(ValueError, match="shard_block"):
+        list(fmb_batch_stream([fa], batch_size=8, vocabulary_size=1000,
+                              shard_block=8, epochs=2))
+
+
+def test_end_to_end_train_with_fmb(tmp_path, dataset):
+    """A full train() run consuming FMB input matches a text-input run."""
+    import jax
+
+    from fast_tffm_tpu.config import Config
+    from fast_tffm_tpu.training import train
+
+    a, b = dataset
+    fa = write_fmb(a, a + ".fmb", vocabulary_size=1000)
+    fb = write_fmb(b, b + ".fmb", vocabulary_size=1000)
+
+    def run(files, ckpt):
+        cfg = Config(
+            vocabulary_size=1000,
+            factor_num=4,
+            model_file=str(tmp_path / ckpt),
+            train_files=files,
+            epoch_num=2,
+            batch_size=16,
+            learning_rate=0.05,
+            log_every=1000,
+        ).validate()
+        return train(cfg, log=lambda *_: None)
+
+    s_text = run((a, b), "text.ckpt")
+    s_fmb = run((fa, fb), "fmb.ckpt")
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(s_text.table)), np.asarray(jax.device_get(s_fmb.table))
+    )

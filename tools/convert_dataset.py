@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Convert libsvm/libffm text files to the FMB packed binary format.
+
+One FMB per source file (per-file example weights keep their alignment):
+
+    python tools/convert_dataset.py data/train.libsvm data/test.libsvm \
+        --vocabulary-size 1048576 [--hash-feature-id] [--max-nnz 39]
+
+writes data/train.libsvm.fmb and data/test.libsvm.fmb.  Training/predict
+then accept the .fmb paths directly in train_files/predict_files — or set
+``binary_cache = true`` in [Train] and the conversion happens (and stays
+fresh) automatically.
+
+--inspect prints an existing FMB file's header instead of converting.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("files", nargs="+", help="libsvm/libffm text files (or FMB with --inspect)")
+    ap.add_argument("--vocabulary-size", type=int, default=1 << 20)
+    ap.add_argument("--hash-feature-id", action="store_true")
+    ap.add_argument("--max-nnz", type=int, default=0, help="stored width (default: file's widest row)")
+    ap.add_argument("-o", "--output", nargs="*", default=None,
+                    help="output paths (default: <file>.fmb), aligned with files")
+    ap.add_argument("--inspect", action="store_true", help="print FMB headers and exit")
+    args = ap.parse_args()
+
+    from fast_tffm_tpu.data.binary import open_fmb, write_fmb
+
+    if args.inspect:
+        for path in args.files:
+            f = open_fmb(path)
+            print(
+                f"{path}: rows={f.n_rows} width={f.width} "
+                f"vocabulary_size={f.vocabulary_size} hashed={f.hashed} "
+                f"ids={f.ids.dtype} bytes={os.path.getsize(path)}"
+            )
+        return
+
+    outs = args.output if args.output else [p + ".fmb" for p in args.files]
+    if len(outs) != len(args.files):
+        ap.error(f"{len(outs)} outputs for {len(args.files)} inputs")
+    for src, dst in zip(args.files, outs):
+        t0 = time.perf_counter()
+        write_fmb(
+            src,
+            dst,
+            vocabulary_size=args.vocabulary_size,
+            hash_feature_id=args.hash_feature_id,
+            max_nnz=args.max_nnz or None,
+        )
+        f = open_fmb(dst)
+        dt = time.perf_counter() - t0
+        print(
+            f"{src} -> {dst}: {f.n_rows} rows, width {f.width}, "
+            f"{os.path.getsize(dst)} bytes in {dt:.1f}s "
+            f"({f.n_rows / max(dt, 1e-9):,.0f} rows/s)"
+        )
+
+
+if __name__ == "__main__":
+    main()
