@@ -366,14 +366,15 @@ def _scan_one(path) -> tuple[int, int]:
     hit = _scan_cache.get(key)
     if hit is not None:
         return hit
-    from fast_tffm_tpu.data.binary import is_fmb, open_fmb
+    from fast_tffm_tpu.data.binary import _read_header, is_fmb
 
     if is_fmb(path):
-        f = open_fmb(path)
-        # Stored width is the file's widest row only when the converter was
-        # not given an explicit (larger) max_nnz; either way it bounds the
-        # widest row, which is all scan callers need.
-        out = (f.n_rows, f.width)
+        # Header-only read (64 bytes) — no reason to memmap the data
+        # sections here.  Stored width is the file's widest row only when
+        # the converter was not given an explicit (larger) max_nnz; either
+        # way it bounds the widest row, which is all scan callers need.
+        n_rows, width, *_ = _read_header(path)
+        out = (n_rows, width)
         _scan_cache[key] = out
         return out
     native = load_native_parser()

@@ -13,12 +13,9 @@ import numpy as np
 
 from fast_tffm_tpu.checkpoint import restore_checkpoint
 from fast_tffm_tpu.config import Config, build_model
-from fast_tffm_tpu.data.native import best_parser
-from fast_tffm_tpu.data.pipeline import batch_stream
 from fast_tffm_tpu.models.base import Batch
-from fast_tffm_tpu.training import scan_max_nnz
+from fast_tffm_tpu.training import _stream, scan_max_nnz
 from fast_tffm_tpu.trainer import init_state, make_predict_step
-from fast_tffm_tpu.utils.prefetch import prefetch
 
 __all__ = ["predict", "dist_predict"]
 
@@ -67,18 +64,22 @@ def _run_predict(
     n = 0
     out = open(cfg.score_path, "w") if is_lead else None
     try:
-        stream = batch_stream(
+        # _stream owns the prefetch wiring AND the conversion-placement
+        # policy (H2D in the prefetch thread iff the input is FMB-backed);
+        # a None batch means convert here in the consumer (text input).
+        stream = _stream(
+            cfg,
             cfg.predict_files,
+            max_nnz,
+            epochs=1,
             batch_size=bs,
-            vocabulary_size=cfg.vocabulary_size,
-            hash_feature_id=cfg.hash_feature_id,
-            max_nnz=max_nnz,
-            parser=best_parser(cfg.thread_num),
-            binary_cache=cfg.binary_cache,
+            weights=None,
+            to_batch=to_batch,
             **stream_kw,
         )
-        for parsed, w in prefetch(stream, depth=cfg.queue_size):
-            b = to_batch(parsed, w)
+        for b, parsed, w in stream:
+            if b is None:
+                b = to_batch(parsed, w)
             scores = np.asarray(predict_step(state, b))
             if not np.isfinite(scores).all():
                 raise RuntimeError(

@@ -68,26 +68,52 @@ def _check_finite(loss: float, cfg: Config) -> None:
 _TRAIN_WEIGHTS = object()  # sentinel: apply cfg.weight_files (train files only)
 
 
+def binary_input(cfg: Config, files) -> bool:
+    """True when the stream over ``files`` will be FMB-backed (binary_cache
+    conversion, or the file list is already .fmb)."""
+    from fast_tffm_tpu.data.binary import is_fmb
+
+    return bool(cfg.binary_cache or (files and all(is_fmb(f) for f in files)))
+
+
 def _stream(
-    cfg: Config, files, max_nnz, epochs, batch_size=None, weights=_TRAIN_WEIGHTS, **shard_kw
+    cfg: Config,
+    files,
+    max_nnz,
+    epochs,
+    batch_size=None,
+    weights=_TRAIN_WEIGHTS,
+    to_batch=None,
+    **shard_kw,
 ):
+    """Prefetched input stream yielding ``(batch_or_None, parsed, w)``.
+
+    With FMB-backed input and a ``to_batch``, the host→device conversion
+    runs INSIDE the prefetch thread, overlapping the transfer with the
+    consumer's step dispatch (measured ~3× end-to-end on a transfer-bound
+    host — the memmap producer is cheap, unlike the text parse, which
+    needs the thread to itself and keeps conversion in the consumer; see
+    DESIGN.md §6).  Callers convert when the first element is None.
+    """
     if weights is _TRAIN_WEIGHTS:
         weights = cfg.weight_files if cfg.weight_files else None
-    return prefetch(
-        batch_stream(
-            files,
-            batch_size=batch_size if batch_size is not None else cfg.batch_size,
-            vocabulary_size=cfg.vocabulary_size,
-            hash_feature_id=cfg.hash_feature_id,
-            max_nnz=max_nnz,
-            epochs=epochs,
-            weights=weights,
-            parser=best_parser(cfg.thread_num),
-            binary_cache=cfg.binary_cache,
-            **shard_kw,
-        ),
-        depth=cfg.queue_size,
+    raw = batch_stream(
+        files,
+        batch_size=batch_size if batch_size is not None else cfg.batch_size,
+        vocabulary_size=cfg.vocabulary_size,
+        hash_feature_id=cfg.hash_feature_id,
+        max_nnz=max_nnz,
+        epochs=epochs,
+        weights=weights,
+        parser=best_parser(cfg.thread_num),
+        binary_cache=cfg.binary_cache,
+        **shard_kw,
     )
+    if to_batch is not None and binary_input(cfg, files):
+        gen = ((to_batch(p, w), p, w) for p, w in raw)
+    else:
+        gen = ((None, p, w) for p, w in raw)
+    return prefetch(gen, depth=cfg.queue_size)
 
 
 def _evaluate(
@@ -99,15 +125,16 @@ def _evaluate(
 
     weight_files aligns with TRAIN files; validation examples weigh 1.0
     (only batch-padding rows carry 0, and ``auc`` drops them)."""
-    if stream is None:
-        stream = _stream(cfg, files, max_nnz, epochs=1, weights=None)
     if to_batch is None:
         to_batch = Batch.from_parsed
+    if stream is None:
+        stream = _stream(cfg, files, max_nnz, epochs=1, weights=None, to_batch=to_batch)
     if fetch is None:
         fetch = lambda b, parsed, w: (parsed.labels, w)
     scores, labels, weights = [], [], []
-    for parsed, w in stream:
-        b = to_batch(parsed, w)
+    for b, parsed, w in stream:
+        if b is None:
+            b = to_batch(parsed, w)
         scores.append(np.asarray(predict_step(state, b)))
         lab, ww = fetch(b, parsed, w)
         labels.append(lab)
@@ -134,7 +161,9 @@ def _run_training(
     and ``evaluate`` the validation pass — the multi-host path plugs in
     sharded input + global-array stitching here without forking the loop."""
     if train_stream is None:
-        train_stream = lambda epoch: _stream(cfg, cfg.train_files, max_nnz, epochs=1)
+        train_stream = lambda epoch: _stream(
+            cfg, cfg.train_files, max_nnz, epochs=1, to_batch=to_batch
+        )
     if to_batch is None:
         to_batch = Batch.from_parsed
     if evaluate is None:
@@ -186,8 +215,9 @@ def _run_training(
         for epoch in range(cfg.epoch_num):
             if stop_requested.is_set():
                 break
-            for parsed, w in train_stream(epoch):
-                b = to_batch(parsed, w)
+            for b, parsed, w in train_stream(epoch):
+                if b is None:
+                    b = to_batch(parsed, w)
                 tracer.on_step()
                 with step_trace("train", step_num):
                     state, loss = step_fn(state, b)
@@ -362,6 +392,7 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
                 shard_count=nproc,
                 shard_block=local_bs,
                 pad_to_batches=steps_per_epoch,
+                to_batch=to_batch,
             )
 
         def to_batch(parsed, w):
@@ -402,6 +433,7 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
                     shard_count=nproc,
                     shard_block=local_bs,
                     pad_to_batches=val_steps,
+                    to_batch=to_batch,
                 ),
                 to_batch=to_batch,
                 fetch=lambda b, parsed, w: (
