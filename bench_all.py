@@ -272,9 +272,14 @@ def bench_end_to_end_fmb(rows=1_000_000):
             stream = batch_stream(
                 [fmb], batch_size=16384, vocabulary_size=1 << 20, max_nnz=39
             )
+            # H2D conversion in the prefetch thread, like training._stream
+            # does for binary input (overlaps transfer with dispatch).
+            gen = (
+                (Batch.from_parsed(p, w, with_fields=False), w) for p, w in stream
+            )
             loss = None
-            for parsed, w in prefetch(stream, depth=8):
-                state, loss = step(state, Batch.from_parsed(parsed, w, with_fields=False))
+            for b, w in prefetch(gen, depth=8):
+                state, loss = step(state, b)
                 n += int((w > 0).sum())
             jax.block_until_ready(loss)
             return n

@@ -187,19 +187,24 @@ def write_fmb(
             "into range instead (hash_feature_id)"
         )
     st = os.stat(src_path)
-    n_rows, widest = scan_files([src_path])
-    width = int(max_nnz) if max_nnz else max(1, widest)
-    ids_dtype = np.int32
-    isz = 4
-    o_lab, o_nnz, o_ids, o_val, o_fld, total = _section_offsets(n_rows, width, isz)
-
     # Temp name unique across hosts too: multi-host cache fills on a shared
     # filesystem can race, and containerized pod workers routinely share
     # PIDs — a colliding temp name would truncate a peer's half-written
     # file.  os.replace keeps the visible path atomic either way.
     tmp = f"{out_path}.{socket.gethostname()}.{os.getpid()}.{uuid.uuid4().hex[:8]}.tmp"
     try:
-        with open(tmp, "wb") as f:
+        # Probe writability BEFORE the full source scan: on an unwritable
+        # cache location the OSError must land cheaply (ensure_fmb_cache
+        # falls back to text per stream, and a multi-GB pre-scan per epoch
+        # would be pure waste).
+        with open(tmp, "wb"):
+            pass
+        n_rows, widest = scan_files([src_path])
+        width = int(max_nnz) if max_nnz else max(1, widest)
+        ids_dtype = np.int32
+        isz = 4
+        o_lab, o_nnz, o_ids, o_val, o_fld, total = _section_offsets(n_rows, width, isz)
+        with open(tmp, "r+b") as f:
             f.truncate(total)
         mm = np.memmap(tmp, np.uint8, mode="r+")
         mm[: _HEADER.size] = np.frombuffer(
@@ -400,7 +405,14 @@ def ensure_fmb_cache(
     requested (vocabulary_size, hash) configuration — anything else triggers
     a rebuild, so a stale or mismatched cache can never silently feed
     training.  Concurrent builders race benignly (atomic replace).
+
+    An unwritable cache location (read-only data mount) is NOT fatal: the
+    source text path is returned for that file with a warning, and the
+    stream falls back to parsing — binary_cache is an accelerator, not a
+    correctness knob.
     """
+    import warnings
+
     out: list[str] = []
     for path in files:
         path = os.fspath(path)
@@ -429,13 +441,36 @@ def ensure_fmb_cache(
         if not fresh:
             if log is not None:
                 log(f"building binary cache {cache}")
-            write_fmb(
-                path,
-                cache,
-                vocabulary_size=vocabulary_size,
-                hash_feature_id=hash_feature_id,
-                max_nnz=max_nnz,
-                parser=parser,
-            )
+            try:
+                write_fmb(
+                    path,
+                    cache,
+                    vocabulary_size=vocabulary_size,
+                    hash_feature_id=hash_feature_id,
+                    max_nnz=max_nnz,
+                    parser=parser,
+                )
+            except OSError as e:
+                # One un-cacheable file means the WHOLE list stays text:
+                # a stream cannot mix FMB and text (batch_stream rejects
+                # the ambiguity), and correctness never depended on the
+                # cache anyway.  If the list ALREADY mixes in .fmb files,
+                # there is no text form to fall back to for those — that
+                # stays a hard error with a pointed message.
+                passthrough = [os.fspath(p) for p in files if is_fmb(p)]
+                if passthrough:
+                    raise OSError(
+                        f"binary_cache: cannot write {cache} ({e}) and "
+                        f"{passthrough} have no text form to fall back to; "
+                        "fix cache-directory permissions or make the input "
+                        "list all-text or all-FMB"
+                    ) from e
+                warnings.warn(
+                    f"binary_cache: cannot write {cache} ({e}); streaming "
+                    "text for all input files instead",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+                return tuple(os.fspath(p) for p in files)
         out.append(cache)
     return tuple(out)

@@ -68,12 +68,12 @@ def _check_finite(loss: float, cfg: Config) -> None:
 _TRAIN_WEIGHTS = object()  # sentinel: apply cfg.weight_files (train files only)
 
 
-def binary_input(cfg: Config, files) -> bool:
-    """True when the stream over ``files`` will be FMB-backed (binary_cache
-    conversion, or the file list is already .fmb)."""
+def binary_input(files) -> bool:
+    """True when every file in the (cache-resolved) list is FMB — i.e. the
+    stream will be memmap-backed, not parsed."""
     from fast_tffm_tpu.data.binary import is_fmb
 
-    return bool(cfg.binary_cache or (files and all(is_fmb(f) for f in files)))
+    return bool(files) and all(is_fmb(f) for f in files)
 
 
 def _stream(
@@ -97,6 +97,22 @@ def _stream(
     """
     if weights is _TRAIN_WEIGHTS:
         weights = cfg.weight_files if cfg.weight_files else None
+    files = tuple(files)
+    parser = best_parser(cfg.thread_num)
+    if cfg.binary_cache:
+        # Resolve the cache HERE (not inside batch_stream) so the
+        # conversion-placement decision below sees the actual outcome:
+        # an unwritable cache falls back to text files, and text input
+        # must keep the prefetch thread for the parse.
+        from fast_tffm_tpu.data.binary import ensure_fmb_cache
+
+        files = ensure_fmb_cache(
+            files,
+            vocabulary_size=cfg.vocabulary_size,
+            hash_feature_id=cfg.hash_feature_id,
+            max_nnz=max_nnz,
+            parser=parser,
+        )
     raw = batch_stream(
         files,
         batch_size=batch_size if batch_size is not None else cfg.batch_size,
@@ -105,11 +121,10 @@ def _stream(
         max_nnz=max_nnz,
         epochs=epochs,
         weights=weights,
-        parser=best_parser(cfg.thread_num),
-        binary_cache=cfg.binary_cache,
+        parser=parser,
         **shard_kw,
     )
-    if to_batch is not None and binary_input(cfg, files):
+    if to_batch is not None and binary_input(files):
         gen = ((to_batch(p, w), p, w) for p, w in raw)
     else:
         gen = ((None, p, w) for p, w in raw)

@@ -205,6 +205,37 @@ def test_cache_build_reuse_and_invalidation(dataset):
     assert ensure_fmb_cache([c4], vocabulary_size=1000, hash_feature_id=True) == (c4,)
 
 
+def test_cache_falls_back_to_text_when_unwritable(tmp_path, monkeypatch):
+    """A read-only data mount must degrade to text streaming, not crash.
+
+    (Simulated via monkeypatch — chmod-based read-only dirs do not bind
+    when the suite runs as root.)
+    """
+    import fast_tffm_tpu.data.binary as binary_mod
+
+    rng = np.random.default_rng(11)
+    src = _write_text(tmp_path / "d.libsvm", 20, rng)
+    def _raise(*a, **k):
+        raise OSError("read-only file system")
+
+    monkeypatch.setattr(binary_mod, "write_fmb", _raise)
+    with pytest.warns(RuntimeWarning, match="streaming text"):
+        out = ensure_fmb_cache([src], vocabulary_size=1000)
+    assert out == (src,)
+    # A pre-existing .fmb in the same list has no text form to fall back
+    # to — that must stay a hard, pointed error, not a mixed-list crash
+    # deeper in the stream.  (The module-level write_fmb import here is
+    # the real function; only the module attribute is patched.)
+    pre = write_fmb(src, str(tmp_path / "pre.fmb"), vocabulary_size=1000)
+    with pytest.raises(OSError, match="no text form"):
+        ensure_fmb_cache([pre, src], vocabulary_size=1000)
+    # And the full stream still works through the text path.
+    common = dict(batch_size=8, vocabulary_size=1000, max_nnz=9)
+    with pytest.warns(RuntimeWarning):
+        cached = _collect(batch_stream([src], **common, binary_cache=True))
+    _assert_streams_equal(_collect(batch_stream([src], **common)), cached)
+
+
 def test_binary_cache_via_batch_stream(dataset):
     a, b = dataset
     common = dict(batch_size=16, vocabulary_size=1000, max_nnz=9)
