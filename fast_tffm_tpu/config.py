@@ -45,6 +45,7 @@ class Config:
     init_accumulator_value: float = 0.1
     thread_num: int = 1  # host-side parse workers (reference: queue threads)
     binary_cache: bool = False  # parse text once into <file>.fmb, stream that
+    binary_cache_wait: float = 600.0  # multi-host: non-lead wait for lead's build (s)
     queue_size: int = 8  # prefetch depth
     log_every: int = 100
     save_every_epochs: int = 1
@@ -160,6 +161,7 @@ def load_config(path: str) -> Config:
     )
     cfg.thread_num = get(t, "thread_num", int, cfg.thread_num)
     cfg.binary_cache = get(t, "binary_cache", ini._convert_to_boolean, cfg.binary_cache)
+    cfg.binary_cache_wait = get(t, "binary_cache_wait", float, cfg.binary_cache_wait)
     cfg.queue_size = get(t, "queue_size", int, cfg.queue_size)
     cfg.log_every = get(t, "log_every", int, cfg.log_every)
     cfg.save_every_epochs = get(t, "save_every_epochs", int, cfg.save_every_epochs)

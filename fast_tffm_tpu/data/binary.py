@@ -389,6 +389,22 @@ def fmb_batch_stream(
     )
 
 
+def _cache_location_writable(cache_path: str) -> bool:
+    """Can a cache file be created at ``cache_path``?  Probe with a unique
+    sibling temp file (the cache itself must never be touched non-atomically)."""
+    probe = f"{cache_path}.{socket.gethostname()}.{os.getpid()}.{uuid.uuid4().hex[:8]}.probe"
+    try:
+        with open(probe, "wb"):
+            pass
+    except OSError:
+        return False
+    try:
+        os.remove(probe)
+    except OSError:
+        pass
+    return True
+
+
 def ensure_fmb_cache(
     files: Sequence[str],
     *,
@@ -397,6 +413,7 @@ def ensure_fmb_cache(
     max_nnz: int | None = None,
     parser=None,
     log=None,
+    wait_for_peer: float = 0.0,
 ) -> tuple[str, ...]:
     """Map text files to fresh ``<file>.fmb`` caches, converting as needed.
 
@@ -410,8 +427,33 @@ def ensure_fmb_cache(
     source text path is returned for that file with a warning, and the
     stream falls back to parsing — binary_cache is an accelerator, not a
     correctness knob.
+
+    ``wait_for_peer`` > 0 polls up to that many seconds for ANOTHER
+    process to finish building a stale cache before building locally —
+    on a multi-host pod with a shared filesystem, the lead process builds
+    once and the other N−1 skip the duplicate parse (hosts with separate
+    local disks simply hit the timeout and build their own copy).
     """
+    import time
     import warnings
+
+    def check_fresh(cache, st):
+        try:
+            if not is_fmb(cache):
+                return False
+            n, width, vocab, hashed, _isz, src_size, src_mtime = _read_header(cache)
+        except (ValueError, OSError):
+            # OSError: the wait loop polls exactly while a peer's
+            # os.replace lands — transient ESTALE/ENOENT on network
+            # filesystems means "not fresh yet", never "crash".
+            return False
+        return (
+            src_size == st.st_size
+            and src_mtime == st.st_mtime_ns
+            and hashed == bool(hash_feature_id)
+            and (vocab == vocabulary_size if hashed else vocab <= vocabulary_size)
+            and (max_nnz is None or width <= max_nnz)
+        )
 
     out: list[str] = []
     for path in files:
@@ -421,23 +463,17 @@ def ensure_fmb_cache(
             continue
         cache = path + ".fmb"
         st = os.stat(path)
-        fresh = False
-        if is_fmb(cache):
-            try:
-                n, width, vocab, hashed, _isz, src_size, src_mtime = _read_header(cache)
-                fresh = (
-                    src_size == st.st_size
-                    and src_mtime == st.st_mtime_ns
-                    and hashed == bool(hash_feature_id)
-                    and (
-                        vocab == vocabulary_size
-                        if hashed
-                        else vocab <= vocabulary_size
-                    )
-                    and (max_nnz is None or width <= max_nnz)
-                )
-            except ValueError:
-                fresh = False
+        fresh = check_fresh(cache, st)
+        if not fresh and wait_for_peer > 0 and _cache_location_writable(cache):
+            # Only wait when a peer's build is actually possible: on an
+            # unwritable (read-only) mount no peer can ever produce the
+            # cache, and the wait would stall every epoch's stream for the
+            # full timeout before the text fallback.  (Writability here is
+            # a proxy for the lead's — same shared mount, same perms.)
+            deadline = time.monotonic() + wait_for_peer
+            while not fresh and time.monotonic() < deadline:
+                time.sleep(min(1.0, wait_for_peer))
+                fresh = check_fresh(cache, st)
         if not fresh:
             if log is not None:
                 log(f"building binary cache {cache}")

@@ -112,6 +112,12 @@ def _stream(
             hash_feature_id=cfg.hash_feature_id,
             max_nnz=max_nnz,
             parser=parser,
+            # Pod etiquette: on a shared filesystem only the lead process
+            # builds a stale cache; the rest wait for it (and build their
+            # own copy after the timeout when disks are host-local).
+            wait_for_peer=(
+                cfg.binary_cache_wait if jax.process_index() != 0 else 0.0
+            ),
         )
     raw = batch_stream(
         files,

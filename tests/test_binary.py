@@ -236,6 +236,44 @@ def test_cache_falls_back_to_text_when_unwritable(tmp_path, monkeypatch):
     _assert_streams_equal(_collect(batch_stream([src], **common)), cached)
 
 
+def test_cache_wait_for_peer(tmp_path, monkeypatch):
+    """wait_for_peer: a stale cache built by a PEER mid-wait is adopted
+    without a local build; on timeout the local build proceeds."""
+    import threading
+    import time
+
+    import fast_tffm_tpu.data.binary as binary_mod
+
+    rng = np.random.default_rng(13)
+    src = _write_text(tmp_path / "w.libsvm", 15, rng)
+    cache = src + ".fmb"
+
+    calls = []
+    real_write = binary_mod.write_fmb
+    monkeypatch.setattr(
+        binary_mod, "write_fmb", lambda *a, **k: calls.append(a) or real_write(*a, **k)
+    )
+
+    # Peer builds the cache ~0.3s into our wait window.
+    peer = threading.Timer(0.3, real_write, args=(src, cache), kwargs=dict(vocabulary_size=1000))
+    peer.start()
+    try:
+        t0 = time.monotonic()
+        out = ensure_fmb_cache([src], vocabulary_size=1000, wait_for_peer=10.0)
+        waited = time.monotonic() - t0
+    finally:
+        peer.join()
+    assert out == (cache,)
+    assert not calls, "local build ran despite the peer's"
+    assert waited < 9.0, "should adopt the peer's cache well before the timeout"
+
+    # Timeout path: stale cache, no peer -> local build after the wait.
+    with open(src, "a") as f:
+        f.write("1 3:1.0\n")
+    out = ensure_fmb_cache([src], vocabulary_size=1000, wait_for_peer=0.2)
+    assert calls and open_fmb(out[0]).n_rows == 16
+
+
 def test_binary_cache_via_batch_stream(dataset):
     a, b = dataset
     common = dict(batch_size=16, vocabulary_size=1000, max_nnz=9)
