@@ -389,6 +389,13 @@ def fmb_batch_stream(
     )
 
 
+# Cache paths whose build failed in THIS process (ENOSPC, quota, …): later
+# ensure_fmb_cache calls skip the peer wait and the rebuild attempt for
+# them, keeping the per-epoch text fallback cheap.  Freshness is still
+# checked first, so a cache that eventually appears is adopted.
+_BUILD_FAILED: set[str] = set()
+
+
 def _cache_location_writable(cache_path: str) -> bool:
     """Can a cache file be created at ``cache_path``?  Probe with a unique
     sibling temp file (the cache itself must never be touched non-atomically)."""
@@ -456,6 +463,10 @@ def ensure_fmb_cache(
         )
 
     out: list[str] = []
+    # ONE wait budget for the whole file list: when no peer exists
+    # (host-local disks), the first file burns the timeout and the rest
+    # skip straight to building — not wait_for_peer × n_files of sleep.
+    deadline = time.monotonic() + wait_for_peer if wait_for_peer > 0 else 0.0
     for path in files:
         path = os.fspath(path)
         if is_fmb(path):
@@ -464,17 +475,48 @@ def ensure_fmb_cache(
         cache = path + ".fmb"
         st = os.stat(path)
         fresh = check_fresh(cache, st)
-        if not fresh and wait_for_peer > 0 and _cache_location_writable(cache):
+        if (
+            not fresh
+            and wait_for_peer > 0
+            and cache not in _BUILD_FAILED
+            and _cache_location_writable(cache)
+        ):
             # Only wait when a peer's build is actually possible: on an
             # unwritable (read-only) mount no peer can ever produce the
             # cache, and the wait would stall every epoch's stream for the
             # full timeout before the text fallback.  (Writability here is
             # a proxy for the lead's — same shared mount, same perms.)
-            deadline = time.monotonic() + wait_for_peer
             while not fresh and time.monotonic() < deadline:
                 time.sleep(min(1.0, wait_for_peer))
                 fresh = check_fresh(cache, st)
         if not fresh:
+            # One un-cacheable file means the WHOLE list stays text: a
+            # stream cannot mix FMB and text (batch_stream rejects the
+            # ambiguity), and correctness never depended on the cache.
+            # If the list ALREADY mixes in .fmb files, there is no text
+            # form to fall back to for those — a hard, pointed error.
+            def fall_back_to_text(err):
+                passthrough = [os.fspath(p) for p in files if is_fmb(p)]
+                if passthrough:
+                    raise OSError(
+                        f"binary_cache: cannot write {cache} ({err}) and "
+                        f"{passthrough} have no text form to fall back to; "
+                        "fix cache-directory permissions or make the input "
+                        "list all-text or all-FMB"
+                    )
+                warnings.warn(
+                    f"binary_cache: cannot write {cache} ({err}); streaming "
+                    "text for all input files instead",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+                return tuple(os.fspath(p) for p in files)
+
+            if cache in _BUILD_FAILED:
+                # A build already failed in this process: don't re-pay the
+                # full parse (epochs recreate this stream) just to fail the
+                # same way again.
+                return fall_back_to_text("previous build failed")
             if log is not None:
                 log(f"building binary cache {cache}")
             try:
@@ -487,26 +529,7 @@ def ensure_fmb_cache(
                     parser=parser,
                 )
             except OSError as e:
-                # One un-cacheable file means the WHOLE list stays text:
-                # a stream cannot mix FMB and text (batch_stream rejects
-                # the ambiguity), and correctness never depended on the
-                # cache anyway.  If the list ALREADY mixes in .fmb files,
-                # there is no text form to fall back to for those — that
-                # stays a hard error with a pointed message.
-                passthrough = [os.fspath(p) for p in files if is_fmb(p)]
-                if passthrough:
-                    raise OSError(
-                        f"binary_cache: cannot write {cache} ({e}) and "
-                        f"{passthrough} have no text form to fall back to; "
-                        "fix cache-directory permissions or make the input "
-                        "list all-text or all-FMB"
-                    ) from e
-                warnings.warn(
-                    f"binary_cache: cannot write {cache} ({e}); streaming "
-                    "text for all input files instead",
-                    RuntimeWarning,
-                    stacklevel=2,
-                )
-                return tuple(os.fspath(p) for p in files)
+                _BUILD_FAILED.add(cache)
+                return fall_back_to_text(e)
         out.append(cache)
     return tuple(out)

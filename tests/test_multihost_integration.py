@@ -33,6 +33,7 @@ WORKER = textwrap.dedent(
     """
     import sys
     pid, nproc, port, tmp = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+    cache = bool(int(sys.argv[5]))
     sys.path.insert(0, {repo!r})
     import jax
     # The harness/sitecustomize may have pinned another platform via env;
@@ -51,7 +52,11 @@ WORKER = textwrap.dedent(
         train_files=(f"{{tmp}}/train.libsvm",),
         validation_files=(f"{{tmp}}/valid.libsvm",),
         epoch_num=2, batch_size=32, learning_rate=0.1, log_every=5,
-        row_parallel=2,
+        row_parallel=2, binary_cache=cache,
+        # Keep the non-lead's peer wait well inside the harness's
+        # communicate() timeout, so a lead-side build failure surfaces as
+        # the lead's traceback, not a TimeoutExpired.
+        binary_cache_wait=30,
     ).validate()
     state = dist_train(cfg, log=lambda m: print(f"[{{pid}}] {{m}}", flush=True))
     print(f"[{{pid}}] DONE step={{int(state.step)}}", flush=True)
@@ -88,7 +93,12 @@ def _write_data(tmp_path):
 
 
 @pytest.mark.slow
-def test_two_process_dist_train_and_cross_mesh_restore(tmp_path):
+@pytest.mark.parametrize("cache", [False, True], ids=["text", "fmb-cache"])
+def test_two_process_dist_train_and_cross_mesh_restore(tmp_path, cache):
+    """``cache=True`` reruns the whole pod story over the FMB binary cache:
+    both processes resolve the cache (the non-lead waits for the lead's
+    build on the shared tmp filesystem), stream sharded memmap batches,
+    and must land on the same table as text input."""
     _write_data(tmp_path)
     port = _free_port()
     script = tmp_path / "worker.py"
@@ -96,7 +106,7 @@ def test_two_process_dist_train_and_cross_mesh_restore(tmp_path):
     env = {k: v for k, v in os.environ.items() if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
     procs = [
         subprocess.Popen(
-            [sys.executable, str(script), str(i), "2", str(port), str(tmp_path)],
+            [sys.executable, str(script), str(i), "2", str(port), str(tmp_path), str(int(cache))],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
@@ -105,9 +115,14 @@ def test_two_process_dist_train_and_cross_mesh_restore(tmp_path):
         for i in range(2)
     ]
     outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=420)
-        outs.append(out)
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out)
+    finally:
+        for p in procs:  # never leave workers (and the coordinator port) behind
+            if p.poll() is None:
+                p.kill()
     steps_per_epoch = -(-N_ROWS // 32)
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {i} failed:\n{out}"
@@ -116,6 +131,10 @@ def test_two_process_dist_train_and_cross_mesh_restore(tmp_path):
     assert f"input sharding: {N_ROWS} rows over 2 processes" in outs[0]
     assert "validation auc" in outs[0]
     assert os.path.isdir(tmp_path / "model.orbax")
+    if cache:
+        # Both processes resolved to the same single cache pair.
+        assert os.path.exists(tmp_path / "train.libsvm.fmb")
+        assert os.path.exists(tmp_path / "valid.libsvm.fmb")
 
     # Cross-mesh restore: the 2x2-mesh orbax checkpoint loads onto a plain
     # single-process state (different padding path) and carries the step.
