@@ -12,6 +12,7 @@ parameter-server sharding, redone as `jax.sharding` + collectives).
 __version__ = "0.1.0"
 
 from fast_tffm_tpu.config import Config, build_model, load_config  # noqa: F401
+from fast_tffm_tpu.data.binary import open_fmb, write_fmb  # noqa: F401
 from fast_tffm_tpu.models import Batch, DeepFMModel, FFMModel, FMModel  # noqa: F401
 from fast_tffm_tpu.ops.fm import fm_score  # noqa: F401
 
@@ -24,6 +25,8 @@ __all__ = [
     "build_model",
     "fm_score",
     "load_config",
+    "open_fmb",
+    "write_fmb",
     "train",
     "dist_train",
     "predict",
