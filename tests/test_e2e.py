@@ -146,6 +146,52 @@ def test_cli_train_predict_subprocess(workdir):
     assert (workdir / "scores.txt").exists()
 
 
+def test_cli_convert_packs_configured_files(workdir):
+    """`convert` pre-builds the FMB cache for every configured data file,
+    and a second invocation reuses the fresh caches."""
+    from fast_tffm_tpu.cli import main
+    from fast_tffm_tpu.data.binary import is_fmb
+
+    assert main(["convert", str(workdir / "run.cfg")]) == 0
+    for name in ("train.libsvm", "valid.libsvm"):
+        assert is_fmb(str(workdir / name) + ".fmb")
+    stamp = os.stat(str(workdir / "train.libsvm.fmb")).st_mtime_ns
+    assert main(["convert", str(workdir / "run.cfg")]) == 0
+    assert os.stat(str(workdir / "train.libsvm.fmb")).st_mtime_ns == stamp
+
+    # And train consumes the pre-built caches (binary_cache resolves to
+    # the same paths; fresh -> no rebuild).
+    cfg = load_config(str(workdir / "run.cfg"))
+    import dataclasses
+
+    cfg = dataclasses.replace(cfg, binary_cache=True).validate()
+    train(cfg, log=lambda *_: None)
+    assert os.stat(str(workdir / "train.libsvm.fmb")).st_mtime_ns == stamp
+
+
+def test_cli_convert_reports_per_file_failures(workdir, monkeypatch, capsys):
+    """One unconvertible file must not abort the others, and the exit code
+    must say something failed."""
+    import fast_tffm_tpu.data.binary as binary_mod
+    from fast_tffm_tpu.cli import main
+    from fast_tffm_tpu.data.binary import is_fmb
+
+    real = binary_mod.write_fmb
+
+    def picky(src, dst, **kw):
+        if "valid" in os.path.basename(src):
+            raise OSError("read-only file system")
+        return real(src, dst, **kw)
+
+    monkeypatch.setattr(binary_mod, "write_fmb", picky)
+    monkeypatch.setattr(binary_mod, "_BUILD_FAILED", set())
+    assert main(["convert", str(workdir / "run.cfg")]) == 1
+    err = capsys.readouterr().err
+    assert "FAILED" in err and "not converted" in err
+    assert is_fmb(str(workdir / "train.libsvm.fmb"))  # others still packed
+    assert not os.path.exists(str(workdir / "valid.libsvm.fmb"))
+
+
 def test_weight_files_do_not_apply_to_validation(workdir, tmp_path):
     # weight_files aligns with TRAIN files; a validation list of a different
     # length must neither crash the eval stream nor weight its AUC.
