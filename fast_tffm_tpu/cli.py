@@ -14,7 +14,7 @@ import sys
 
 from fast_tffm_tpu.config import load_config
 
-MODES = ("train", "predict", "dist_train", "dist_predict")
+MODES = ("train", "predict", "dist_train", "dist_predict", "convert")
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -52,6 +52,49 @@ def main(argv: list[str] | None = None) -> int:
         from fast_tffm_tpu.prediction import predict
 
         predict(cfg)
+    elif args.mode == "convert":
+        # Pre-pack every configured data file into its FMB binary cache
+        # (what `binary_cache = true` would do lazily at first stream) —
+        # handy before a pod run so training starts at memmap speed.
+        # Per FILE, not one ensure_fmb_cache call: the all-or-nothing text
+        # fallback is a per-STREAM rule, but these files feed independent
+        # streams — an unwritable predict mount must not abort packing the
+        # train files.  No upfront width scan either: a fresh-cache rerun
+        # stays nearly free, and write_fmb defaults to each file's widest
+        # row (compatible with any training-time max_nnz >= it).
+        from fast_tffm_tpu.data.binary import ensure_fmb_cache, is_fmb
+
+        files = tuple(
+            dict.fromkeys((*cfg.train_files, *cfg.validation_files, *cfg.predict_files))
+        )
+        if not files:
+            print("no data files configured", file=sys.stderr)
+            return 1
+        failures = 0
+        for src in files:
+            try:
+                (dst,) = ensure_fmb_cache(
+                    [src],
+                    vocabulary_size=cfg.vocabulary_size,
+                    hash_feature_id=cfg.hash_feature_id,
+                    max_nnz=cfg.max_nnz or None,
+                    log=print,
+                )
+            except OSError as e:
+                print(f"{src}: FAILED ({e})", file=sys.stderr)
+                failures += 1
+                continue
+            if dst == src and not is_fmb(src):
+                # The unwritable-location fallback hands back the text path.
+                print(f"{src}: FAILED (cache location unwritable)", file=sys.stderr)
+                failures += 1
+            elif src == dst:
+                print(f"{src} (already FMB)")
+            else:
+                print(f"{src} -> {dst}")
+        if failures:
+            print(f"{failures} of {len(files)} files not converted", file=sys.stderr)
+            return 1
     else:
         from fast_tffm_tpu.prediction import dist_predict
 
