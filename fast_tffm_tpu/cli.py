@@ -70,6 +70,13 @@ def main(argv: list[str] | None = None) -> int:
         if not files:
             print("no data files configured", file=sys.stderr)
             return 1
+        if not cfg.binary_cache:
+            print(
+                "note: this config has binary_cache = false — set it to true "
+                "(or put the .fmb paths in the file lists) so train/predict "
+                "actually stream the packed caches",
+                file=sys.stderr,
+            )
         failures = 0
         for src in files:
             try:
@@ -80,7 +87,10 @@ def main(argv: list[str] | None = None) -> int:
                     max_nnz=cfg.max_nnz or None,
                     log=print,
                 )
-            except OSError as e:
+            except (OSError, ValueError, RuntimeError) as e:
+                # ValueError: malformed libsvm / id out of range;
+                # RuntimeError: file changed mid-convert.  One bad FILE must
+                # not abort packing the rest any more than a bad mount does.
                 print(f"{src}: FAILED ({e})", file=sys.stderr)
                 failures += 1
                 continue
