@@ -124,6 +124,7 @@ def main():
         FMModel(vocabulary_size=1 << 20, factor_num=8, order=3),
         B, 11, 1 << 20, lr=0.05,
     )
+    bench_predict()
     bench_input()
     bench_end_to_end()
     bench_end_to_end_fmb()
@@ -151,6 +152,22 @@ def _synthetic_file(td, rows):
     path = os.path.join(td, "bench.libsvm")
     _gen_tools().generate(path, rows=rows, fields=39, vocab=1 << 20, fmt="libsvm", seed=0)
     return path
+
+
+def bench_predict():
+    """Inference throughput for the config-#1 shape: gather + fused scorer
+    + sigmoid, no optimizer RMW — the CTR-serving number."""
+    from fast_tffm_tpu.trainer import make_predict_step
+
+    model = FMModel(vocabulary_size=1 << 20, factor_num=8, order=2)
+    state = init_state(model, jax.random.key(0))
+    predict = make_predict_step(model)
+    rng = np.random.default_rng(0)
+    B = 16384
+    batches = [make_batch(rng, B, 39, 1 << 20) for _ in range(8)]
+    # time_step's (state, loss) protocol, with the scores as the "loss".
+    sps = time_step(lambda s, b: (s, predict(s, b)), state, batches)
+    report("predict ex/s/chip (FM order2 k=8, nnz=39, vocab=1M)", B * sps / jax.device_count())
 
 
 def bench_input(rows=200_000):
