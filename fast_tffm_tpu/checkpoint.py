@@ -192,6 +192,20 @@ def restore_checkpoint(path: str, like: TrainState) -> TrainState:
     else:
         table, table_accum, new_dense, new_accum, step = _load_npz(path, like)
 
+    if table_accum.shape[-1] != like.table_opt.accum.shape[-1]:
+        # Accumulator granularity is part of the optimizer's identity: a
+        # [V, D] element accumulator cannot serve a row-mode state (or
+        # vice versa) — silently proceeding would either ignore the
+        # configured mode or numpy-broadcast a fabricated accumulator in
+        # the re-pad path below.
+        mode = lambda d: "row" if d == 1 else "element"
+        raise ValueError(
+            f"checkpoint {path!r} was trained with adagrad_accumulator = "
+            f"{mode(table_accum.shape[-1])} (accum width {table_accum.shape[-1]}) "
+            f"but this config expects {mode(like.table_opt.accum.shape[-1])} "
+            f"(width {like.table_opt.accum.shape[-1]}); set adagrad_accumulator "
+            "to match the checkpoint"
+        )
     if table.shape[0] != like.table.shape[0]:
         # Mesh-shape change ⇒ different vocab padding; re-pad with init rows.
         v = min(table.shape[0], like.table.shape[0])

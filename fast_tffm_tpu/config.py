@@ -43,6 +43,7 @@ class Config:
     factor_lambda: float = 0.0
     bias_lambda: float = 0.0
     init_accumulator_value: float = 0.1
+    adagrad_accumulator: str = "element"  # element (TF parity) | row (faster RMW)
     thread_num: int = 1  # host-side parse workers (reference: queue threads)
     binary_cache: bool = False  # parse text once into <file>.fmb, stream that
     binary_cache_wait: float = 600.0  # multi-host: non-lead wait for lead's build (s)
@@ -87,6 +88,10 @@ class Config:
             raise ValueError(f"unknown compute_dtype {self.compute_dtype!r}")
         if self.lookup not in ("allgather", "alltoall"):
             raise ValueError(f"unknown lookup {self.lookup!r} (allgather | alltoall)")
+        if self.adagrad_accumulator not in ("element", "row"):
+            raise ValueError(
+                f"unknown adagrad_accumulator {self.adagrad_accumulator!r} (element | row)"
+            )
         return self
 
 
@@ -159,6 +164,9 @@ def load_config(path: str) -> Config:
     cfg.init_accumulator_value = get(
         t, "init_accumulator_value", float, cfg.init_accumulator_value
     )
+    cfg.adagrad_accumulator = get(
+        t, "adagrad_accumulator", str, cfg.adagrad_accumulator
+    ).lower()
     cfg.thread_num = get(t, "thread_num", int, cfg.thread_num)
     cfg.binary_cache = get(t, "binary_cache", ini._convert_to_boolean, cfg.binary_cache)
     cfg.binary_cache_wait = get(t, "binary_cache_wait", float, cfg.binary_cache_wait)

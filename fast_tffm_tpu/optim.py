@@ -15,6 +15,18 @@ shapes, no `jnp.unique`), then a single gather→update→scatter touches each
 unique row exactly once.  Touching each row once matters: Adagrad is not
 linear in g (accum += g² must see the *summed* gradient, and duplicate
 scatter targets would race).
+
+Accumulator granularity: the accumulator array's trailing dim selects the
+variant — ``[V, D]`` is TF-Adagrad's per-element accumulator (parity
+default), ``[V, 1]`` is a per-ROW scalar accumulator
+(``accum += ‖g_row‖²``, one sqrt per row, broadcast over the row; the cfg
+``adagrad_accumulator = row`` opt-in).  What the row variant buys is
+OPTIMIZER-STATE MEMORY: accumulator HBM shrinks D× (at a 10B-parameter
+table the element accumulator doubles memory; row cuts the optimizer
+state to ~1/(1+k)).  Measured speed-neutral on one chip — the update's
+gathers are descriptor-bound, not byte-bound (DESIGN.md §6) — and the
+step size is coarser (grouped-AdaGrad-style), so element stays the
+default.
 """
 
 from __future__ import annotations
@@ -35,6 +47,27 @@ def init_adagrad(param, init_accumulator_value: float) -> AdagradState:
     return AdagradState(
         jax.tree.map(lambda p: jnp.full_like(p, init_accumulator_value), param)
     )
+
+
+def init_table_adagrad(
+    table: jax.Array, init_accumulator_value: float, accumulator: str = "element"
+) -> AdagradState:
+    """Accumulator for the sparse table: ``element`` ([V, D], TF parity) or
+    ``row`` ([V, 1], grouped accumulator — see module docstring)."""
+    if accumulator == "row":
+        return AdagradState(
+            jnp.full((table.shape[0], 1), init_accumulator_value, table.dtype)
+        )
+    if accumulator != "element":
+        raise ValueError(f"unknown adagrad accumulator {accumulator!r} (element | row)")
+    return init_adagrad(table, init_accumulator_value)
+
+
+def accum_sq(accum: jax.Array, gsum: jax.Array) -> jax.Array:
+    """g² in the granularity the accumulator's shape declares."""
+    if accum.shape[-1] == 1 and gsum.shape[-1] != 1:
+        return jnp.sum(gsum * gsum, axis=-1, keepdims=True)  # row mode
+    return gsum * gsum  # element mode
 
 
 def dense_adagrad_update(param, state: AdagradState, grad, lr: float):
@@ -87,9 +120,8 @@ def sparse_adagrad_update(
     """
     D = table.shape[-1]
     uids, gsum = dedup_rows(ids.reshape(-1), row_grads.reshape(-1, D), table.shape[0])
-    acc_rows = state.accum[uids] + gsum * gsum  # gather clamps on the sentinel,
-    new_acc_rows = acc_rows  # but mode='drop' below discards those lanes
-    upd_rows = table[uids] - lr * gsum / jnp.sqrt(new_acc_rows)
-    accum = state.accum.at[uids].set(new_acc_rows, mode="drop")
+    acc_rows = state.accum[uids] + accum_sq(state.accum, gsum)  # sentinel lanes
+    upd_rows = table[uids] - lr * gsum / jnp.sqrt(acc_rows)  # dropped below
+    accum = state.accum.at[uids].set(acc_rows, mode="drop")
     table = table.at[uids].set(upd_rows, mode="drop")
     return table, AdagradState(accum)

@@ -43,11 +43,13 @@ def apply_shard_adagrad(table_shard, accum_shard, guids, ggsum, lr, base):
     below and the all-to-all routed update (parallel/alltoall.py) must
     stay numerically identical, and both end here.  ``guids`` out of this
     shard's range (other shards' rows, dedup sentinels) drop."""
+    from fast_tffm_tpu.optim import accum_sq
+
     shard_rows = table_shard.shape[0]
     local = guids - base
     owned = (local >= 0) & (local < shard_rows)
     local = jnp.where(owned, local, shard_rows)  # out of range → mode='drop'
-    acc_rows = accum_shard[jnp.minimum(local, shard_rows - 1)] + ggsum * ggsum
+    acc_rows = accum_shard[jnp.minimum(local, shard_rows - 1)] + accum_sq(accum_shard, ggsum)
     upd_rows = table_shard[jnp.minimum(local, shard_rows - 1)] - lr * ggsum / jnp.sqrt(acc_rows)
     accum_shard = accum_shard.at[local].set(acc_rows, mode="drop")
     table_shard = table_shard.at[local].set(upd_rows, mode="drop")

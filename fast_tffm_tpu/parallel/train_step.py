@@ -116,10 +116,13 @@ def _pad_model_vocab(model, mesh: Mesh):
     return dataclasses.replace(model, vocabulary_size=padded)
 
 
-def init_sharded_state(model, mesh: Mesh, key, init_accumulator_value: float = 0.1):
+def init_sharded_state(
+    model, mesh: Mesh, key, init_accumulator_value: float = 0.1,
+    accumulator: str = "element",
+):
     """init_state placed with row-sharded table and replicated dense params."""
     model = _pad_model_vocab(model, mesh)
-    state = init_state(model, key, init_accumulator_value)
+    state = init_state(model, key, init_accumulator_value, accumulator)
     ts = table_sharding(mesh)
     rep = replicated(mesh)
     return TrainState(

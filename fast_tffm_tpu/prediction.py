@@ -110,7 +110,9 @@ def predict(cfg: Config, log=print) -> str:
     """Single-device prediction — the reference's `predict` mode."""
     model = build_model(cfg)
     max_nnz = scan_max_nnz(cfg)
-    state = init_state(model, jax.random.key(0), cfg.init_accumulator_value)
+    state = init_state(
+        model, jax.random.key(0), cfg.init_accumulator_value, cfg.adagrad_accumulator
+    )
     state = restore_checkpoint(cfg.model_file, state)
     return _run_predict(
         cfg, state, make_predict_step(model), max_nnz, log, with_fields=model.uses_fields
@@ -135,7 +137,9 @@ def dist_predict(cfg: Config, log=print, mesh=None) -> str:
         data = cfg.data_parallel or None
         mesh = make_mesh(data, row)
     check_batch_divides(cfg.batch_size, mesh)
-    state = init_sharded_state(model, mesh, jax.random.key(0), cfg.init_accumulator_value)
+    state = init_sharded_state(
+        model, mesh, jax.random.key(0), cfg.init_accumulator_value, cfg.adagrad_accumulator
+    )
     state = restore_checkpoint(cfg.model_file, state)
     return _run_predict(
         cfg,

@@ -23,6 +23,7 @@ from fast_tffm_tpu.optim import (
     AdagradState,
     dense_adagrad_update,
     init_adagrad,
+    init_table_adagrad,
     sparse_adagrad_update,
 )
 
@@ -37,13 +38,22 @@ class TrainState(NamedTuple):
     step: jax.Array  # i64 scalar
 
 
-def init_state(model, key: jax.Array, init_accumulator_value: float = 0.1) -> TrainState:
+def init_state(
+    model,
+    key: jax.Array,
+    init_accumulator_value: float = 0.1,
+    accumulator: str = "element",
+) -> TrainState:
+    """``accumulator``: table-accumulator granularity — ``element`` ([V, D],
+    TF-Adagrad parity) or ``row`` ([V, 1], D×-smaller optimizer state;
+    measured speed-neutral — see optim.py).  The dense (MLP) path is
+    always element-wise."""
     k1, k2 = jax.random.split(key)
     table = model.init_table(k1)
     dense = model.init_dense(k2)
     return TrainState(
         table=table,
-        table_opt=init_adagrad(table, init_accumulator_value),
+        table_opt=init_table_adagrad(table, init_accumulator_value, accumulator),
         dense=dense,
         dense_opt=init_adagrad(dense, init_accumulator_value),
         step=jnp.zeros((), jnp.int32),

@@ -317,7 +317,9 @@ def train(cfg: Config, *, resume: bool = False, log=print):
         )
     model = build_model(cfg)
     max_nnz = scan_max_nnz(cfg)
-    state = init_state(model, jax.random.key(0), cfg.init_accumulator_value)
+    state = init_state(
+        model, jax.random.key(0), cfg.init_accumulator_value, cfg.adagrad_accumulator
+    )
     if resume:
         state = restore_checkpoint(cfg.model_file, state)
         log(f"resumed from {cfg.model_file} at step {int(state.step)}")
@@ -370,7 +372,9 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
         mesh = make_mesh(data, row)
     log(f"mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))} on {mesh.devices.size} devices")
     check_batch_divides(cfg.batch_size, mesh)
-    state = init_sharded_state(model, mesh, jax.random.key(0), cfg.init_accumulator_value)
+    state = init_sharded_state(
+        model, mesh, jax.random.key(0), cfg.init_accumulator_value, cfg.adagrad_accumulator
+    )
     if resume:
         state = restore_checkpoint(cfg.model_file, state)
         log(f"resumed from {cfg.model_file} at step {int(state.step)}")

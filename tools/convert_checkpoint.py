@@ -46,7 +46,9 @@ def main(argv=None) -> int:
 
     cfg = load_config(args.config)
     model = build_model(cfg)
-    like = init_state(model, jax.random.key(0), cfg.init_accumulator_value)
+    like = init_state(
+        model, jax.random.key(0), cfg.init_accumulator_value, cfg.adagrad_accumulator
+    )
     state = restore_checkpoint(args.src, like)
     save_checkpoint(args.dst, state, args.format)
     print(f"converted {args.src} -> {args.dst} (step {int(state.step)})")
