@@ -94,7 +94,7 @@ class FmbFile:
     src_mtime_ns: int
     labels: np.ndarray  # f32 [n_rows]
     nnz: np.ndarray  # i32 [n_rows]
-    ids: np.ndarray  # i32|i64 [n_rows, width]
+    ids: np.ndarray  # i32 [n_rows, width]
     vals: np.ndarray  # f32 [n_rows, width]
     fields: np.ndarray  # i32 [n_rows, width]
 
