@@ -47,6 +47,8 @@ class Config:
     thread_num: int = 1  # host-side parse workers (reference: queue threads)
     binary_cache: bool = False  # parse text once into <file>.fmb, stream that
     binary_cache_wait: float = 600.0  # multi-host: non-lead wait for lead's build (s)
+    shuffle: bool = False  # per-epoch global shuffle of train rows (FMB input only)
+    shuffle_seed: int = 0
     queue_size: int = 8  # prefetch depth
     log_every: int = 100
     save_every_epochs: int = 1
@@ -88,6 +90,10 @@ class Config:
             raise ValueError(f"unknown compute_dtype {self.compute_dtype!r}")
         if self.lookup not in ("allgather", "alltoall"):
             raise ValueError(f"unknown lookup {self.lookup!r} (allgather | alltoall)")
+        if self.shuffle_seed < 0:
+            # numpy SeedSequence rejects negatives — fail at the config,
+            # not deep inside the prefetch thread.
+            raise ValueError(f"shuffle_seed must be >= 0, got {self.shuffle_seed}")
         if self.adagrad_accumulator not in ("element", "row"):
             raise ValueError(
                 f"unknown adagrad_accumulator {self.adagrad_accumulator!r} (element | row)"
@@ -170,6 +176,8 @@ def load_config(path: str) -> Config:
     cfg.thread_num = get(t, "thread_num", int, cfg.thread_num)
     cfg.binary_cache = get(t, "binary_cache", ini._convert_to_boolean, cfg.binary_cache)
     cfg.binary_cache_wait = get(t, "binary_cache_wait", float, cfg.binary_cache_wait)
+    cfg.shuffle = get(t, "shuffle", ini._convert_to_boolean, cfg.shuffle)
+    cfg.shuffle_seed = get(t, "shuffle_seed", int, cfg.shuffle_seed)
     cfg.queue_size = get(t, "queue_size", int, cfg.queue_size)
     cfg.log_every = get(t, "log_every", int, cfg.log_every)
     cfg.save_every_epochs = get(t, "save_every_epochs", int, cfg.save_every_epochs)

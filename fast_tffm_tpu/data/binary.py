@@ -298,6 +298,7 @@ def fmb_batch_stream(
     weights: Sequence[float] | None = None,
     drop_remainder: bool = False,
     pad_to_batches: int | None = None,
+    shuffle_seed: int | None = None,
 ) -> Iterator[tuple[ParsedBatch, np.ndarray]]:
     """Stream (ParsedBatch, example_weights) from FMB files.
 
@@ -308,6 +309,18 @@ def fmb_batch_stream(
     Batches freely span file and epoch boundaries, exactly like the text
     streams, and the emitted batches are bit-identical to the text path
     over the same source data.
+
+    ``shuffle_seed`` enables per-epoch global shuffling — a capability the
+    memmap format makes cheap (random access) and text streaming cannot
+    offer.  Semantics: each epoch e draws one permutation of ALL rows from
+    ``(shuffle_seed, e)``, defining an output SLOT order; sharding selects
+    slots (not source rows) by the same block-cyclic rule, so multi-host
+    processes assemble the same global batches from disjoint slot ranges
+    without communicating.  Same seed ⇒ same order everywhere, epochs
+    differ, and every epoch visits every row exactly once.  Memory:
+    O(8 bytes × total rows) per process for the permutation — fine into
+    the hundreds of millions of rows; beyond that, pre-shuffle at convert
+    time instead.
     """
     if weights is not None and len(weights) != len(files):
         raise ValueError(f"weights has {len(weights)} entries for {len(files)} files")
@@ -356,6 +369,68 @@ def fmb_batch_stream(
     labels, ids, vals, flds, nnz, w = alloc()
     filled = 0
     emitted = 0
+
+    def cycle_buffers():
+        """Emit the full batch and start fresh buffers — the one place the
+        buffer lifecycle lives, shared by the sequential and shuffled
+        loops (fresh zeroed buffers per yield is what makes column/tail
+        padding and prefetch-queue safety hold)."""
+        nonlocal labels, ids, vals, flds, nnz, w, filled, emitted
+        out = ParsedBatch(labels, ids, vals, flds, nnz), w
+        labels, ids, vals, flds, nnz, w = alloc()
+        filled = 0
+        emitted += 1
+        return out
+
+    if shuffle_seed is not None:
+        bounds = np.cumsum([0] + [f.n_rows for f in fs])
+        total = int(bounds[-1])
+        fweights = np.asarray(
+            [1.0] * len(fs) if weights is None else [float(x) for x in weights],
+            np.float32,
+        )
+        slot_base = 0  # global slot counter across epochs (cyclic-rule parity)
+        block = max(1, shard_block)
+        for e in range(max(0, epochs)):
+            # One permutation of ALL rows per epoch; slots are the output
+            # order, and this shard owns slots by the block-cyclic rule —
+            # every process derives the identical permutation from the seed.
+            perm = np.random.default_rng((shuffle_seed, e)).permutation(total)
+            slots = np.arange(total, dtype=np.int64)
+            mine = ((slot_base + slots) // block) % shard_count == shard_index
+            rows = perm[mine]  # source row per owned slot, in slot order
+            slot_base += total
+            pos = 0
+            while pos < len(rows):
+                take = min(len(rows) - pos, batch_size - filled)
+                chunk = rows[pos : pos + take]
+                fidx = np.searchsorted(bounds, chunk, side="right") - 1
+                local = chunk - bounds[fidx]
+                for fi in np.unique(fidx):
+                    m = fidx == fi
+                    f = fs[fi]
+                    li = local[m]
+                    dst = np.flatnonzero(m) + filled
+                    labels[dst] = f.labels[li]
+                    nnz[dst] = f.nnz[li]
+                    ids[dst, : f.width] = f.ids[li]
+                    vals[dst, : f.width] = f.vals[li]
+                    flds[dst, : f.width] = f.fields[li]
+                    w[dst] = fweights[fi]
+                filled += take
+                pos += take
+                if filled == batch_size:
+                    yield cycle_buffers()
+                    if pad_to_batches is not None and emitted >= pad_to_batches:
+                        return
+        from fast_tffm_tpu.data.pipeline import emit_assembled_tail
+
+        yield from emit_assembled_tail(
+            alloc, (labels, ids, vals, flds, nnz, w), filled, emitted,
+            drop_remainder, pad_to_batches,
+        )
+        return
+
     counter = 0  # global row index, running across files AND epochs
     for _ in range(max(0, epochs)):
         for fi, f in enumerate(fs):
@@ -374,10 +449,7 @@ def fmb_batch_stream(
                     filled += take
                     lo += take
                     if filled == batch_size:
-                        yield ParsedBatch(labels, ids, vals, flds, nnz), w
-                        emitted += 1
-                        labels, ids, vals, flds, nnz, w = alloc()
-                        filled = 0
+                        yield cycle_buffers()
                         if pad_to_batches is not None and emitted >= pad_to_batches:
                             return
             counter += f.n_rows

@@ -110,6 +110,7 @@ def batch_stream(
     pad_to_batches: int | None = None,
     parser=None,
     binary_cache: bool = False,
+    shuffle_seed: int | None = None,
 ) -> Iterator[tuple[ParsedBatch, np.ndarray]]:
     """Yield (ParsedBatch, example_weights[batch]) with static shapes.
 
@@ -173,8 +174,15 @@ def batch_stream(
             weights=weights,
             drop_remainder=drop_remainder,
             pad_to_batches=pad_to_batches,
+            shuffle_seed=shuffle_seed,
         )
         return
+    if shuffle_seed is not None:
+        raise ValueError(
+            "shuffle requires memmap (FMB) input — sequential text streaming "
+            "cannot reorder rows; set binary_cache = true or convert the "
+            "files (tools/convert_dataset.py / the convert CLI verb)"
+        )
 
     if isinstance(parser, NativeParser) and max_nnz is not None:
         # Full-native path: file reads, sharding, and parsing all in C++

@@ -84,6 +84,7 @@ def _stream(
     batch_size=None,
     weights=_TRAIN_WEIGHTS,
     to_batch=None,
+    shuffle_epoch=None,
     **shard_kw,
 ):
     """Prefetched input stream yielding ``(batch_or_None, parsed, w)``.
@@ -119,6 +120,28 @@ def _stream(
                 cfg.binary_cache_wait if jax.process_index() != 0 else 0.0
             ),
         )
+    # Per-epoch shuffle (train streams only — drivers create one stream per
+    # epoch and pass its index).  The seed folds the epoch so every epoch
+    # draws a fresh permutation, identically on every process.
+    shuffle_seed = (
+        cfg.shuffle_seed * 1_000_003 + shuffle_epoch
+        if cfg.shuffle and shuffle_epoch is not None
+        else None
+    )
+    if shuffle_seed is not None and cfg.binary_cache and not binary_input(files):
+        # The cache fell back to text (unwritable location): binary_cache
+        # is an accelerator and must keep degrading gracefully — drop the
+        # shuffle for this run rather than dying on batch_stream's
+        # "set binary_cache = true" (which the user already did).
+        import warnings
+
+        warnings.warn(
+            "shuffle disabled: the binary cache is unavailable (text "
+            "fallback) and text streaming cannot reorder rows",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        shuffle_seed = None
     raw = batch_stream(
         files,
         batch_size=batch_size if batch_size is not None else cfg.batch_size,
@@ -128,6 +151,7 @@ def _stream(
         epochs=epochs,
         weights=weights,
         parser=parser,
+        shuffle_seed=shuffle_seed,
         **shard_kw,
     )
     if to_batch is not None and binary_input(files):
@@ -183,7 +207,8 @@ def _run_training(
     sharded input + global-array stitching here without forking the loop."""
     if train_stream is None:
         train_stream = lambda epoch: _stream(
-            cfg, cfg.train_files, max_nnz, epochs=1, to_batch=to_batch
+            cfg, cfg.train_files, max_nnz, epochs=1, to_batch=to_batch,
+            shuffle_epoch=epoch,
         )
     if to_batch is None:
         to_batch = Batch.from_parsed
@@ -418,6 +443,7 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
                 shard_block=local_bs,
                 pad_to_batches=steps_per_epoch,
                 to_batch=to_batch,
+                shuffle_epoch=epoch,
             )
 
         def to_batch(parsed, w):

@@ -334,3 +334,128 @@ def test_end_to_end_train_with_fmb(tmp_path, dataset):
     np.testing.assert_array_equal(
         np.asarray(jax.device_get(s_text.table)), np.asarray(jax.device_get(s_fmb.table))
     )
+
+
+class TestShuffle:
+    """shuffle_seed: per-epoch global permutation over memmap rows."""
+
+    def _rows(self, stream):
+        """Flatten a stream into per-row tuples (label, ids, vals, w), real rows only."""
+        out = []
+        for p, w in stream:
+            for i in range(p.batch_size):
+                if w[i] > 0 or p.nnz[i] > 0:
+                    out.append(
+                        (float(p.labels[i]), tuple(np.asarray(p.ids[i], np.int64)),
+                         tuple(p.vals[i]), float(w[i]))
+                    )
+        return out
+
+    def test_permutes_without_loss_and_epochs_differ(self, dataset):
+        a, b = dataset
+        fa = write_fmb(a, a + ".fmb", vocabulary_size=1000)
+        fb = write_fmb(b, b + ".fmb", vocabulary_size=1000)
+        common = dict(batch_size=16, vocabulary_size=1000, max_nnz=9,
+                      weights=(2.0, 0.5))
+        plain = self._rows(fmb_batch_stream([fa, fb], **common))
+        e0 = self._rows(fmb_batch_stream([fa, fb], **common, shuffle_seed=7))
+        e01 = self._rows(fmb_batch_stream([fa, fb], **common, epochs=2, shuffle_seed=7))
+        # Same multiset of (row, weight) pairs — weights follow their rows.
+        assert sorted(e0) == sorted(plain)
+        assert e0 != plain  # actually reordered
+        # Epoch 0 of the 2-epoch stream is identical; epoch 1 reorders.
+        assert e01[: len(e0)] == e0
+        assert sorted(e01[len(e0):]) == sorted(plain)
+        assert e01[len(e0):] != e0
+        # Determinism: same seed, same order.
+        assert self._rows(fmb_batch_stream([fa, fb], **common, shuffle_seed=7)) == e0
+        # Different seed, different order.
+        assert self._rows(fmb_batch_stream([fa, fb], **common, shuffle_seed=8)) != e0
+
+    def test_shards_partition_the_shuffled_slots(self, dataset):
+        a, b = dataset
+        fa = write_fmb(a, a + ".fmb", vocabulary_size=1000)
+        fb = write_fmb(b, b + ".fmb", vocabulary_size=1000)
+        # Global batch 12, 3 shards x block 4: shard p owns rows
+        # [4p, 4p+4) of every global batch of the SHUFFLED order.
+        full = self._rows(fmb_batch_stream(
+            [fa, fb], batch_size=12, vocabulary_size=1000, max_nnz=9, shuffle_seed=3,
+        ))
+        shards = [
+            self._rows(fmb_batch_stream(
+                [fa, fb], batch_size=4, vocabulary_size=1000, max_nnz=9,
+                shuffle_seed=3, shard_index=i, shard_count=3, shard_block=4,
+            ))
+            for i in range(3)
+        ]
+        # Stitch: global batch g = shard0[4g:4g+4] + shard1[...] + shard2[...]
+        stitched = []
+        g = 0
+        while any(4 * g < len(s) for s in shards):
+            for s in shards:
+                stitched.extend(s[4 * g: 4 * g + 4])
+            g += 1
+        assert stitched == full
+
+    def test_text_input_rejected(self, dataset):
+        a, _ = dataset
+        with pytest.raises(ValueError, match="shuffle requires"):
+            list(batch_stream([a], batch_size=8, vocabulary_size=1000,
+                              max_nnz=9, shuffle_seed=1))
+
+    def test_train_with_shuffle_learns(self, tmp_path, dataset):
+        import jax
+
+        from fast_tffm_tpu.config import Config
+        from fast_tffm_tpu.training import train
+
+        a, b = dataset
+        cfg = Config(
+            vocabulary_size=1000,
+            factor_num=4,
+            model_file=str(tmp_path / "s.ckpt"),
+            train_files=(a, b),
+            epoch_num=3,
+            batch_size=16,
+            learning_rate=0.05,
+            log_every=1000,
+            binary_cache=True,
+            shuffle=True,
+            shuffle_seed=11,
+        ).validate()
+        state = train(cfg, log=lambda *_: None)
+        assert np.isfinite(np.asarray(jax.device_get(state.table))).all()
+        assert int(state.step) > 0
+
+    def test_shuffle_degrades_with_cache_fallback(self, tmp_path, monkeypatch):
+        """Unwritable cache + shuffle must warn and train unshuffled, not
+        crash with a misleading 'set binary_cache = true'."""
+        import jax
+
+        import fast_tffm_tpu.data.binary as binary_mod
+        from fast_tffm_tpu.config import Config
+        from fast_tffm_tpu.training import train
+
+        rng = np.random.default_rng(17)
+        src = _write_text(tmp_path / "ro.libsvm", 40, rng)
+
+        def _raise(*a, **k):
+            raise OSError("read-only file system")
+
+        monkeypatch.setattr(binary_mod, "write_fmb", _raise)
+        monkeypatch.setattr(binary_mod, "_BUILD_FAILED", set())
+        cfg = Config(
+            vocabulary_size=1000, factor_num=4,
+            model_file=str(tmp_path / "m.ckpt"),
+            train_files=(src,), epoch_num=1, batch_size=16,
+            log_every=1000, binary_cache=True, shuffle=True,
+        ).validate()
+        with pytest.warns(RuntimeWarning):
+            state = train(cfg, log=lambda *_: None)
+        assert np.isfinite(np.asarray(jax.device_get(state.table))).all()
+
+    def test_negative_seed_rejected_at_config(self):
+        from fast_tffm_tpu.config import Config
+
+        with pytest.raises(ValueError, match="shuffle_seed"):
+            Config(shuffle=True, shuffle_seed=-1).validate()
