@@ -459,3 +459,24 @@ class TestShuffle:
 
         with pytest.raises(ValueError, match="shuffle_seed"):
             Config(shuffle=True, shuffle_seed=-1).validate()
+
+    def test_shuffle_with_pad_and_drop(self, dataset):
+        """Tail semantics hold under shuffle: drop_remainder drops the short
+        batch; pad_to_batches emits exactly N batches with weight-0 tails."""
+        a, b = dataset  # 53 + 31 = 84 rows
+        fa = write_fmb(a, a + ".fmb", vocabulary_size=1000)
+        fb = write_fmb(b, b + ".fmb", vocabulary_size=1000)
+        common = dict(vocabulary_size=1000, max_nnz=9, shuffle_seed=9)
+
+        dropped = list(fmb_batch_stream([fa, fb], batch_size=16,
+                                        drop_remainder=True, **common))
+        assert len(dropped) == 84 // 16
+        assert all((w > 0).all() for _, w in dropped)
+
+        padded = list(fmb_batch_stream([fa, fb], batch_size=16,
+                                       pad_to_batches=8, **common))
+        assert len(padded) == 8
+        real = sum(int((w > 0).sum()) for _, w in padded)
+        assert real == 84  # every row exactly once, rest weight-0 padding
+        # The two all-empty tail batches carry no rows.
+        assert all((w == 0).all() for _, w in padded[6:])
