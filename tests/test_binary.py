@@ -480,3 +480,32 @@ class TestShuffle:
         assert real == 84  # every row exactly once, rest weight-0 padding
         # The two all-empty tail batches carry no rows.
         assert all((w == 0).all() for _, w in padded[6:])
+
+    def test_train_shuffles_direct_fmb_inputs(self, tmp_path, dataset):
+        """shuffle works on .fmb paths listed directly (no binary_cache)."""
+        import jax
+
+        from fast_tffm_tpu.config import Config
+        from fast_tffm_tpu.training import train
+
+        a, b = dataset
+        fa = write_fmb(a, a + ".fmb", vocabulary_size=1000)
+        fb = write_fmb(b, b + ".fmb", vocabulary_size=1000)
+        cfg = Config(
+            vocabulary_size=1000, factor_num=4,
+            model_file=str(tmp_path / "d.ckpt"),
+            train_files=(fa, fb), epoch_num=2, batch_size=16,
+            log_every=1000, shuffle=True, shuffle_seed=3,
+        ).validate()
+        state = train(cfg, log=lambda *_: None)
+        assert np.isfinite(np.asarray(jax.device_get(state.table))).all()
+        # Shuffled training visits the same data: same step count as the
+        # unshuffled run over the same files.
+        cfg2 = Config(
+            vocabulary_size=1000, factor_num=4,
+            model_file=str(tmp_path / "d2.ckpt"),
+            train_files=(fa, fb), epoch_num=2, batch_size=16,
+            log_every=1000,
+        ).validate()
+        state2 = train(cfg2, log=lambda *_: None)
+        assert int(state.step) == int(state2.step)
