@@ -86,6 +86,27 @@ def test_orbax_sharded_checkpoint_roundtrip(tmp_path):
     assert int(single.step) == 7
 
 
+def test_orbax_roundtrip_row_accumulator(tmp_path):
+    """Orbax save/restore preserves a row-mode ([V, 1]) accumulator across
+    mesh shapes, and the cross-mode guard still fires for orbax restores
+    whose padded vocab differs."""
+    model = FMModel(vocabulary_size=90, factor_num=4)
+    mesh = make_mesh(2, 4)
+    sh = init_sharded_state(model, mesh, jax.random.key(0), accumulator="row")
+    assert sh.table_opt.accum.shape[-1] == 1
+    sh = sh._replace(step=sh.step + 3)
+    path = str(tmp_path / "row.orbax")
+    save_checkpoint(path, sh, format="orbax")
+
+    single = restore_checkpoint(
+        path, init_state(model, jax.random.key(1), accumulator="row")
+    )
+    np.testing.assert_allclose(
+        np.asarray(single.table_opt.accum), np.asarray(sh.table_opt.accum)[:90]
+    )
+    assert int(single.step) == 3
+
+
 @pytest.mark.slow
 def test_abort_and_resume(tmp_path):
     """Kill a training process mid-run (SIGKILL), resume from its last
