@@ -94,17 +94,60 @@ def _save_orbax(path: str, state: TrainState) -> None:
             f.write(str(int(state.step)))
 
 
-def _orbax_table_shape(path: str):
-    """Saved table's global shape from checkpoint metadata (no data reads)."""
+def _orbax_metadata_item(path: str):
+    """Checkpoint metadata tree (no data reads), fetched once per restore."""
     import orbax.checkpoint as ocp
 
     meta = ocp.StandardCheckpointer().metadata(os.path.abspath(path))
-    item = getattr(meta, "item_metadata", meta)
-    table_meta = item.table if hasattr(item, "table") else item["table"]
-    return tuple(table_meta.shape)
+    return getattr(meta, "item_metadata", meta)
 
 
-def _restore_orbax_inplace(path: str, like: TrainState):
+def _meta_field(item, name):
+    return getattr(item, name) if hasattr(item, name) else item[name]
+
+
+def _orbax_table_shape(path: str, item=None):
+    """Saved table's global shape from checkpoint metadata."""
+    if item is None:
+        item = _orbax_metadata_item(path)
+    return tuple(_meta_field(item, "table").shape)
+
+
+def _orbax_accum_width(item):
+    """Saved table accumulator's trailing dim from the metadata tree;
+    None when the tree doesn't expose it (older orbax versions)."""
+    try:
+        return int(tuple(_meta_field(_meta_field(item, "table_opt"), "accum").shape)[-1])
+    except Exception:
+        return None
+
+
+def _accum_mode_error(path: str, saved_width: int, want_width: int) -> ValueError:
+    """Accumulator granularity is part of the optimizer's identity: a
+    [V, D] element accumulator cannot serve a row-mode state (or vice
+    versa) — silently proceeding would either ignore the configured mode
+    or numpy-broadcast a fabricated accumulator in the re-pad path."""
+    if saved_width > 1 and want_width > 1:
+        # Both element-mode: the widths differ because the ROW width does
+        # (factor_num / model change) — adagrad_accumulator is the wrong
+        # knob for that.
+        return ValueError(
+            f"checkpoint {path!r} has accumulator rows of width {saved_width} "
+            f"but this config expects width {want_width} — the model's row "
+            "width changed (factor_num / model type); restore with the "
+            "configuration the checkpoint was trained under"
+        )
+    mode = lambda d: "row" if d == 1 else "element"
+    return ValueError(
+        f"checkpoint {path!r} was trained with adagrad_accumulator = "
+        f"{mode(saved_width)} (accum width {saved_width}) "
+        f"but this config expects {mode(want_width)} "
+        f"(width {want_width}); set adagrad_accumulator "
+        "to match the checkpoint"
+    )
+
+
+def _restore_orbax_inplace(path: str, like: TrainState, meta_item=None):
     """Sharded restore straight onto ``like``'s placement (no host gather).
 
     Real restore failures (corrupt checkpoint, version mismatch) propagate;
@@ -114,7 +157,7 @@ def _restore_orbax_inplace(path: str, like: TrainState):
     """
     import orbax.checkpoint as ocp
 
-    if _orbax_table_shape(path) != tuple(like.table.shape):
+    if _orbax_table_shape(path, meta_item) != tuple(like.table.shape):
         return None
     abstract = jax.tree.map(
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding), like
@@ -174,7 +217,16 @@ def restore_checkpoint(path: str, like: TrainState) -> TrainState:
     """
     path = path.rstrip("/")
     if os.path.isdir(path):
-        restored = _restore_orbax_inplace(path, like)
+        # Mode mismatch first, from metadata alone: the inplace restore
+        # would otherwise surface it as an opaque orbax shape error (or,
+        # multi-host with a vocab-padding difference too, as the misleading
+        # table-shape RuntimeError below).
+        meta_item = _orbax_metadata_item(path)
+        saved_width = _orbax_accum_width(meta_item)
+        want_width = like.table_opt.accum.shape[-1]
+        if saved_width is not None and saved_width != want_width:
+            raise _accum_mode_error(path, saved_width, want_width)
+        restored = _restore_orbax_inplace(path, like, meta_item)
         if restored is not None:
             return restored
         if jax.process_count() > 1:
@@ -193,18 +245,8 @@ def restore_checkpoint(path: str, like: TrainState) -> TrainState:
         table, table_accum, new_dense, new_accum, step = _load_npz(path, like)
 
     if table_accum.shape[-1] != like.table_opt.accum.shape[-1]:
-        # Accumulator granularity is part of the optimizer's identity: a
-        # [V, D] element accumulator cannot serve a row-mode state (or
-        # vice versa) — silently proceeding would either ignore the
-        # configured mode or numpy-broadcast a fabricated accumulator in
-        # the re-pad path below.
-        mode = lambda d: "row" if d == 1 else "element"
-        raise ValueError(
-            f"checkpoint {path!r} was trained with adagrad_accumulator = "
-            f"{mode(table_accum.shape[-1])} (accum width {table_accum.shape[-1]}) "
-            f"but this config expects {mode(like.table_opt.accum.shape[-1])} "
-            f"(width {like.table_opt.accum.shape[-1]}); set adagrad_accumulator "
-            "to match the checkpoint"
+        raise _accum_mode_error(
+            path, table_accum.shape[-1], like.table_opt.accum.shape[-1]
         )
     if table.shape[0] != like.table.shape[0]:
         # Mesh-shape change ⇒ different vocab padding; re-pad with init rows.

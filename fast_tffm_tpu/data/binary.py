@@ -56,7 +56,12 @@ __all__ = [
 FMB_MAGIC = b"FMB1"
 _ALIGN = 64
 # magic, version, n_rows, width, vocabulary_size, hashed, ids_itemsize,
-# (pad), src_size, src_mtime_ns, reserved
+# (pad), src_size, src_mtime_ns, max_row_nnz
+# max_row_nnz is the file's WIDEST ACTUAL ROW — `width` is the converter's
+# (possibly generous) --max-nnz padding choice.  Readers compare a
+# requested max_nnz against the actual widest row, so a generously-padded
+# file still serves a narrower training config.  0 = unknown (files
+# written before the field existed; readers fall back to scanning nnz).
 _HEADER = struct.Struct("<4sIqqqBB6xqqq")
 assert _HEADER.size <= _ALIGN
 
@@ -92,6 +97,7 @@ class FmbFile:
     hashed: bool
     src_size: int
     src_mtime_ns: int
+    max_row_nnz: int  # widest actual row; 0 = unknown (pre-field files)
     labels: np.ndarray  # f32 [n_rows]
     nnz: np.ndarray  # i32 [n_rows]
     ids: np.ndarray  # i32 [n_rows, width]
@@ -113,7 +119,7 @@ def _read_header(path):
         raw = f.read(_HEADER.size)
     if len(raw) < _HEADER.size:
         raise ValueError(f"{path}: truncated FMB header")
-    magic, version, n_rows, width, vocab, hashed, isz, src_size, src_mtime, _ = (
+    magic, version, n_rows, width, vocab, hashed, isz, src_size, src_mtime, widest = (
         _HEADER.unpack(raw)
     )
     if magic != FMB_MAGIC:
@@ -125,13 +131,13 @@ def _read_header(path):
         # gather index dtype) and config caps vocabulary_size to match, so
         # a wider id section could only ever truncate silently downstream.
         raise ValueError(f"{path}: unsupported ids itemsize {isz} (int32 only)")
-    return n_rows, width, vocab, bool(hashed), isz, src_size, src_mtime
+    return n_rows, width, vocab, bool(hashed), isz, src_size, src_mtime, widest
 
 
 def open_fmb(path) -> FmbFile:
     """Memmap an FMB file into array views (no data is read eagerly)."""
     path = os.fspath(path)
-    n_rows, width, vocab, hashed, isz, src_size, src_mtime = _read_header(path)
+    n_rows, width, vocab, hashed, isz, src_size, src_mtime, widest = _read_header(path)
     o_lab, o_nnz, o_ids, o_val, o_fld, total = _section_offsets(n_rows, width, isz)
     if os.path.getsize(path) < total:
         raise ValueError(f"{path}: truncated FMB file (partial write?)")
@@ -148,6 +154,7 @@ def open_fmb(path) -> FmbFile:
         hashed=hashed,
         src_size=src_size,
         src_mtime_ns=src_mtime,
+        max_row_nnz=widest,
         labels=view(o_lab, n_rows, np.float32, (n_rows,)),
         nnz=view(o_nnz, n_rows, np.int32, (n_rows,)),
         ids=view(o_ids, n_rows * width, np.int32, (n_rows, width)),
@@ -210,7 +217,8 @@ def write_fmb(
         mm[: _HEADER.size] = np.frombuffer(
             _HEADER.pack(
                 FMB_MAGIC, 1, n_rows, width, vocabulary_size,
-                1 if hash_feature_id else 0, isz, st.st_size, st.st_mtime_ns, 0,
+                1 if hash_feature_id else 0, isz, st.st_size, st.st_mtime_ns,
+                max(1, widest),
             ),
             np.uint8,
         )
@@ -350,12 +358,17 @@ def fmb_batch_stream(
     width = int(max_nnz) if max_nnz else max([f.width for f in fs] or [1])
     for f in fs:
         if f.width > width:
-            # The text path fails on the first too-wide ROW; the stored
-            # width is the file's widest row, so this is the same condition
-            # surfaced at open time instead of mid-stream.
-            raise ValueError(
-                f"{f.path}: rows up to {f.width} features > max_nnz={width}"
-            )
+            # The stored width is the converter's (possibly generous)
+            # padding choice, not the data's — only an actual ROW wider
+            # than the request is an error (the condition the text path
+            # surfaces mid-stream, here at open time).  Columns beyond
+            # ``width`` in such a file are guaranteed padding zeros and
+            # the copy loops below clamp them off.
+            widest = f.max_row_nnz or (int(f.nnz.max()) if f.n_rows else 0)
+            if widest > width:
+                raise ValueError(
+                    f"{f.path}: rows up to {widest} features > max_nnz={width}"
+                )
     def alloc():
         return (
             np.zeros((batch_size,), np.float32),
@@ -411,11 +424,12 @@ def fmb_batch_stream(
                     f = fs[fi]
                     li = local[m]
                     dst = np.flatnonzero(m) + filled
+                    cw = min(f.width, width)  # clamp generous padding off
                     labels[dst] = f.labels[li]
                     nnz[dst] = f.nnz[li]
-                    ids[dst, : f.width] = f.ids[li]
-                    vals[dst, : f.width] = f.vals[li]
-                    flds[dst, : f.width] = f.fields[li]
+                    ids[dst, :cw] = f.ids[li, :cw]
+                    vals[dst, :cw] = f.vals[li, :cw]
+                    flds[dst, :cw] = f.fields[li, :cw]
                     w[dst] = fweights[fi]
                 filled += take
                 pos += take
@@ -435,6 +449,7 @@ def fmb_batch_stream(
     for _ in range(max(0, epochs)):
         for fi, f in enumerate(fs):
             fw = 1.0 if weights is None else float(weights[fi])
+            cw = min(f.width, width)  # clamp generous padding off
             for lo, hi in _shard_runs(counter, f.n_rows, shard_index, shard_count, shard_block):
                 while lo < hi:
                     take = min(hi - lo, batch_size - filled)
@@ -442,9 +457,9 @@ def fmb_batch_stream(
                     out = slice(filled, filled + take)
                     labels[out] = f.labels[sl]
                     nnz[out] = f.nnz[sl]
-                    ids[out, : f.width] = f.ids[sl]
-                    vals[out, : f.width] = f.vals[sl]
-                    flds[out, : f.width] = f.fields[sl]
+                    ids[out, :cw] = f.ids[sl, :cw]
+                    vals[out, :cw] = f.vals[sl, :cw]
+                    flds[out, :cw] = f.fields[sl, :cw]
                     w[out] = fw
                     filled += take
                     lo += take
@@ -520,7 +535,9 @@ def ensure_fmb_cache(
         try:
             if not is_fmb(cache):
                 return False
-            n, width, vocab, hashed, _isz, src_size, src_mtime = _read_header(cache)
+            n, width, vocab, hashed, _isz, src_size, src_mtime, widest = (
+                _read_header(cache)
+            )
         except (ValueError, OSError):
             # OSError: the wait loop polls exactly while a peer's
             # os.replace lands — transient ESTALE/ENOENT on network
@@ -531,7 +548,15 @@ def ensure_fmb_cache(
             and src_mtime == st.st_mtime_ns
             and hashed == bool(hash_feature_id)
             and (vocab == vocabulary_size if hashed else vocab <= vocabulary_size)
-            and (max_nnz is None or width <= max_nnz)
+            # A generously-padded cache still serves a narrower max_nnz as
+            # long as its ACTUAL widest row fits (the stream clamps the
+            # padding columns); widest == 0 means a pre-field file, where
+            # only the stored width is trustworthy.
+            and (
+                max_nnz is None
+                or width <= max_nnz
+                or (widest > 0 and widest <= max_nnz)
+            )
         )
 
     out: list[str] = []

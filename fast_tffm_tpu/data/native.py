@@ -370,11 +370,13 @@ def _scan_one(path) -> tuple[int, int]:
 
     if is_fmb(path):
         # Header-only read (64 bytes) — no reason to memmap the data
-        # sections here.  Stored width is the file's widest row only when
-        # the converter was not given an explicit (larger) max_nnz; either
-        # way it bounds the widest row, which is all scan callers need.
-        n_rows, width, *_ = _read_header(path)
-        out = (n_rows, width)
+        # sections here.  Prefer the recorded widest ACTUAL row over the
+        # stored width (the converter's possibly-generous --max-nnz
+        # padding choice), so an auto-derived training max_nnz doesn't
+        # inherit padding; 0 means a pre-field file, where only the
+        # stored width is trustworthy.
+        n_rows, width, _v, _h, _i, _s, _m, widest = _read_header(path)
+        out = (n_rows, widest if widest > 0 else width)
         _scan_cache[key] = out
         return out
     native = load_native_parser()

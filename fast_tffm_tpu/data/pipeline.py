@@ -155,6 +155,7 @@ def batch_stream(
             parser=parser,
         )
     fmb = [is_fmb(p) for p in files]
+    cache_fell_back = binary_cache and not all(fmb)
     if any(fmb):
         if not all(fmb):
             raise ValueError(
@@ -178,6 +179,16 @@ def batch_stream(
         )
         return
     if shuffle_seed is not None:
+        if cache_fell_back:
+            # The caller ALREADY asked for the cache; repeating "set
+            # binary_cache = true" would send them in a circle.
+            raise ValueError(
+                "shuffle requires memmap (FMB) input, and the binary cache "
+                "could not be built (cache location unwritable?) — fix the "
+                "cache-directory permissions or convert the files to a "
+                "writable location (tools/convert_dataset.py / the convert "
+                "CLI verb)"
+            )
         raise ValueError(
             "shuffle requires memmap (FMB) input — sequential text streaming "
             "cannot reorder rows; set binary_cache = true or convert the "

@@ -129,10 +129,26 @@ def _stream(
         else None
     )
     if shuffle_seed is not None and cfg.binary_cache and not binary_input(files):
-        # The cache fell back to text (unwritable location): binary_cache
-        # is an accelerator and must keep degrading gracefully — drop the
-        # shuffle for this run rather than dying on batch_stream's
-        # "set binary_cache = true" (which the user already did).
+        if jax.process_count() > 1:
+            # The fallback decision is PER-PROCESS (host-local disks can
+            # fail on some hosts only).  A process streaming its shard
+            # sequentially while its peers follow the epoch permutation
+            # would let make_global_batch stitch shards drawn from
+            # different row orderings into one global batch — silently
+            # duplicating/dropping examples for the whole run.  Die loudly
+            # instead; every process either shuffles or none do.
+            raise RuntimeError(
+                "shuffle with binary_cache on a multi-process run: this "
+                "process could not build/reach the binary cache (text "
+                "fallback), and a per-host shuffle fallback would silently "
+                "misalign the global batches — fix the cache location on "
+                "every host (or pre-convert the files, or disable shuffle)"
+            )
+        # Single process: the cache fell back to text (unwritable
+        # location); binary_cache is an accelerator and must keep
+        # degrading gracefully — drop the shuffle for this run rather
+        # than dying on batch_stream's "set binary_cache = true" (which
+        # the user already did).
         import warnings
 
         warnings.warn(

@@ -173,6 +173,85 @@ def test_width_overflow_rejected(dataset):
                               max_nnz=wid - 1))
 
 
+def test_generous_width_serves_narrower_max_nnz(dataset):
+    """A file packed with a generous --max-nnz stays usable for a smaller
+    training max_nnz as long as every ACTUAL row fits: the stored width is
+    the converter's padding choice, not the data's (header records the
+    true widest row), and the stream clamps the padding columns off —
+    bit-identical to the text path at the narrow width."""
+    a, _ = dataset
+    fa = write_fmb(a, a + ".fmb", vocabulary_size=1000, max_nnz=16)
+    f = open_fmb(fa)
+    assert f.width == 16 and 0 < f.max_row_nnz < 16
+    narrow = f.max_row_nnz  # tightest width every row fits
+    common = dict(batch_size=8, vocabulary_size=1000, max_nnz=narrow)
+    _assert_streams_equal(
+        _collect(batch_stream([a], **common)),
+        _collect(fmb_batch_stream([fa], **common)),
+    )
+    # The shuffled path clamps identically (one-file perm == slot order
+    # permutation of rows; compare against itself at the stored width).
+    wide = _collect(
+        fmb_batch_stream([fa], batch_size=8, vocabulary_size=1000,
+                         max_nnz=16, shuffle_seed=5)
+    )
+    nar = _collect(
+        fmb_batch_stream([fa], batch_size=8, vocabulary_size=1000,
+                         max_nnz=narrow, shuffle_seed=5)
+    )
+    for (wl, wi, wv, wf, wn, ww), (nl, ni, nv, nf, nn, nw) in zip(wide, nar):
+        np.testing.assert_array_equal(wi[:, :narrow], ni)
+        assert not wi[:, narrow:].any()  # clamped columns were padding
+        np.testing.assert_array_equal(wl, nl)
+        np.testing.assert_array_equal(wn, nn)
+    # An actual row wider than the request is still an error.
+    with pytest.raises(ValueError, match="max_nnz"):
+        list(fmb_batch_stream([fa], batch_size=8, vocabulary_size=1000,
+                              max_nnz=narrow - 1))
+
+
+def test_pre_field_file_falls_back_to_nnz_scan(dataset):
+    """Files written before max_row_nnz existed carry 0 there; the width
+    check must then scan the nnz section instead of rejecting outright."""
+    import struct
+
+    from fast_tffm_tpu.data.binary import _HEADER
+
+    a, _ = dataset
+    fa = write_fmb(a, a + ".fmb", vocabulary_size=1000, max_nnz=16)
+    # Zero the max_row_nnz header slot (the trailing q) in place.
+    with open(fa, "r+b") as fh:
+        raw = fh.read(_HEADER.size)
+        vals = list(_HEADER.unpack(raw))
+        vals[-1] = 0
+        fh.seek(0)
+        fh.write(_HEADER.pack(*vals))
+    f = open_fmb(fa)
+    assert f.max_row_nnz == 0
+    widest = int(f.nnz.max())
+    assert _collect(
+        fmb_batch_stream([fa], batch_size=8, vocabulary_size=1000, max_nnz=widest)
+    )
+    with pytest.raises(ValueError, match="max_nnz"):
+        list(fmb_batch_stream([fa], batch_size=8, vocabulary_size=1000,
+                              max_nnz=widest - 1))
+
+
+def test_cache_fresh_for_narrower_max_nnz(dataset):
+    """ensure_fmb_cache reuses a generously-padded cache for a smaller
+    max_nnz when the actual widest row fits — no rebuild."""
+    a, _ = dataset
+    (c1,) = ensure_fmb_cache([a], vocabulary_size=1000, max_nnz=16)
+    stamp = os.stat(c1).st_mtime_ns
+    widest = open_fmb(c1).max_row_nnz
+    (c2,) = ensure_fmb_cache([a], vocabulary_size=1000, max_nnz=widest)
+    assert os.stat(c2).st_mtime_ns == stamp  # reused, not rebuilt
+    # Too narrow for the data -> rebuild attempt (which then fails parsing
+    # a too-wide row — the honest outcome, not a silent reuse).
+    with pytest.raises(ValueError):
+        ensure_fmb_cache([a], vocabulary_size=1000, max_nnz=widest - 1)
+
+
 def test_truncated_file_rejected(dataset):
     a, _ = dataset
     fa = write_fmb(a, a + ".fmb", vocabulary_size=1000)
@@ -453,6 +532,59 @@ class TestShuffle:
         with pytest.warns(RuntimeWarning):
             state = train(cfg, log=lambda *_: None)
         assert np.isfinite(np.asarray(jax.device_get(state.table))).all()
+
+    def test_shuffle_cache_fallback_raises_multiprocess(self, tmp_path, monkeypatch):
+        """Multi-process runs must NOT silently degrade per-host: a process
+        whose cache fell back to text would stream a different row order
+        than its shuffling peers, and make_global_batch would stitch
+        misaligned shards for the whole run.  The fallback must die loudly
+        instead."""
+        import fast_tffm_tpu.data.binary as binary_mod
+        import fast_tffm_tpu.training as training_mod
+        from fast_tffm_tpu.config import Config
+
+        rng = np.random.default_rng(19)
+        src = _write_text(tmp_path / "mp.libsvm", 40, rng)
+
+        def _raise(*a, **k):
+            raise OSError("read-only file system")
+
+        monkeypatch.setattr(binary_mod, "write_fmb", _raise)
+        monkeypatch.setattr(binary_mod, "_BUILD_FAILED", set())
+        monkeypatch.setattr(training_mod.jax, "process_count", lambda: 2)
+        cfg = Config(
+            vocabulary_size=1000, factor_num=4,
+            model_file=str(tmp_path / "m.ckpt"),
+            train_files=(src,), epoch_num=1, batch_size=16,
+            log_every=1000, binary_cache=True, shuffle=True,
+        ).validate()
+        with pytest.warns(RuntimeWarning, match="streaming text"):
+            with pytest.raises(RuntimeError, match="multi-process"):
+                training_mod._stream(
+                    cfg, cfg.train_files, 9, epochs=1, shuffle_epoch=0
+                )
+
+    def test_batch_stream_fallback_message_tailored(self, tmp_path, monkeypatch):
+        """Library users who passed binary_cache=True must not be told to
+        'set binary_cache = true' when the cache build itself failed."""
+        import fast_tffm_tpu.data.binary as binary_mod
+
+        rng = np.random.default_rng(23)
+        src = _write_text(tmp_path / "lib.libsvm", 30, rng)
+
+        def _raise(*a, **k):
+            raise OSError("read-only file system")
+
+        monkeypatch.setattr(binary_mod, "write_fmb", _raise)
+        monkeypatch.setattr(binary_mod, "_BUILD_FAILED", set())
+        with pytest.warns(RuntimeWarning, match="streaming text"):
+            with pytest.raises(ValueError, match="could not be built"):
+                list(
+                    batch_stream(
+                        [src], batch_size=8, vocabulary_size=1000, max_nnz=9,
+                        binary_cache=True, shuffle_seed=3,
+                    )
+                )
 
     def test_negative_seed_rejected_at_config(self):
         from fast_tffm_tpu.config import Config

@@ -107,6 +107,26 @@ def test_orbax_roundtrip_row_accumulator(tmp_path):
     assert int(single.step) == 3
 
 
+def test_orbax_accum_mode_mismatch_friendly_error(tmp_path):
+    """Accumulator-mode mismatch surfaces the adagrad_accumulator remedy
+    even when the TABLE shape matches (the inplace restore path, where it
+    would otherwise appear as an opaque orbax shape error)."""
+    model = FMModel(vocabulary_size=90, factor_num=4)
+    mesh = make_mesh(2, 4)
+    sh = init_sharded_state(model, mesh, jax.random.key(0))  # element mode
+    path = str(tmp_path / "el.orbax")
+    save_checkpoint(path, sh, format="orbax")
+    like = init_sharded_state(model, mesh, jax.random.key(1), accumulator="row")
+    assert like.table.shape == sh.table.shape  # same mesh -> same padding
+    with pytest.raises(ValueError, match="adagrad_accumulator"):
+        restore_checkpoint(path, like)
+    # Width mismatch with BOTH sides element-mode is a factor_num/model
+    # change, not an accumulator-mode one — the remedy must say so.
+    other = FMModel(vocabulary_size=90, factor_num=8)
+    with pytest.raises(ValueError, match="factor_num"):
+        restore_checkpoint(path, init_state(other, jax.random.key(2)))
+
+
 @pytest.mark.slow
 def test_abort_and_resume(tmp_path):
     """Kill a training process mid-run (SIGKILL), resume from its last
