@@ -63,6 +63,7 @@ class Config:
     row_parallel: int = 0  # 0 = vocabulary_block_num
     lookup: str = "allgather"  # embedding lookup collective (| alltoall)
     lookup_capacity_factor: float = 2.0  # alltoall per-destination slack
+    lookup_overflow: str = "fallback"  # fallback (retry step via allgather) | abort
     coordinator_address: str = ""  # multi-host: host:port of process 0
     num_processes: int = 0  # multi-host: total process count
     process_id: int = -1  # multi-host: this process's index
@@ -90,6 +91,10 @@ class Config:
             raise ValueError(f"unknown compute_dtype {self.compute_dtype!r}")
         if self.lookup not in ("allgather", "alltoall"):
             raise ValueError(f"unknown lookup {self.lookup!r} (allgather | alltoall)")
+        if self.lookup_overflow not in ("fallback", "abort"):
+            raise ValueError(
+                f"unknown lookup_overflow {self.lookup_overflow!r} (fallback | abort)"
+            )
         if self.shuffle_seed < 0:
             # numpy SeedSequence rejects negatives — fail at the config,
             # not deep inside the prefetch thread.
@@ -193,6 +198,7 @@ def load_config(path: str) -> Config:
     cfg.data_parallel = get(d, "data_parallel", int, cfg.data_parallel)
     cfg.row_parallel = get(d, "row_parallel", int, cfg.row_parallel)
     cfg.lookup = get(d, "lookup", str, cfg.lookup).lower()
+    cfg.lookup_overflow = get(d, "lookup_overflow", str, cfg.lookup_overflow).lower()
     cfg.lookup_capacity_factor = get(
         d, "lookup_capacity_factor", float, cfg.lookup_capacity_factor
     )

@@ -21,10 +21,15 @@ ICI bytes per chip: ~2·R·C·D ≈ 2·slack·M·D instead of R·M·D — an
 capacity C = ceil(capacity_factor · M / R).  With ``hash_feature_id``
 (the 10B-row regime this path exists for) ids are uniform and
 capacity_factor=2 overflows with negligible probability.  Zipf-skewed
-RAW ids on contiguous shards can overflow; overflow is NEVER silent —
-every affected row poisons to NaN, so the loss goes NaN on the first
-overflowing step (test-pinned).  Raise capacity_factor or use the
-default all-gather lookup for skewed id spaces.
+RAW ids on contiguous shards can overflow; overflow is NEVER silent.
+What happens next is the caller's ``lookup_overflow`` choice
+(train_step.py): ``fallback`` (default) reruns the whole step through
+the allgather collectives under ``lax.cond`` — deterministic, exactly
+the allgather result, counted in the metrics — while ``abort`` poisons
+every affected row to NaN so the loss goes NaN on the first overflowing
+step and the run stops before checkpointing (both test-pinned).
+``routing_overflow`` below is the globally-agreed predicate the
+fallback branches on.
 
 These functions run INSIDE a shard_map body (parallel/train_step.py).
 """
@@ -36,7 +41,23 @@ from jax import lax
 
 from fast_tffm_tpu.parallel.mesh import DATA_AXIS, ROW_AXIS
 
-__all__ = ["routed_gather", "routed_update", "capacity_for"]
+__all__ = ["routed_gather", "routed_update", "routing_overflow", "capacity_for"]
+
+
+def routing_overflow(ids: jnp.ndarray, shard_rows: int, capacity: int):
+    """GLOBAL flag: would routing this batch overflow any destination?
+
+    Computed from the gather-direction bucket counts alone: the update
+    direction buckets the DEDUPED ids, and per-owner unique counts can
+    never exceed per-owner occurrence counts, so (with the shared
+    capacity) "gather fits" implies "update fits".  The psum makes every
+    chip agree — the caller can branch on it (lax.cond) without risking
+    divergent collectives.
+    """
+    R = lax.axis_size(ROW_AXIS)
+    counts = jnp.bincount(ids.reshape(-1) // shard_rows, length=R)
+    local = jnp.any(counts > capacity)
+    return lax.psum(local.astype(jnp.int32), (DATA_AXIS, ROW_AXIS)) > 0
 
 
 def capacity_for(ids_per_chip: int, row_parallel: int, capacity_factor: float) -> int:

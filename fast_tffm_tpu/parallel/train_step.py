@@ -155,7 +155,7 @@ def _make_gather(mesh: Mesh, local_ids_shape, lookup: str, capacity_factor: floa
 
 def make_sharded_train_step(
     model, learning_rate: float, mesh: Mesh, *, lookup: str = "allgather",
-    capacity_factor: float = 2.0
+    capacity_factor: float = 2.0, overflow_mode: str = "abort",
 ):
     """Returns jitted SPMD ``step(state, batch) -> (state, global mean loss)``.
 
@@ -165,17 +165,27 @@ def make_sharded_train_step(
     id skew) or ``alltoall`` (SparseCore-style routing for the lookup AND
     the gradient update — ~R× fewer ICI bytes each way; needs
     near-uniform ids, see parallel/alltoall.py).
+
+    ``overflow_mode`` (alltoall only) decides what a capacity overflow
+    does.  ``abort``: affected rows NaN-poison and the loss goes NaN (the
+    caller stops before checkpointing).  ``fallback``: the whole step
+    reruns through the allgather collectives under ``lax.cond`` — the
+    overflow flag is psum'd, so every chip takes the same branch, the
+    step's result is exactly the allgather step's, and training continues
+    deterministically; the step then returns ``(state, loss, overflowed)``
+    with a replicated int32 flag so the driver can count skew events.
     """
     model = _pad_model_vocab(model, mesh)
     num_rows_global = model.vocabulary_size
-    from fast_tffm_tpu.trainer import batch_loss
+    if overflow_mode not in ("abort", "fallback"):
+        raise ValueError(f"unknown overflow_mode {overflow_mode!r} (abort | fallback)")
+    fallback = lookup == "alltoall" and overflow_mode == "fallback"
 
     def shard_body(table, accum, dense, dense_acc, batch: Batch):
         # Built per trace: the capacity is sized from THIS trace's batch
         # shape (a cached closure would pin a stale capacity across jit
         # retraces with bigger batches and spuriously overflow).
         gather, cap = _make_gather(mesh, batch.ids.shape, lookup, capacity_factor)
-        rows = gather(table, batch.ids)
 
         def loss_fn(rows, dense):
             scores = model.score(rows, dense, batch)
@@ -190,21 +200,43 @@ def make_sharded_train_step(
             return data_loss + reg, data_loss
 
         grad_fn = jax.value_and_grad(loss_fn, argnums=(0, 1), has_aux=True)
-        (_, data_loss_local), (g_rows, g_dense) = grad_fn(rows, dense)
 
-        if lookup == "alltoall":
-            from fast_tffm_tpu.parallel.alltoall import routed_update
-
-            table, accum, overflow = routed_update(
-                table, accum, batch.ids, g_rows, learning_rate, num_rows_global, cap
-            )
-            # A dropped contribution must never persist silently: NaN the
-            # loss so the training loop aborts before checkpointing.
-            data_loss_local = jnp.where(overflow, jnp.nan, data_loss_local)
-        else:
-            table, accum = sharded_sparse_adagrad_update(
+        def allgather_branch():
+            rows = sharded_gather(table, batch.ids)
+            (_, dl), (g_rows, g_dense) = grad_fn(rows, dense)
+            t2, a2 = sharded_sparse_adagrad_update(
                 table, accum, batch.ids, g_rows, learning_rate, num_rows_global
             )
+            return t2, a2, g_dense, dl
+
+        if lookup == "alltoall":
+            from fast_tffm_tpu.parallel.alltoall import routed_update, routing_overflow
+
+            def routed_branch():
+                rows = gather(table, batch.ids)
+                (_, dl), (g_rows, g_dense) = grad_fn(rows, dense)
+                t2, a2, overflow = routed_update(
+                    table, accum, batch.ids, g_rows, learning_rate,
+                    num_rows_global, cap,
+                )
+                if not fallback:
+                    # A dropped contribution must never persist silently:
+                    # NaN the loss so the training loop aborts before
+                    # checkpointing.
+                    dl = jnp.where(overflow, jnp.nan, dl)
+                return t2, a2, g_dense, dl
+
+            if fallback:
+                overflowed = routing_overflow(batch.ids, table.shape[0], cap)
+                table, accum, g_dense, data_loss_local = lax.cond(
+                    overflowed, allgather_branch, routed_branch
+                )
+            else:
+                table, accum, g_dense, data_loss_local = routed_branch()
+                overflowed = jnp.asarray(False)
+        else:
+            table, accum, g_dense, data_loss_local = allgather_branch()
+            overflowed = jnp.asarray(False)
         if jax.tree.leaves(dense):
             g_dense = lax.psum(g_dense, _BOTH)
             dense, dense_acc = dense_adagrad_update(
@@ -212,7 +244,7 @@ def make_sharded_train_step(
             )
             dense_acc = dense_acc.accum
         data_loss = lax.psum(data_loss_local, _BOTH)
-        return table, accum, dense, dense_acc, data_loss
+        return table, accum, dense, dense_acc, data_loss, overflowed.astype(jnp.int32)
 
     dense_spec = jax.tree.map(lambda _: P(), model.init_dense(jax.random.key(0)))
     mapped = shard_map(
@@ -225,32 +257,51 @@ def make_sharded_train_step(
             dense_spec,
             _batch_specs(),
         ),
-        out_specs=(P(ROW_AXIS, None), P(ROW_AXIS, None), dense_spec, dense_spec, P()),
+        out_specs=(
+            P(ROW_AXIS, None), P(ROW_AXIS, None), dense_spec, dense_spec, P(), P(),
+        ),
         check_vma=False,
     )
 
     @partial(jax.jit, donate_argnums=(0,))
     def step(state: TrainState, batch: Batch):
-        table, accum, dense, dense_acc, loss = mapped(
+        table, accum, dense, dense_acc, loss, overflowed = mapped(
             state.table, state.table_opt.accum, state.dense, state.dense_opt.accum, batch
         )
-        return (
-            TrainState(table, AdagradState(accum), dense, AdagradState(dense_acc), state.step + 1),
-            loss,
+        new = TrainState(
+            table, AdagradState(accum), dense, AdagradState(dense_acc), state.step + 1
         )
+        if fallback:
+            return new, loss, overflowed
+        return new, loss
 
     return step
 
 
 def make_sharded_predict_step(
-    model, mesh: Mesh, *, lookup: str = "allgather", capacity_factor: float = 2.0
+    model, mesh: Mesh, *, lookup: str = "allgather", capacity_factor: float = 2.0,
+    overflow_mode: str = "abort",
 ):
-    """Returns jitted SPMD ``predict(state, batch) -> sigmoid scores [B]``."""
+    """Returns jitted SPMD ``predict(state, batch) -> sigmoid scores [B]``.
+
+    ``overflow_mode='fallback'`` (alltoall only) reruns an overflowing
+    batch's lookup through the allgather collective instead of NaN-ing the
+    scores — same ``lax.cond`` scheme as the train step."""
     model = _pad_model_vocab(model, mesh)
+    fallback = lookup == "alltoall" and overflow_mode == "fallback"
 
     def shard_body(table, dense, batch: Batch):
-        gather, _cap = _make_gather(mesh, batch.ids.shape, lookup, capacity_factor)
-        rows = gather(table, batch.ids)
+        gather, cap = _make_gather(mesh, batch.ids.shape, lookup, capacity_factor)
+        if fallback:
+            from fast_tffm_tpu.parallel.alltoall import routing_overflow
+
+            rows = lax.cond(
+                routing_overflow(batch.ids, table.shape[0], cap),
+                lambda: sharded_gather(table, batch.ids),
+                lambda: gather(table, batch.ids),
+            )
+        else:
+            rows = gather(table, batch.ids)
         scores = jax.nn.sigmoid(model.score(rows, dense, batch))
         # Replicate the (tiny, [B]) score vector so the result is fetchable
         # on every process of a multi-host mesh — a P(('data','row'))-sharded

@@ -82,11 +82,18 @@ def _run_predict(
                 b = to_batch(parsed, w)
             scores = np.asarray(predict_step(state, b))
             if not np.isfinite(scores).all():
+                # Under lookup_overflow=fallback an overflow cannot
+                # poison scores (the lookup reran via allgather).
+                cause = (
+                    "an alltoall-lookup capacity overflow (raise "
+                    "lookup_capacity_factor, set lookup_overflow = "
+                    "fallback, or use lookup=allgather) or a diverged model"
+                    if cfg.lookup == "alltoall" and cfg.lookup_overflow == "abort"
+                    else "a diverged model (non-finite weights)"
+                )
                 raise RuntimeError(
-                    "non-finite scores — an alltoall-lookup capacity overflow "
-                    "(raise lookup_capacity_factor or use lookup=allgather) "
-                    "or a diverged model; refusing to write a poisoned "
-                    f"score file to {cfg.score_path}"
+                    f"non-finite scores — {cause}; refusing to write a "
+                    f"poisoned score file to {cfg.score_path}"
                 )
             if remaining is not None:
                 take = min(remaining, len(scores))
@@ -145,7 +152,9 @@ def dist_predict(cfg: Config, log=print, mesh=None) -> str:
         cfg,
         state,
         make_sharded_predict_step(
-            model, mesh, lookup=cfg.lookup, capacity_factor=cfg.lookup_capacity_factor
+            model, mesh, lookup=cfg.lookup,
+            capacity_factor=cfg.lookup_capacity_factor,
+            overflow_mode=cfg.lookup_overflow,
         ),
         max_nnz,
         log,

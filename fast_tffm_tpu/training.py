@@ -53,10 +53,13 @@ def _check_finite(loss: float, cfg: Config) -> None:
     """Abort on a non-finite loss instead of training on (and eventually
     checkpointing) poisoned state."""
     if not np.isfinite(loss):
+        # Under lookup_overflow=fallback an overflow cannot produce NaN
+        # (the step reran via allgather) — divergence is the only cause.
         hint = (
             "an alltoall-lookup capacity overflow — raise "
-            "lookup_capacity_factor or use lookup=allgather"
-            if cfg.lookup == "alltoall"
+            "lookup_capacity_factor, set lookup_overflow = fallback, or "
+            "use lookup=allgather"
+            if cfg.lookup == "alltoall" and cfg.lookup_overflow == "abort"
             else "a diverged model — lower learning_rate"
         )
         raise RuntimeError(
@@ -216,11 +219,15 @@ def _run_training(
     to_batch=None,
     examples_per_step=None,
     evaluate=None,
+    extra_metrics=None,
 ):
     """Shared step loop.  ``train_stream(epoch)`` overrides the per-epoch
     input stream, ``to_batch(parsed, w)`` the host→device batch assembly,
     and ``evaluate`` the validation pass — the multi-host path plugs in
-    sharded input + global-array stitching here without forking the loop."""
+    sharded input + global-array stitching here without forking the loop.
+    ``extra_metrics()`` (optional) is drained at every log point and its
+    dict merged into the stdout line and the JSONL record (dist_train uses
+    it to report alltoall overflow-fallback step counts)."""
     if train_stream is None:
         train_stream = lambda epoch: _stream(
             cfg, cfg.train_files, max_nnz, epochs=1, to_batch=to_batch,
@@ -297,10 +304,13 @@ def _run_training(
                     rate = meter.rate()
                     mean_loss = np.mean([float(l) for l in losses])
                     _check_finite(mean_loss, cfg)
+                    extra = extra_metrics() if extra_metrics is not None else {}
+                    extra_txt = "".join(f" {k} {v}" for k, v in extra.items() if v)
                     log(
                         f"step {int(state.step)} epoch {epoch} "
                         f"loss {mean_loss:.5f} "
                         f"examples/sec {rate:,.0f} (/chip {rate / n_chips:,.0f})"
+                        f"{extra_txt}"
                     )
                     metrics.log(
                         step=int(state.step),
@@ -308,6 +318,7 @@ def _run_training(
                         loss=round(float(mean_loss), 6),
                         examples_per_sec=round(rate, 1),
                         examples_per_sec_per_chip=round(rate / n_chips, 1),
+                        **extra,
                     )
                     losses.clear()
                     meter.reset()
@@ -326,6 +337,13 @@ def _run_training(
                 save_checkpoint(cfg.model_file, state, ckpt_format)
                 log(f"epoch {epoch} checkpoint -> {cfg.model_file}")
     finally:
+        if extra_metrics is not None:
+            # Drain events from the final partial log window (run end,
+            # SIGTERM stop, abort) — a skew burst at the end must still
+            # reach the metrics file.
+            extra = extra_metrics()
+            if any(extra.values()):
+                metrics.log(step=int(state.step), **extra)
         tracer.close()
         metrics.close()
         for sig, handler in restore_handlers.items():
@@ -422,10 +440,29 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
     step_fn = make_sharded_train_step(
         model, cfg.learning_rate, mesh,
         lookup=cfg.lookup, capacity_factor=cfg.lookup_capacity_factor,
+        overflow_mode=cfg.lookup_overflow,
     )
     predict_step = make_sharded_predict_step(
-        model, mesh, lookup=cfg.lookup, capacity_factor=cfg.lookup_capacity_factor
+        model, mesh, lookup=cfg.lookup, capacity_factor=cfg.lookup_capacity_factor,
+        overflow_mode=cfg.lookup_overflow,
     )
+
+    extra_metrics = None
+    if cfg.lookup == "alltoall" and cfg.lookup_overflow == "fallback":
+        # The fallback step returns a replicated overflow flag; keep the
+        # (tiny) device scalars unsynced and count them only at log points
+        # so the dispatch pipeline never stalls on a per-step fetch.
+        raw_step, pending = step_fn, []
+
+        def step_fn(state, b):
+            state, loss, overflowed = raw_step(state, b)
+            pending.append(overflowed)
+            return state, loss
+
+        def extra_metrics():
+            n = int(np.sum([np.asarray(x) for x in pending])) if pending else 0
+            pending.clear()
+            return {"lookup_overflow_steps": n}
 
     train_stream = examples_per_step = evaluate = None
     to_batch = lambda parsed, w: Batch.from_parsed(parsed, w, with_fields=model.uses_fields)
@@ -520,4 +557,5 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
         to_batch=to_batch,
         examples_per_step=examples_per_step,
         evaluate=evaluate,
+        extra_metrics=extra_metrics,
     )

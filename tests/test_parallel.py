@@ -220,9 +220,9 @@ def test_alltoall_overflow_poisons_not_corrupts():
 
 
 def test_alltoall_overflow_aborts_training_before_checkpoint(tmp_path):
-    """End-to-end: a capacity overflow must abort the RUN (RuntimeError
-    naming the remedy), not keep training on NaN state or overwrite the
-    checkpoint with it."""
+    """End-to-end: with lookup_overflow = abort, a capacity overflow must
+    abort the RUN (RuntimeError naming the remedy), not keep training on
+    NaN state or overwrite the checkpoint with it."""
     from fast_tffm_tpu.config import Config
     from fast_tffm_tpu.training import dist_train
 
@@ -235,10 +235,111 @@ def test_alltoall_overflow_aborts_training_before_checkpoint(tmp_path):
         train_files=(str(f),),
         epoch_num=1, batch_size=64, learning_rate=0.1, log_every=1,
         row_parallel=8, lookup="alltoall", lookup_capacity_factor=0.5,
+        lookup_overflow="abort",
     ).validate()
     with pytest.raises(RuntimeError, match="lookup_capacity_factor"):
         dist_train(cfg, log=lambda *_: None)
     assert not (tmp_path / "m.ckpt").exists()  # no poisoned checkpoint
+
+
+def test_alltoall_overflow_fallback_matches_allgather():
+    """lookup_overflow = fallback: an overflowing step must produce EXACTLY
+    the allgather step's result (same state, finite loss), flag the event,
+    and a non-overflowing step must stay on the routed path (flag 0,
+    result identical to the abort-mode alltoall step)."""
+    model = FMModel(vocabulary_size=V, factor_num=4)
+    mesh = make_mesh(1, 8)
+    rng = np.random.default_rng(6)
+    uniform = _batches(rng, n=1, B=256)[0]
+    skewed = Batch(
+        labels=uniform.labels,
+        ids=jnp.zeros_like(uniform.ids),  # all ids -> shard 0: overflow
+        vals=uniform.vals,
+        fields=uniform.fields,
+        weights=uniform.weights,
+    )
+    mk = lambda **kw: make_sharded_train_step(
+        model, 0.1, mesh, lookup="alltoall", capacity_factor=1.0, **kw
+    )
+    fb_step = mk(overflow_mode="fallback")
+    ag_step = make_sharded_train_step(model, 0.1, mesh)
+
+    # Overflowing batch: fallback == allgather, bit for bit, and flagged.
+    fb_state, fb_loss, over = fb_step(
+        init_sharded_state(model, mesh, jax.random.key(1)), skewed
+    )
+    ag_state, ag_loss = ag_step(
+        init_sharded_state(model, mesh, jax.random.key(1)), skewed
+    )
+    assert int(over) == 1
+    assert np.isfinite(float(fb_loss))
+    np.testing.assert_array_equal(np.asarray(fb_loss), np.asarray(ag_loss))
+    np.testing.assert_array_equal(np.asarray(fb_state.table), np.asarray(ag_state.table))
+    np.testing.assert_array_equal(
+        np.asarray(fb_state.table_opt.accum), np.asarray(ag_state.table_opt.accum)
+    )
+
+    # Uniform batch: no flag, and the routed path's result (== the
+    # abort-mode step's) is what lands.
+    fb_state, fb_loss, over = fb_step(
+        init_sharded_state(model, mesh, jax.random.key(2)), uniform
+    )
+    aa_state, aa_loss = mk(overflow_mode="abort")(
+        init_sharded_state(model, mesh, jax.random.key(2)), uniform
+    )
+    assert int(over) == 0
+    np.testing.assert_array_equal(np.asarray(fb_loss), np.asarray(aa_loss))
+    np.testing.assert_array_equal(np.asarray(fb_state.table), np.asarray(aa_state.table))
+
+
+def test_alltoall_predict_fallback_finite_and_matches():
+    """Predict with fallback: an overflowing batch's scores must equal the
+    allgather predict's scores instead of NaN-poisoning."""
+    model = FMModel(vocabulary_size=V, factor_num=4)
+    mesh = make_mesh(1, 8)
+    rng = np.random.default_rng(8)
+    b = _batches(rng, n=1, B=256)[0]
+    skewed = Batch(
+        labels=b.labels, ids=jnp.zeros_like(b.ids), vals=b.vals,
+        fields=b.fields, weights=b.weights,
+    )
+    state = init_sharded_state(model, mesh, jax.random.key(3))
+    fb = make_sharded_predict_step(
+        model, mesh, lookup="alltoall", capacity_factor=1.0,
+        overflow_mode="fallback",
+    )(state, skewed)
+    ag = make_sharded_predict_step(model, mesh)(state, skewed)
+    assert np.isfinite(np.asarray(fb)).all()
+    np.testing.assert_array_equal(np.asarray(fb), np.asarray(ag))
+
+
+def test_alltoall_overflow_fallback_trains_through(tmp_path):
+    """End-to-end: the default lookup_overflow = fallback trains THROUGH a
+    deliberately-undersized capacity — finite losses, checkpoint written,
+    overflow steps counted in the JSONL metrics."""
+    import json
+
+    from fast_tffm_tpu.config import Config
+    from fast_tffm_tpu.training import dist_train
+
+    f = tmp_path / "skew.libsvm"
+    f.write_text("".join("1 " + " ".join("0:1.0" for _ in range(8)) + "\n" for _ in range(64)))
+    cfg = Config(
+        model="fm", factor_num=2, vocabulary_size=64,
+        model_file=str(tmp_path / "m.ckpt"),
+        train_files=(str(f),),
+        epoch_num=1, batch_size=64, learning_rate=0.1, log_every=1,
+        row_parallel=8, lookup="alltoall", lookup_capacity_factor=0.5,
+        metrics_path=str(tmp_path / "metrics.jsonl"),
+    ).validate()
+    assert cfg.lookup_overflow == "fallback"  # the default
+    state = dist_train(cfg, log=lambda *_: None)
+    assert (tmp_path / "m.ckpt").exists()
+    assert np.isfinite(np.asarray(state.table)).all()
+    records = [
+        json.loads(line) for line in (tmp_path / "metrics.jsonl").read_text().splitlines()
+    ]
+    assert sum(r.get("lookup_overflow_steps", 0) for r in records) >= 1
 
 
 def test_lookup_choice_changes_emitted_collectives():
