@@ -27,7 +27,7 @@ from fast_tffm_tpu.checkpoint import restore_checkpoint, save_checkpoint
 from fast_tffm_tpu.config import Config, build_model
 from fast_tffm_tpu.data.native import best_parser
 from fast_tffm_tpu.data.pipeline import batch_stream
-from fast_tffm_tpu.metrics import Throughput, auc
+from fast_tffm_tpu.metrics import StreamingAUC, Throughput
 from fast_tffm_tpu.models.base import Batch
 from fast_tffm_tpu.trainer import init_state, make_predict_step, make_train_step
 from fast_tffm_tpu.utils.prefetch import prefetch
@@ -187,25 +187,26 @@ def _evaluate(
     multi-host sharded path (sharded input, global-array stitching, device
     all-gather of the label/weight vectors); defaults are the local path.
 
+    Bounded memory: per-batch scores fold into a fixed-bucket streaming
+    AUC (metrics.StreamingAUC) instead of accumulating every score/label
+    on the host — a Criteo-scale validation split evaluates in O(bins).
+
     weight_files aligns with TRAIN files; validation examples weigh 1.0
-    (only batch-padding rows carry 0, and ``auc`` drops them)."""
+    (only batch-padding rows carry 0, and the AUC drops them)."""
     if to_batch is None:
         to_batch = Batch.from_parsed
     if stream is None:
         stream = _stream(cfg, files, max_nnz, epochs=1, weights=None, to_batch=to_batch)
     if fetch is None:
         fetch = lambda b, parsed, w: (parsed.labels, w)
-    scores, labels, weights = [], [], []
+    meter = StreamingAUC()
     for b, parsed, w in stream:
         if b is None:
             b = to_batch(parsed, w)
-        scores.append(np.asarray(predict_step(state, b)))
+        scores = np.asarray(predict_step(state, b))
         lab, ww = fetch(b, parsed, w)
-        labels.append(lab)
-        weights.append(ww)
-    if not scores:
-        return float("nan")
-    return auc(np.concatenate(labels), np.concatenate(scores), np.concatenate(weights))
+        meter.add(lab, scores, ww)
+    return meter.value()
 
 
 def _run_training(
