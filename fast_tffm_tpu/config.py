@@ -44,7 +44,7 @@ class Config:
     bias_lambda: float = 0.0
     init_accumulator_value: float = 0.1
     adagrad_accumulator: str = "element"  # element (TF parity) | row (faster RMW)
-    thread_num: int = 1  # host-side parse workers (reference: queue threads)
+    thread_num: int = 0  # host-side parse workers; 0 = all cores (reference: queue threads)
     binary_cache: bool = False  # parse text once into <file>.fmb, stream that
     binary_cache_wait: float = 600.0  # multi-host: non-lead wait for lead's build (s)
     shuffle: bool = False  # per-epoch global shuffle of train rows (FMB input only)
@@ -94,6 +94,10 @@ class Config:
         if self.lookup_overflow not in ("fallback", "abort"):
             raise ValueError(
                 f"unknown lookup_overflow {self.lookup_overflow!r} (fallback | abort)"
+            )
+        if self.thread_num < 0:
+            raise ValueError(
+                f"thread_num must be >= 0 (0 = all cores), got {self.thread_num}"
             )
         if self.shuffle_seed < 0:
             # numpy SeedSequence rejects negatives — fail at the config,

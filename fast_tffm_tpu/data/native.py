@@ -179,11 +179,18 @@ class NativeParser:
     ``threads`` spreads the parse over an in-kernel std::thread pool — the
     analog of the reference trainer's cfg-driven parse-thread count, but
     inside one GIL-released ctypes call instead of TF queue-runner threads.
+    ``threads=0`` (the default) uses every core: a pod host feeding 4-8
+    chips needs the full parse bandwidth, and the pool only spins up when
+    a batch is large enough to pay for it (see fm_parse_spans).
     """
 
-    def __init__(self, lib: ctypes.CDLL, threads: int = 1):
+    def __init__(self, lib: ctypes.CDLL, threads: int = 0):
         self._lib = lib
-        self.threads = max(1, int(threads))
+        if threads < 0:
+            # Mirror config.validate: a negative count is a bug upstream,
+            # not a request for every core.
+            raise ValueError(f"threads must be >= 0 (0 = all cores), got {threads}")
+        self.threads = int(threads) if threads > 0 else (os.cpu_count() or 1)
 
     def fnv1a64(self, token: bytes) -> int:
         return int(self._lib.fm_fnv1a64(token, len(token)))
@@ -460,7 +467,7 @@ def _stale() -> bool:
     )
 
 
-def load_native_parser(threads: int = 1) -> NativeParser | None:
+def load_native_parser(threads: int = 0) -> NativeParser | None:
     """Load the C++ parser, (re)building it on first use; None → Python fallback."""
     if _stale():
         _try_build()
@@ -474,7 +481,7 @@ def load_native_parser(threads: int = 1) -> NativeParser | None:
     return NativeParser(lib, threads)
 
 
-def best_parser(threads: int = 1):
+def best_parser(threads: int = 0):
     """The fastest available parser honoring the parse_lines contract."""
     native = load_native_parser(threads)
     if native is not None:

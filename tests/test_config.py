@@ -83,6 +83,14 @@ def test_defaults(tmp_path):
     cfg = load_config(str(p))
     assert cfg.model == "fm" and cfg.order == 2
     assert cfg.batch_size == 1024 and cfg.init_accumulator_value == 0.1
+    assert cfg.thread_num == 0  # 0 = every core (pod hosts feed 4-8 chips)
+
+
+def test_thread_num_negative_rejected(tmp_path):
+    p = tmp_path / "t.cfg"
+    p.write_text("[General]\nvocabulary_size = 100\n[Train]\nthread_num = -1\n")
+    with pytest.raises(ValueError, match="thread_num"):
+        load_config(str(p))
 
 
 def test_compute_dtype_parsed_and_validated(tmp_path):

@@ -165,6 +165,16 @@ class TestNativeParity:
         for f in ("labels", "ids", "vals", "fields", "nnz"):
             np.testing.assert_array_equal(getattr(a, f), getattr(b, f))
 
+    def test_thread_auto_resolution(self):
+        import os
+
+        from fast_tffm_tpu.data.native import NativeParser
+
+        assert NativeParser(native._lib, threads=0).threads == (os.cpu_count() or 1)
+        assert NativeParser(native._lib, threads=3).threads == 3
+        with pytest.raises(ValueError, match="threads"):
+            NativeParser(native._lib, threads=-1)
+
     def test_native_parse_mt_reports_first_error(self):
         from fast_tffm_tpu.data.native import NativeParser
 

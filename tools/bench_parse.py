@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Thread-scaling benchmark of the C++ libsvm parser.
+
+The reference fed its FmParser from `thread_num` queue-runner threads; here
+the pool lives inside one GIL-released C++ call (csrc/libsvm_parser.cpp ::
+fm_parse_spans).  A pod host drives 4-8 chips and needs multi-M rows/s of
+text parse for the first pass (steady state streams FMB) — this script
+measures rows/s/host at a sweep of thread counts so that claim is a number,
+not a guess.
+
+Usage: python tools/bench_parse.py [--rows 200000] [--nnz 39]
+                                   [--threads 1,2,4,8] [--repeat 5]
+Prints one JSON line per thread count and a summary line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def synth_lines(rows: int, nnz: int, vocab: int, seed: int = 0) -> list[str]:
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(rows):
+        ids = rng.integers(0, vocab, size=nnz)
+        vals = rng.normal(size=nnz)
+        toks = " ".join(f"{i}:{v:.4f}" for i, v in zip(ids, vals))
+        out.append(f"{int(rng.integers(0, 2))} {toks}")
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=200_000)
+    ap.add_argument("--nnz", type=int, default=39)
+    ap.add_argument("--vocab", type=int, default=1 << 20)
+    ap.add_argument("--batch", type=int, default=8192)
+    ap.add_argument("--threads", default="1,2,4,8")
+    ap.add_argument("--repeat", type=int, default=5)
+    args = ap.parse_args()
+
+    from fast_tffm_tpu.data.native import load_native_parser
+
+    parser = load_native_parser()
+    if parser is None:
+        print(json.dumps({"error": "native parser unavailable (build failed?)"}))
+        return 1
+
+    lines = synth_lines(args.rows, args.nnz, args.vocab)
+    batches = [
+        lines[i : i + args.batch] for i in range(0, len(lines), args.batch)
+    ]
+    cores = os.cpu_count() or 1
+    sweep = sorted({int(t) for t in args.threads.split(",")} | {cores})
+    results = {}
+    for t in sweep:
+        parser.threads = t
+        rates = []
+        for _ in range(args.repeat):
+            t0 = time.perf_counter()
+            for chunk in batches:
+                parser(chunk, vocabulary_size=args.vocab, max_nnz=args.nnz)
+            rates.append(args.rows / (time.perf_counter() - t0))
+        results[t] = float(np.median(rates))
+        print(
+            json.dumps(
+                {
+                    "metric": "text parse rows/sec/host",
+                    "threads": t,
+                    "value": round(results[t], 1),
+                    "host_cores": cores,
+                    "nnz": args.nnz,
+                }
+            )
+        )
+    best = max(results.values())
+    print(
+        json.dumps(
+            {
+                "metric": "text parse rows/sec/host (best)",
+                "value": round(best, 1),
+                "host_cores": cores,
+                "note": (
+                    "thread scaling requires physical cores; this host has "
+                    f"{cores} — see README input-pipeline notes"
+                    if cores < max(results)
+                    else "pool scales with cores"
+                ),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
