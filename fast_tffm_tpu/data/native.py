@@ -173,15 +173,26 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     return lib
 
 
+def usable_cores() -> int:
+    """Cores THIS process may run on — cgroup/affinity-aware where the OS
+    exposes it (a containerized pod worker pinned to 8 of 64 cores must
+    size its pool at 8, not 64)."""
+    try:
+        return len(os.sched_getaffinity(0)) or 1
+    except AttributeError:  # non-Linux
+        return os.cpu_count() or 1
+
+
 class NativeParser:
     """Callable with the signature of ``libsvm.parse_lines``.
 
     ``threads`` spreads the parse over an in-kernel std::thread pool — the
     analog of the reference trainer's cfg-driven parse-thread count, but
     inside one GIL-released ctypes call instead of TF queue-runner threads.
-    ``threads=0`` (the default) uses every core: a pod host feeding 4-8
-    chips needs the full parse bandwidth, and the pool only spins up when
-    a batch is large enough to pay for it (see fm_parse_spans).
+    ``threads=0`` (the default) uses every USABLE core: a pod host feeding
+    4-8 chips needs the full parse bandwidth, and the pool only spins up
+    when a batch is large enough to pay for it (parse_spans_mt in
+    csrc/libsvm_parser.cpp).
     """
 
     def __init__(self, lib: ctypes.CDLL, threads: int = 0):
@@ -190,7 +201,7 @@ class NativeParser:
             # Mirror config.validate: a negative count is a bug upstream,
             # not a request for every core.
             raise ValueError(f"threads must be >= 0 (0 = all cores), got {threads}")
-        self.threads = int(threads) if threads > 0 else (os.cpu_count() or 1)
+        self.threads = int(threads) if threads > 0 else usable_cores()
 
     def fnv1a64(self, token: bytes) -> int:
         return int(self._lib.fm_fnv1a64(token, len(token)))

@@ -1,0 +1,253 @@
+"""Independent NumPy FM/FFM trainer — the AUC-parity oracle.
+
+DELIBERATELY NAIVE AND SELF-CONTAINED: scalar Python loops, dense NumPy
+Adagrad, its own libsvm parser and its own AUC — no imports from
+``fast_tffm_tpu`` anywhere.  This is the stand-in for "matching the
+reference AUC at convergence" (SURVEY.md §6) while ``/root/reference`` is
+empty: if an implementation THIS different (explicit O(N²)/O(N³) pair
+loops instead of fused kernels, a Python dict instead of sort+segment
+dedup, float64 accumulation instead of jitted float32) converges to the
+same held-out AUC on the same data, the trainer's quality is anchored by
+something other than itself.
+
+Semantics mirrored from first principles (not from the code): logistic
+loss weighted-mean over the batch, per-batch L2 on the gathered rows
+(bias_lambda on col 0, factor_lambda on factors, per occurrence), TF-style
+Adagrad (accum += g², param -= lr·g/√accum, accum init to
+init_accumulator_value) applied once per unique row per batch with the
+summed gradient.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+
+def parse_libsvm(path):
+    """(labels, ids, vals, fields) as Python lists — naive split parser."""
+    labels, ids, vals, fields = [], [], [], []
+    with open(path) as f:
+        for line in f:
+            toks = line.split()
+            if not toks:
+                continue
+            labels.append(float(toks[0]))
+            row_i, row_v, row_f = [], [], []
+            for tok in toks[1:]:
+                parts = tok.split(":")
+                if len(parts) == 3:  # field:feature:value (libffm)
+                    row_f.append(int(parts[0]))
+                    row_i.append(int(parts[1]))
+                    row_v.append(float(parts[2]))
+                else:  # feature:value
+                    row_f.append(0)
+                    row_i.append(int(parts[0]))
+                    row_v.append(float(parts[1]))
+            ids.append(row_i)
+            vals.append(row_v)
+            fields.append(row_f)
+    return labels, ids, vals, fields
+
+
+def rank_auc(labels, scores):
+    """Independent exact AUC: count concordant pos/neg pairs directly."""
+    pairs = sorted(zip(scores, labels))
+    n_pos = sum(1 for _, y in pairs if y > 0.5)
+    n_neg = len(pairs) - n_pos
+    if n_pos == 0 or n_neg == 0:
+        return float("nan")
+    wins = ties = 0.0
+    i = 0
+    neg_seen = 0
+    while i < len(pairs):
+        j = i
+        while j < len(pairs) and pairs[j][0] == pairs[i][0]:
+            j += 1
+        block = pairs[i:j]
+        bpos = sum(1 for _, y in block if y > 0.5)
+        bneg = len(block) - bpos
+        wins += bpos * neg_seen  # strictly-lower negatives
+        ties += bpos * bneg
+        neg_seen += bneg
+        i = j
+    return (wins + 0.5 * ties) / (n_pos * n_neg)
+
+
+def _sigmoid(x):
+    if x >= 0:
+        return 1.0 / (1.0 + math.exp(-x))
+    e = math.exp(x)
+    return e / (1.0 + e)
+
+
+class OracleFM:
+    """Plain FM of a given order, per-row [bias | k factors]."""
+
+    def __init__(self, vocab, k, order=2, init_range=0.01,
+                 factor_lambda=0.0, bias_lambda=0.0, init_accum=0.1, seed=0):
+        rng = np.random.default_rng(seed)
+        self.w = np.zeros(vocab, np.float64)
+        self.v = rng.uniform(-init_range, init_range, size=(vocab, k))
+        self.order = order
+        self.k = k
+        self.factor_lambda = factor_lambda
+        self.bias_lambda = bias_lambda
+        self.acc_w = np.full(vocab, init_accum)
+        self.acc_v = np.full((vocab, k), init_accum)
+
+    def score_one(self, row_ids, row_vals):
+        s = 0.0
+        n = len(row_ids)
+        for i in range(n):
+            s += self.w[row_ids[i]] * row_vals[i]
+        if self.order >= 2:
+            for i in range(n):
+                for j in range(i + 1, n):
+                    s += row_vals[i] * row_vals[j] * float(
+                        self.v[row_ids[i]] @ self.v[row_ids[j]]
+                    )
+        if self.order >= 3:
+            for i in range(n):
+                for j in range(i + 1, n):
+                    for l in range(j + 1, n):
+                        s += (
+                            row_vals[i] * row_vals[j] * row_vals[l]
+                            * float(np.sum(
+                                self.v[row_ids[i]] * self.v[row_ids[j]] * self.v[row_ids[l]]
+                            ))
+                        )
+        return s
+
+    def _score_grads(self, row_ids, row_vals):
+        """Per-occurrence d(score)/d(w_i), d(score)/d(v_i)."""
+        n = len(row_ids)
+        gw = [row_vals[i] for i in range(n)]
+        gv = [np.zeros(self.k) for _ in range(n)]
+        if self.order >= 2:
+            for i in range(n):
+                for j in range(n):
+                    if j != i:
+                        gv[i] += row_vals[i] * row_vals[j] * self.v[row_ids[j]]
+        if self.order >= 3:
+            for i in range(n):
+                acc = np.zeros(self.k)
+                for j in range(n):
+                    for l in range(j + 1, n):
+                        if j != i and l != i:
+                            acc += (
+                                row_vals[j] * row_vals[l]
+                                * self.v[row_ids[j]] * self.v[row_ids[l]]
+                            )
+                gv[i] += row_vals[i] * acc
+        return gw, gv
+
+    def train_epoch(self, labels, ids, vals, fields, batch_size, lr):
+        del fields
+        n = len(labels)
+        for lo in range(0, n, batch_size):
+            bl = labels[lo : lo + batch_size]
+            bi = ids[lo : lo + batch_size]
+            bv = vals[lo : lo + batch_size]
+            bsz = len(bl)
+            grad_w: dict[int, float] = {}
+            grad_v: dict[int, np.ndarray] = {}
+            for r in range(bsz):
+                s = self.score_one(bi[r], bv[r])
+                dl = (_sigmoid(s) - bl[r]) / bsz  # weighted mean, weights 1
+                gw, gv = self._score_grads(bi[r], bv[r])
+                for pos, fid in enumerate(bi[r]):
+                    if bv[r][pos] == 0.0:
+                        continue
+                    g_w = dl * gw[pos] + 2.0 * self.bias_lambda * self.w[fid]
+                    g_v = dl * gv[pos] + 2.0 * self.factor_lambda * self.v[fid]
+                    grad_w[fid] = grad_w.get(fid, 0.0) + g_w
+                    if fid in grad_v:
+                        grad_v[fid] = grad_v[fid] + g_v
+                    else:
+                        grad_v[fid] = g_v.copy()
+            for fid, g in grad_w.items():
+                self.acc_w[fid] += g * g
+                self.w[fid] -= lr * g / math.sqrt(self.acc_w[fid])
+            for fid, g in grad_v.items():
+                self.acc_v[fid] += g * g
+                self.v[fid] -= lr * g / np.sqrt(self.acc_v[fid])
+
+    def predict(self, ids, vals, fields=None):
+        return [
+            _sigmoid(self.score_one(ri, rv)) for ri, rv in zip(ids, vals)
+        ]
+
+
+class OracleFFM:
+    """Plain FFM, per-row [bias | num_fields blocks of k factors]."""
+
+    def __init__(self, vocab, num_fields, k, init_range=0.01,
+                 factor_lambda=0.0, bias_lambda=0.0, init_accum=0.1, seed=0):
+        rng = np.random.default_rng(seed)
+        self.w = np.zeros(vocab, np.float64)
+        # v[id, partner_field, :]
+        self.v = rng.uniform(-init_range, init_range, size=(vocab, num_fields, k))
+        self.k = k
+        self.num_fields = num_fields
+        self.factor_lambda = factor_lambda
+        self.bias_lambda = bias_lambda
+        self.acc_w = np.full(vocab, init_accum)
+        self.acc_v = np.full((vocab, num_fields, k), init_accum)
+
+    def score_one(self, row_ids, row_vals, row_fields):
+        s = 0.0
+        n = len(row_ids)
+        for i in range(n):
+            s += self.w[row_ids[i]] * row_vals[i]
+        for i in range(n):
+            for j in range(i + 1, n):
+                s += row_vals[i] * row_vals[j] * float(
+                    self.v[row_ids[i], row_fields[j]] @ self.v[row_ids[j], row_fields[i]]
+                )
+        return s
+
+    def train_epoch(self, labels, ids, vals, fields, batch_size, lr):
+        n = len(labels)
+        for lo in range(0, n, batch_size):
+            bl = labels[lo : lo + batch_size]
+            bi = ids[lo : lo + batch_size]
+            bv = vals[lo : lo + batch_size]
+            bf = fields[lo : lo + batch_size]
+            bsz = len(bl)
+            grad_w: dict[int, float] = {}
+            grad_v: dict[int, np.ndarray] = {}
+            for r in range(bsz):
+                s = self.score_one(bi[r], bv[r], bf[r])
+                dl = (_sigmoid(s) - bl[r]) / bsz
+                m = len(bi[r])
+                gv = [np.zeros((self.num_fields, self.k)) for _ in range(m)]
+                for i in range(m):
+                    for j in range(m):
+                        if j != i:
+                            gv[i][bf[r][j]] += (
+                                bv[r][i] * bv[r][j] * self.v[bi[r][j], bf[r][i]]
+                            )
+                for pos, fid in enumerate(bi[r]):
+                    if bv[r][pos] == 0.0:
+                        continue
+                    g_w = dl * bv[r][pos] + 2.0 * self.bias_lambda * self.w[fid]
+                    g_v = dl * gv[pos] + 2.0 * self.factor_lambda * self.v[fid]
+                    grad_w[fid] = grad_w.get(fid, 0.0) + g_w
+                    if fid in grad_v:
+                        grad_v[fid] = grad_v[fid] + g_v
+                    else:
+                        grad_v[fid] = g_v.copy()
+            for fid, g in grad_w.items():
+                self.acc_w[fid] += g * g
+                self.w[fid] -= lr * g / math.sqrt(self.acc_w[fid])
+            for fid, g in grad_v.items():
+                self.acc_v[fid] += g * g
+                self.v[fid] -= lr * g / np.sqrt(self.acc_v[fid])
+
+    def predict(self, ids, vals, fields):
+        return [
+            _sigmoid(self.score_one(ri, rv, rf))
+            for ri, rv, rf in zip(ids, vals, fields)
+        ]

@@ -1,0 +1,186 @@
+"""AUC parity against an independent NumPy oracle trainer.
+
+The honest stand-in for "matching the reference AUC at convergence"
+(SURVEY.md §6) while ``/root/reference`` is empty: ``oracle_trainer.py``
+shares NO code with ``fast_tffm_tpu`` (its own parser, its own scalar-loop
+scoring, dense NumPy Adagrad, its own pair-counting AUC), yet both
+trainers fed the same libsvm text with the same hyperparameters must land
+within ±0.005 held-out AUC of each other — for FM order 2, FM order 3,
+and FFM.  A systematic quality defect in either implementation (loss,
+gradients, optimizer, evaluation) breaks the agreement.
+
+Also cross-checks metrics.auc against the oracle's independently-written
+AUC on identical score vectors.
+"""
+
+import numpy as np
+import pytest
+
+from tests.oracle_trainer import OracleFFM, OracleFM, parse_libsvm, rank_auc
+
+
+def _write_planted(path, rng, planted, *, n, vocab, k, nnz, fields=0, order=2):
+    """Synthetic CTR data from ONE planted model (shared by train AND test
+    splits): labels drawn Bernoulli(sigmoid(planted score)).  The planted
+    model matches the model class under test — FM (order 2 or 3) or FFM —
+    so each trainer converges toward a well-defined optimum of its own
+    class instead of overfit-racing a mismatched one."""
+    w, v = planted  # v: [vocab, k] for FM, [vocab, fields, k] for FFM
+    lines = []
+    for _ in range(n):
+        m = int(rng.integers(2, nnz + 1))
+        ids = rng.choice(vocab, size=m, replace=False)
+        vals = np.round(rng.normal(scale=1.0, size=m), 4)
+        s = float(w[ids] @ vals)
+        fs = rng.integers(0, fields, size=m) if fields else None
+        for i in range(m):
+            for j in range(i + 1, m):
+                if fields:
+                    s += vals[i] * vals[j] * float(
+                        v[ids[i], fs[j]] @ v[ids[j], fs[i]]
+                    )
+                else:
+                    s += vals[i] * vals[j] * float(v[ids[i]] @ v[ids[j]])
+        if order >= 3:
+            for i in range(m):
+                for j in range(i + 1, m):
+                    for l in range(j + 1, m):
+                        s += vals[i] * vals[j] * vals[l] * float(
+                            np.sum(v[ids[i]] * v[ids[j]] * v[ids[l]])
+                        )
+        y = int(rng.random() < 1.0 / (1.0 + np.exp(-s)))
+        if fields:
+            toks = " ".join(f"{f}:{i}:{x}" for f, i, x in zip(fs, ids, vals))
+        else:
+            toks = " ".join(f"{i}:{x}" for i, x in zip(ids, vals))
+        lines.append(f"{y} {toks}")
+    path.write_text("\n".join(lines) + "\n")
+    return str(path)
+
+
+def _train_tpu_impl(tmp_path, train_file, test_file, *, model_kw, epochs, lr, batch):
+    """Train fast_tffm_tpu through its real driver; return held-out scores."""
+    import jax
+
+    from fast_tffm_tpu.config import Config, build_model
+    from fast_tffm_tpu.data.pipeline import batch_stream
+    from fast_tffm_tpu.models.base import Batch
+    from fast_tffm_tpu.trainer import make_predict_step
+    from fast_tffm_tpu.training import train
+
+    cfg = Config(
+        model_file=str(tmp_path / "m.npz"),
+        train_files=(train_file,),
+        epoch_num=epochs,
+        batch_size=batch,
+        learning_rate=lr,
+        log_every=10_000,
+        **model_kw,
+    ).validate()
+    state = train(cfg, log=lambda *_: None)
+    model = build_model(cfg)
+    predict = make_predict_step(model)
+    scores, labels = [], []
+    for parsed, w in batch_stream(
+        [test_file], batch_size=batch, vocabulary_size=cfg.vocabulary_size,
+        max_nnz=16, epochs=1,
+    ):
+        b = Batch.from_parsed(parsed, w, with_fields=model.uses_fields)
+        s = np.asarray(predict(state, b))
+        keep = w > 0
+        scores.extend(s[keep].tolist())
+        labels.extend(parsed.labels[keep].tolist())
+    del state, jax
+    return labels, scores
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "case",
+    ["fm2", "fm3", "ffm"],
+)
+def test_auc_parity_with_independent_oracle(tmp_path, case):
+    rng = np.random.default_rng({"fm2": 11, "fm3": 13, "ffm": 17}[case])
+    vocab, k, nnz = 100, 4, 6
+    n_fields = 5 if case == "ffm" else 0
+    order = 3 if case == "fm3" else 2
+    # One planted model for BOTH splits; scales chosen so the planted
+    # ceiling AUC is ~0.9 (labels carry signal over the Bernoulli noise)
+    # and 6000 rows cover the 100-vocab pair space.
+    v_shape = (vocab, n_fields, k) if case == "ffm" else (vocab, k)
+    planted = (
+        rng.normal(scale=1.2, size=vocab),
+        rng.normal(scale=0.9 if case != "fm3" else 0.7, size=v_shape),
+    )
+    train_file = _write_planted(
+        tmp_path / "train.libsvm", rng, planted, n=6000, vocab=vocab, k=k,
+        nnz=nnz, fields=n_fields, order=order,
+    )
+    test_file = _write_planted(
+        tmp_path / "test.libsvm", rng, planted, n=2000, vocab=vocab, k=k,
+        nnz=nnz, fields=n_fields, order=order,
+    )
+    epochs, lr, batch, init = 16, 0.5, 64, 0.1
+
+    if case == "ffm":
+        model_kw = dict(
+            model="ffm", vocabulary_size=vocab, factor_num=k,
+            num_fields=n_fields, init_value_range=init,
+        )
+        oracle = OracleFFM(vocab, n_fields, k, seed=1, init_range=init)
+    else:
+        model_kw = dict(
+            model="fm", vocabulary_size=vocab, factor_num=k, order=order,
+            init_value_range=init,
+        )
+        oracle = OracleFM(vocab, k, order=order, seed=1, init_range=init)
+
+    # Both trainers start from the SAME initial parameters (recomputed
+    # here — train() seeds init_state with key(0) deterministically).
+    # Measured: higher-order FM landscapes are init-sensitive enough that
+    # two different RNG draws land ~0.02 AUC apart at convergence; the
+    # parity claim under test is the TRAINING PIPELINE (parse → loss →
+    # gradients → Adagrad → eval), not the init generator, so the init is
+    # pinned and the ±0.005 agreement bound stays tight.
+    import jax as _jax
+
+    from fast_tffm_tpu.config import Config as _Config, build_model as _build
+    from fast_tffm_tpu.trainer import init_state as _init_state
+
+    _model = _build(
+        _Config(model_file="unused", **model_kw).validate()
+    )
+    table0 = np.asarray(_init_state(_model, _jax.random.key(0)).table)
+    oracle.w = table0[:, 0].astype(np.float64).copy()
+    v0 = table0[:, 1:].astype(np.float64).copy()
+    oracle.v = v0.reshape(oracle.v.shape)
+
+    labels_t, scores_t = _train_tpu_impl(
+        tmp_path, train_file, test_file,
+        model_kw=model_kw, epochs=epochs, lr=lr, batch=batch,
+    )
+
+    tr = parse_libsvm(train_file)
+    te_labels, te_ids, te_vals, te_fields = parse_libsvm(test_file)
+    for _ in range(epochs):
+        oracle.train_epoch(*tr, batch_size=batch, lr=lr)
+    scores_o = oracle.predict(te_ids, te_vals, te_fields)
+
+    auc_t = rank_auc(labels_t, scores_t)
+    auc_o = rank_auc(te_labels, scores_o)
+    # Both must have learned the planted signal, and agree.  The bar is
+    # per-case: FFM fits 5x the factor parameters from the same 6000 rows
+    # and plateaus lower on this data size (both implementations agree on
+    # WHERE it plateaus, which is the claim under test).
+    bar = {"fm2": 0.85, "fm3": 0.8, "ffm": 0.7}[case]
+    assert auc_o > bar, f"oracle failed to learn ({case}): {auc_o}"
+    assert auc_t > bar, f"trainer failed to learn ({case}): {auc_t}"
+    assert abs(auc_t - auc_o) < 0.005, (case, auc_t, auc_o)
+
+    # The evaluation stack itself cross-checks: metrics.auc must equal the
+    # oracle's independently-written pair-counting AUC on the same vectors.
+    from fast_tffm_tpu.metrics import auc as impl_auc
+
+    assert abs(
+        impl_auc(np.asarray(labels_t), np.asarray(scores_t)) - auc_t
+    ) < 1e-12

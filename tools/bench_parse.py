@@ -3,7 +3,7 @@
 
 The reference fed its FmParser from `thread_num` queue-runner threads; here
 the pool lives inside one GIL-released C++ call (csrc/libsvm_parser.cpp ::
-fm_parse_spans).  A pod host drives 4-8 chips and needs multi-M rows/s of
+parse_spans_mt).  A pod host drives 4-8 chips and needs multi-M rows/s of
 text parse for the first pass (steady state streams FMB) — this script
 measures rows/s/host at a sweep of thread counts so that claim is a number,
 not a guess.
@@ -58,8 +58,15 @@ def main() -> int:
     batches = [
         lines[i : i + args.batch] for i in range(0, len(lines), args.batch)
     ]
-    cores = os.cpu_count() or 1
-    sweep = sorted({int(t) for t in args.threads.split(",")} | {cores})
+    from fast_tffm_tpu.data.native import usable_cores
+
+    cores = usable_cores()
+    raw = [int(t) for t in args.threads.split(",")]
+    if any(t < 0 for t in raw):
+        print(json.dumps({"error": "negative thread counts are invalid"}))
+        return 1
+    # Same 0-means-all-cores resolution the config layer gets.
+    sweep = sorted({(t if t > 0 else cores) for t in raw} | {cores})
     results = {}
     for t in sweep:
         parser.threads = t
