@@ -1,90 +1,309 @@
 #!/usr/bin/env python
-"""Benchmark: train-step throughput for the BASELINE config-#1 shape.
+"""Benchmark: train-step throughput in the REALISTIC north-star regime.
 
-2nd-order FM, k=8, Criteo-like batches (39 features/example), logistic loss,
-sparse Adagrad — the full jitted train step (gather → fused (Σv)²−Σv²
-scorer with hand-written VJP → dedup → sparse scatter update), measured on
-whatever chips are visible and reported per chip.
+Headline (the printed line's "value"): the full jitted train step — gather
+→ fused (Σv)²−Σv² scorer with hand-written VJP → dedup → sparse Adagrad
+scatter — on a **2^28-row table** (~9.7 GB + 1.1 GB row-mode accumulator,
+most of one chip's HBM, the single-chip analog of BASELINE.json's
+10B-parameter target) with **Zipf(1.1)-skewed ids**: hot head plus a long
+tail folded across the whole table, so gathers are cache-hostile and the
+update RMW touches cold HBM.  This is the regime VERDICT r1 named as the
+missing load-bearing number — not the 1M-row toy table.
+
+Extra keys on the same line:
+  sharded_value       same shapes through the mesh-sharded SPMD step
+                      (dist_train's program) on the visible mesh
+  fmb_streamed_value  end-to-end file → memmap-stream → H2D → step through
+                      the real FMB input path (on this box the host↔device
+                      tunnel swings ~100×, so treat as a floor, not a rate)
+  toy_vocab1m_value   the r1 microbench (vocab=1M, uniform ids) for
+                      round-over-round continuity
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "examples/sec/chip", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "examples/sec/chip", "vs_baseline": N, ...}
 vs_baseline is against the BASELINE.json north-star ≥500k examples/sec/chip.
 """
 
 import json
+import os
 import time
 
 import _bench_watchdog
 
 # Armed before jax/fast_tffm_tpu imports: backend init inside `import jax`
-# is itself a known hang point behind a dead tunnel.
-_watchdog = _bench_watchdog.arm(what="bench.py")
+# is itself a known hang point behind a dead tunnel.  Budget covers the
+# fallback ladder: each rejected rung costs a ~60s failed remote compile
+# before the achievable one runs (~10 min total worst case measured).
+_watchdog = _bench_watchdog.arm(seconds=1500, what="bench.py")
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
 from fast_tffm_tpu.models import Batch, FMModel
-from fast_tffm_tpu.trainer import init_state, make_train_step
+from fast_tffm_tpu.optim import AdagradState
+from fast_tffm_tpu.trainer import TrainState, init_state, make_train_step
 
 BASELINE_EXAMPLES_PER_SEC_PER_CHIP = 500_000.0
 
+# Largest-first ladder of table sizes.  2^28 rows ([V, 9] f32 ≈ 9.7 GB +
+# 1 GB row accumulator) is the VERDICT-r1 ask; this box's remote TPU
+# compile helper rejects train-step programs once donated args reach
+# ~10 GiB (measured: 235M rows compiles, 268M does not — simple fills and
+# reduces at the same sizes compile fine, so it is a toolchain bound, not
+# HBM).  The bench takes the largest rung that compiles and reports it.
+SCALE_VOCABS = (1 << 28, 251_658_240, 234_881_024, 1 << 27)
+SCALE_K = 8
+NNZ = 39  # Criteo field count
+BATCH = 16384
 
-def make_batch(rng, batch_size, nnz, vocab):
+
+def zipf_ids(rng, shape, vocab):
+    """Zipf(1.1) ids folded onto [0, vocab): a hot head (the same few ids
+    recur across every batch) plus a tail spread uniformly over the whole
+    table by the modulo — worst case for row-reuse in the gather and for
+    locality in the update scatter."""
+    z = rng.zipf(1.1, size=shape)
+    return ((z - 1) % vocab).astype(np.int32)
+
+
+def make_batch(ids):
+    rng = np.random.default_rng(ids[0, 0])
+    b, n = ids.shape
     return Batch(
-        labels=jnp.asarray(rng.integers(0, 2, size=(batch_size,)).astype(np.float32)),
-        ids=jnp.asarray(rng.integers(0, vocab, size=(batch_size, nnz)).astype(np.int32)),
-        vals=jnp.asarray(np.abs(rng.normal(size=(batch_size, nnz)).astype(np.float32)) + 0.1),
-        fields=jnp.zeros((batch_size, nnz), jnp.int32),
-        weights=jnp.ones((batch_size,), jnp.float32),
+        labels=jnp.asarray(rng.integers(0, 2, size=(b,)).astype(np.float32)),
+        ids=jnp.asarray(ids),
+        vals=jnp.asarray(np.abs(rng.normal(size=(b, n)).astype(np.float32)) + 0.1),
+        fields=jnp.zeros((b, n), jnp.int32),
+        weights=jnp.ones((b,), jnp.float32),
     )
 
 
-def main():
-    batch_size = 16384
-    nnz = 39  # Criteo field count
-    vocab = 1 << 20
-    iters = 30
+def scale_state(vocab, k):
+    """TrainState with a [V, 1+k] table + ROW-mode accumulator, built
+    in-place on device (init_state's bias/factor concat would peak at 2×
+    the table — too much next to 16 GB HBM)."""
+    from functools import partial
 
-    model = FMModel(vocabulary_size=vocab, factor_num=8, order=2)
-    state = init_state(model, jax.random.key(0))
-    step = make_train_step(model, learning_rate=0.01)
+    @partial(jax.jit, static_argnums=(1, 2))
+    def mk_table(key, v, d):
+        t = jax.random.uniform(key, (v, d), jnp.float32, -0.01, 0.01)
+        return t.at[:, 0].set(0.0)  # bias column starts at zero
 
-    rng = np.random.default_rng(0)
-    batches = [make_batch(rng, batch_size, nnz, vocab) for _ in range(8)]
+    return TrainState(
+        table=mk_table(jax.random.key(0), vocab, 1 + k),
+        table_opt=AdagradState(jnp.full((vocab, 1), 0.1, jnp.float32)),
+        dense={},
+        dense_opt=AdagradState({}),
+        step=jnp.zeros((), jnp.int32),
+    )
 
-    # Warm until steady state (>= 2s past compile): a fresh process pays
-    # device/tunnel spin-up for its first dispatches, and a fixed 5-step
-    # warmup was observed under-reporting a cold run by ~2.5x.
+
+def measure(step, state, batches, iters, warm_secs=2.0, windows=3):
+    """(final state, best-window examples/sec).  Warm past compile + tunnel
+    spin-up, then best of ``windows`` (min time: slowdowns are
+    contamination, never speedups)."""
     state, loss = step(state, batches[0])
-    jax.block_until_ready(loss)  # compile finishes before the clock starts
-    deadline = time.perf_counter() + 2.0
+    jax.block_until_ready(loss)
+    deadline = time.perf_counter() + warm_secs
     i = 1
     while time.perf_counter() < deadline:
         state, loss = step(state, batches[i % len(batches)])
         i += 1
     jax.block_until_ready(loss)
-
-    # Best of 3 measurement windows (min is the noise-robust choice for a
-    # single-line report: slowdowns are contamination, never speedups).
     best_dt = float("inf")
-    for _ in range(3):
+    for _ in range(windows):
         t0 = time.perf_counter()
         for i in range(iters):
             state, loss = step(state, batches[i % len(batches)])
         jax.block_until_ready(loss)
         best_dt = min(best_dt, time.perf_counter() - t0)
+    return state, BATCH * iters / best_dt
 
-    n_chips = jax.device_count()
-    value = batch_size * iters / best_dt / n_chips
+
+def ensure_scale_fmb(vocab, rows=1 << 19, seed=7):
+    """Synthesize (once, cached) an FMB file of Zipf-id rows at the scale
+    vocab — built directly in the FMB layout (the text→FMB converter would
+    spend minutes parsing 250 MB of synthetic text for no extra fidelity;
+    the STREAM under test is identical either way)."""
+    from fast_tffm_tpu.data.binary import _HEADER, FMB_MAGIC, _section_offsets, open_fmb
+
+    path = f"/tmp/fmb_scale_cache/zipf_v{vocab}_n{NNZ}_r{rows}_s{seed}.fmb"
+    if os.path.exists(path):
+        try:
+            f = open_fmb(path)
+            if f.n_rows == rows and f.vocabulary_size == vocab:
+                return path
+        except ValueError:
+            pass
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    rng = np.random.default_rng(seed)
+    o_lab, o_nnz, o_ids, o_val, o_fld, total = _section_offsets(rows, NNZ, 4)
+    tmp = path + f".{os.getpid()}.tmp"
+    with open(tmp, "wb") as fh:
+        fh.truncate(total)
+    mm = np.memmap(tmp, np.uint8, mode="r+")
+    mm[: _HEADER.size] = np.frombuffer(
+        _HEADER.pack(FMB_MAGIC, 1, rows, NNZ, vocab, 1, 4, 0, 0, NNZ),
+        np.uint8,
+    )
+
+    def view(off, count, dtype, shape):
+        return mm[off : off + count * np.dtype(dtype).itemsize].view(dtype).reshape(shape)
+
+    view(o_lab, rows, np.float32, (rows,))[:] = rng.integers(
+        0, 2, size=rows
+    ).astype(np.float32)
+    view(o_nnz, rows, np.int32, (rows,))[:] = NNZ
+    view(o_ids, rows * NNZ, np.int32, (rows, NNZ))[:] = zipf_ids(
+        rng, (rows, NNZ), vocab
+    )
+    view(o_val, rows * NNZ, np.float32, (rows, NNZ))[:] = np.abs(
+        rng.normal(size=(rows, NNZ)).astype(np.float32)
+    ) + 0.1
+    view(o_fld, rows * NNZ, np.int32, (rows, NNZ))[:] = 0
+    mm.flush()
+    del mm
+    os.replace(tmp, path)
+    return path
+
+
+def bench_fmb_streamed(step, state, path, vocab):
+    """(final state, examples/sec) through the REAL input path: memmap
+    stream → producer-thread H2D conversion (training's binary-input
+    placement) → jitted step."""
+    from fast_tffm_tpu.data.binary import fmb_batch_stream, open_fmb
+    from fast_tffm_tpu.utils.prefetch import prefetch
+
+    n_rows = open_fmb(path).n_rows
+    count = n_rows // BATCH
+
+    def stream():
+        raw = fmb_batch_stream(
+            [path], batch_size=BATCH, vocabulary_size=vocab,
+            hash_feature_id=True, max_nnz=NNZ, epochs=1, drop_remainder=True,
+        )
+        return prefetch(
+            ((Batch.from_parsed(p, w, with_fields=False), p, w) for p, w in raw),
+            depth=8,
+        )
+
+    loss = None
+    for b, _p, _w in stream():  # warm epoch (page cache, executable reuse)
+        state, loss = step(state, b)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for b, _p, _w in stream():
+        state, loss = step(state, b)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    return state, count * BATCH / dt
+
+
+def main():
+    rng = np.random.default_rng(0)
+    results = {}
+
+    # --- headline: local jitted step, largest compilable table, Zipf ids,
+    #     row accumulator ---
+    state = step = None
+    vocab = None
+    for cand in SCALE_VOCABS:
+        model = FMModel(vocabulary_size=cand, factor_num=SCALE_K, order=2)
+        step = make_train_step(model, learning_rate=0.01)
+        batches = [
+            make_batch(zipf_ids(rng, (BATCH, NNZ), cand)) for _ in range(16)
+        ]
+        try:
+            state = scale_state(cand, SCALE_K)
+            state, scale_rate = measure(step, state, batches, iters=20)
+            vocab = cand
+            break
+        except Exception as e:
+            results.setdefault("scale_fallbacks", []).append(
+                f"vocab={cand}: {str(e)[:80]}"
+            )
+            state = None
+    if vocab is None:
+        raise SystemExit("no scale rung compiled: " + str(results))
+    results["value"] = round(scale_rate / jax.device_count(), 1)
+    results["scale_vocab_rows"] = vocab
+    results["scale_table_gib"] = round(vocab * (1 + SCALE_K) * 4 / 2**30, 2)
+
+    # Uniform ids over the same giant table: the true cold-gather worst
+    # case (Zipf's hot head concentrates most gathers on a few cached
+    # rows; uniform makes every row gather + update RMW touch cold HBM).
+    # Same executable — only the id values change.
+    try:
+        uni = [
+            make_batch(
+                rng.integers(0, vocab, size=(BATCH, NNZ)).astype(np.int32)
+            )
+            for _ in range(16)
+        ]
+        state, uni_rate = measure(step, state, uni, iters=20)
+        results["uniform_ids_value"] = round(uni_rate / jax.device_count(), 1)
+        del uni
+    except Exception as e:
+        results["uniform_ids_value"] = None
+        results["uniform_ids_error"] = str(e)[:120]
+
+    # --- end-to-end through the FMB input path (same live state) ---
+    try:
+        state, fmb_rate = bench_fmb_streamed(
+            step, state, ensure_scale_fmb(vocab), vocab
+        )
+        results["fmb_streamed_value"] = round(fmb_rate, 1)
+    except Exception as e:  # tunnel/disk trouble must not kill the headline
+        results["fmb_streamed_value"] = None
+        results["fmb_streamed_error"] = str(e)[:120]
+
+    # --- same shapes through the sharded SPMD step (dist_train's program) ---
+    try:
+        from fast_tffm_tpu.parallel import make_mesh, make_sharded_train_step
+
+        n = jax.device_count()
+        mesh = make_mesh(1, n)
+        sh_step = make_sharded_train_step(model, 0.01, mesh)
+        state, sh_rate = measure(sh_step, state, batches, iters=20)
+        results["sharded_value"] = round(sh_rate / n, 1)
+    except Exception as e:
+        results["sharded_value"] = None
+        results["sharded_error"] = str(e)[:120]
+    del state, batches
+
+    # --- r1 continuity: the 1M-row uniform-id microbench ---
+    try:
+        toy_model = FMModel(vocabulary_size=1 << 20, factor_num=8, order=2)
+        toy_step = make_train_step(toy_model, learning_rate=0.01)
+        toy_batches = [
+            make_batch(
+                rng.integers(0, 1 << 20, size=(BATCH, NNZ)).astype(np.int32)
+            )
+            for _ in range(8)
+        ]
+        toy_state = init_state(toy_model, jax.random.key(0))
+        _, toy_rate = measure(toy_step, toy_state, toy_batches, iters=30)
+        results["toy_vocab1m_value"] = round(toy_rate / jax.device_count(), 1)
+    except Exception as e:
+        results["toy_vocab1m_value"] = None
+        results["toy_error"] = str(e)[:120]
+
     _watchdog.cancel()
     print(
         json.dumps(
             {
-                "metric": "train examples/sec/chip (2nd-order FM, k=8, nnz=39, vocab=1M)",
-                "value": round(value, 1),
+                "metric": (
+                    f"train examples/sec/chip (2nd-order FM, k=8, nnz=39, "
+                    f"vocab={vocab} rows ~{results['scale_table_gib']}GiB "
+                    "table, Zipf(1.1) ids, row accumulator)"
+                ),
+                "value": results["value"],
                 "unit": "examples/sec/chip",
-                "vs_baseline": round(value / BASELINE_EXAMPLES_PER_SEC_PER_CHIP, 4),
+                "vs_baseline": round(
+                    results["value"] / BASELINE_EXAMPLES_PER_SEC_PER_CHIP, 4
+                ),
+                **{k: v for k, v in results.items() if k != "value"},
             }
         )
     )

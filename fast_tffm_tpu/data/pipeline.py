@@ -155,6 +155,11 @@ def batch_stream(
             parser=parser,
         )
     fmb = [is_fmb(p) for p in files]
+    # ensure_fmb_cache's fallback is all-or-nothing (a failed build turns
+    # the WHOLE list back to text, and a failed build alongside .fmb
+    # passthroughs raises there), so a cache fallback can never produce a
+    # mixed list — the mixed-list error below always describes the
+    # caller's own input.
     cache_fell_back = binary_cache and not all(fmb)
     if any(fmb):
         if not all(fmb):

@@ -174,6 +174,13 @@ def make_sharded_train_step(
     step's result is exactly the allgather step's, and training continues
     deterministically; the step then returns ``(state, loss, overflowed)``
     with a replicated int32 flag so the driver can count skew events.
+
+    Note the defaults differ by layer on purpose: the CONFIG default
+    (``lookup_overflow = fallback``, what the train/predict drivers pass)
+    is the operationally-kind choice, while this bare function defaults to
+    ``abort`` so direct library callers keep the uniform
+    ``(state, loss)`` return signature unless they opt into the flagged
+    3-tuple.
     """
     model = _pad_model_vocab(model, mesh)
     num_rows_global = model.vocabulary_size

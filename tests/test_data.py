@@ -166,11 +166,9 @@ class TestNativeParity:
             np.testing.assert_array_equal(getattr(a, f), getattr(b, f))
 
     def test_thread_auto_resolution(self):
-        import os
+        from fast_tffm_tpu.data.native import NativeParser, usable_cores
 
-        from fast_tffm_tpu.data.native import NativeParser
-
-        assert NativeParser(native._lib, threads=0).threads == (os.cpu_count() or 1)
+        assert NativeParser(native._lib, threads=0).threads == usable_cores()
         assert NativeParser(native._lib, threads=3).threads == 3
         with pytest.raises(ValueError, match="threads"):
             NativeParser(native._lib, threads=-1)

@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""Held-out-AUC data-scaling study: close (or bound) the gap to the oracle.
+
+VERDICT r1 left the held-out quality claim unfinished at 0.826 vs the 0.911
+planted-oracle ceiling at 2.4M rows, with the data-scaling argument (150k →
+0.649, 600k → 0.712, 2.4M → 0.826) "plausible but unfinished".  This script
+extends the curve (default out to ~9.6M rows on the identical task and
+settings) and writes one JSON artifact with every point next to the oracle
+ceiling, so the claim "the residual gap is sample volume, not trainer
+quality" is a committed measurement, not an assertion.
+
+Usage:
+  python tools/scaling_study.py [--rows 2400000,4800000,9600000]
+                                [--epochs 4] [--out scaling_study.json]
+
+Each point: generate train split (fixed test split, 50k rows, seed 1),
+train the real `train()` driver with binary_cache, record the best
+validation AUC from the JSONL metrics, report vs the oracle AUC (the
+planted model scoring the same held-out rows — the ceiling ANY learner has
+on Bernoulli(sigmoid(score)) labels).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np  # noqa: E402
+
+FIELDS, K_HIDDEN, SPREAD, VOCAB = 39, 4, 3.0, 1 << 14
+
+
+def oracle_auc(path):
+    import gen_synthetic
+
+    from fast_tffm_tpu.data.native import best_parser
+    from fast_tffm_tpu.data.pipeline import batch_stream
+    from fast_tffm_tpu.metrics import auc
+
+    labels, scores = [], []
+    for b, w in batch_stream(
+        [path], batch_size=8192, vocabulary_size=VOCAB, max_nnz=FIELDS,
+        parser=best_parser(),
+    ):
+        n = int((w > 0).sum())
+        scores.append(
+            gen_synthetic.planted_score(
+                np.asarray(b.ids)[:n], b.vals[:n], factor_num=K_HIDDEN
+            )
+        )
+        labels.append(b.labels[:n])
+    return auc(np.concatenate(labels), np.concatenate(scores))
+
+
+def train_point(td, rows, te, epochs, lr, bs):
+    import gen_synthetic
+
+    from fast_tffm_tpu.config import Config
+    from fast_tffm_tpu.training import train
+
+    tr = os.path.join(td, f"tr_{rows}.libsvm")
+    t0 = time.time()
+    gen_synthetic.generate(
+        tr, rows=rows, fields=FIELDS, vocab=VOCAB, seed=0,
+        factor_num=K_HIDDEN, spread=SPREAD,
+    )
+    gen_secs = time.time() - t0
+    metrics = os.path.join(td, f"metrics_{rows}.jsonl")
+    cfg = Config(
+        model="fm",
+        factor_num=8,
+        vocabulary_size=VOCAB,
+        model_file=os.path.join(td, f"m_{rows}.ckpt"),
+        train_files=(tr,),
+        validation_files=(te,),
+        epoch_num=epochs,
+        batch_size=bs,
+        learning_rate=lr,
+        log_every=10**9,
+        metrics_path=metrics,
+        binary_cache=True,
+    ).validate()
+    t0 = time.time()
+    train(cfg, log=lambda *_: None)
+    train_secs = time.time() - t0
+    with open(metrics) as f:
+        aucs = [
+            r["validation_auc"] for r in map(json.loads, f) if "validation_auc" in r
+        ]
+    # Free the big splits as we go (10M rows of text+fmb is ~10 GB).
+    for suffix in ("", ".fmb"):
+        try:
+            os.remove(tr + suffix)
+        except OSError:
+            pass
+    return max(aucs), {"gen_secs": round(gen_secs, 1), "train_secs": round(train_secs, 1)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", default="2400000,4800000,9600000")
+    ap.add_argument("--epochs", type=int, default=4)
+    ap.add_argument("--lr", type=float, default=0.5)
+    ap.add_argument("--batch", type=int, default=1024)
+    ap.add_argument("--out", default="scaling_study.json")
+    args = ap.parse_args()
+
+    import gen_synthetic
+
+    points = []
+    with tempfile.TemporaryDirectory() as td:
+        te = os.path.join(td, "te.libsvm")
+        gen_synthetic.generate(
+            te, rows=50_000, fields=FIELDS, vocab=VOCAB, seed=1,
+            factor_num=K_HIDDEN, spread=SPREAD,
+        )
+        oracle = oracle_auc(te)
+        print(json.dumps({"oracle_auc": round(oracle, 5)}), flush=True)
+        for rows in [int(r) for r in args.rows.split(",")]:
+            auc_v, timing = train_point(
+                td, rows, te, args.epochs, args.lr, args.batch
+            )
+            point = {
+                "rows": rows,
+                "heldout_auc": round(auc_v, 5),
+                "oracle_auc": round(oracle, 5),
+                "gap": round(oracle - auc_v, 5),
+                "lift_vs_oracle": round((auc_v - 0.5) / (oracle - 0.5), 4),
+                **timing,
+            }
+            points.append(point)
+            print(json.dumps(point), flush=True)
+
+    artifact = {
+        "study": "held-out AUC vs training rows (planted Zipf CTR task, "
+        f"FM k=8, vocab=2^14, {FIELDS} fields, spread={SPREAD}, "
+        f"epochs={args.epochs}, lr={args.lr}, batch={args.batch})",
+        "r1_points": [
+            {"rows": 150_000, "heldout_auc": 0.649},
+            {"rows": 600_000, "heldout_auc": 0.712},
+            {"rows": 2_400_000, "heldout_auc": 0.826},
+        ],
+        "points": points,
+    }
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(json.dumps({"written": args.out}))
+
+
+if __name__ == "__main__":
+    main()
