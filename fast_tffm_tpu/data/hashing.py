@@ -38,6 +38,12 @@ def hash_feature_ids_np(ids: np.ndarray, vocabulary_size: int) -> np.ndarray:
 
     Matches ``hash_feature_id(str(i).encode(), vocab)`` element-wise — the
     contract shared with the C++ parser.
+
+    PERFORMANCE WARNING: this is a per-element Python loop (~10³× slower
+    than the native path) kept only as the parity fallback when the C++
+    parser is unavailable — the C++ parser and the FMB writer hash
+    natively, so production paths never come through here.  If a profile
+    shows this function, build the native parser (``make -C csrc``).
     """
     return np.fromiter(
         (hash_feature_id(str(int(i)), vocabulary_size) for i in ids.ravel()),
