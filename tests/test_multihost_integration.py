@@ -76,10 +76,75 @@ WORKER = textwrap.dedent(
 ).format(repo=REPO)
 
 
+WORKER_ALLTOALL = textwrap.dedent(
+    """
+    import sys
+    pid, nproc, port, tmp = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+    jax.distributed.initialize(f"127.0.0.1:{{port}}", num_processes=nproc, process_id=pid)
+
+    from fast_tffm_tpu.config import Config
+    from fast_tffm_tpu.training import dist_train
+
+    cfg = Config(
+        model="fm", factor_num=4, vocabulary_size=128,
+        model_file=f"{{tmp}}/model_aa.orbax", checkpoint_format="orbax",
+        train_files=(f"{{tmp}}/train.libsvm",),
+        epoch_num=2, batch_size=32, learning_rate=0.1, log_every=3,
+        row_parallel=2,
+        lookup="alltoall", lookup_capacity_factor=0.25,
+        metrics_path=f"{{tmp}}/metrics_aa.jsonl",
+    ).validate()
+    assert cfg.lookup_overflow == "fallback"
+    state = dist_train(cfg, log=lambda m: print(f"[{{pid}}] {{m}}", flush=True))
+    print(f"[{{pid}}] DONE step={{int(state.step)}}", flush=True)
+    """
+).format(repo=REPO)
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+def _run_workers(script_text, tmp_path, extra_args=(), nproc=2, timeout=420):
+    """Launch ``nproc`` copies of a worker script (argv: pid nproc port tmp
+    [extra...]), collect their merged outputs, and assert every process
+    exited 0 — the one place the subprocess harness lives, shared by every
+    multi-process test so timeout/kill/env fixes can't drift."""
+    port = _free_port()
+    script = tmp_path / "worker.py"
+    script.write_text(script_text)
+    env = {
+        k: v for k, v in os.environ.items() if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(i), str(nproc), str(port),
+             str(tmp_path), *map(str, extra_args)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        for i in range(nproc)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+    finally:
+        for p in procs:  # never leave workers (and the coordinator port) behind
+            if p.poll() is None:
+                p.kill()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {i} failed:\n{out}"
+    return outs
 
 
 def _write_data(tmp_path):
@@ -100,32 +165,9 @@ def test_two_process_dist_train_and_cross_mesh_restore(tmp_path, cache):
     build on the shared tmp filesystem), stream sharded memmap batches,
     and must land on the same table as text input."""
     _write_data(tmp_path)
-    port = _free_port()
-    script = tmp_path / "worker.py"
-    script.write_text(WORKER)
-    env = {k: v for k, v in os.environ.items() if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(script), str(i), "2", str(port), str(tmp_path), str(int(cache))],
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-            text=True,
-            env=env,
-        )
-        for i in range(2)
-    ]
-    outs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=420)
-            outs.append(out)
-    finally:
-        for p in procs:  # never leave workers (and the coordinator port) behind
-            if p.poll() is None:
-                p.kill()
+    outs = _run_workers(WORKER, tmp_path, extra_args=(int(cache),))
     steps_per_epoch = -(-N_ROWS // 32)
-    for i, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"process {i} failed:\n{out}"
+    for i, out in enumerate(outs):
         assert f"[{i}] DONE step={2 * steps_per_epoch}" in out, out
     assert "mesh: {'data': 2, 'row': 2} on 4 devices" in outs[0]
     assert f"input sharding: {N_ROWS} rows over 2 processes" in outs[0]
@@ -213,3 +255,59 @@ def test_two_process_dist_train_and_cross_mesh_restore(tmp_path, cache):
     one = np.loadtxt(tmp_path / "scores_single.txt")
     assert dist.shape == one.shape == (96,)
     np.testing.assert_allclose(dist, one, atol=5e-5)
+
+
+@pytest.mark.slow
+def test_two_process_alltoall_overflow_fallback(tmp_path):
+    """The overflow fallback's lax.cond branches on a psum'd flag — in a
+    REAL two-process mesh every chip (across OS processes) must take the
+    same branch or the collectives deadlock.  Skewed ids with a
+    deliberately-undersized capacity force overflows on most steps; the
+    run must complete, count the events in the JSONL metrics, and land on
+    the same table as single-process ALLGATHER training (the fallback's
+    defined semantics)."""
+    import json
+
+    rng = np.random.default_rng(3)
+    with open(tmp_path / "train.libsvm", "w") as f:
+        for _ in range(N_ROWS):
+            # Ids concentrated on shard 0's row range [0, 64): every
+            # chip's send bucket for shard 0 exceeds the tiny capacity.
+            ids = rng.choice(64, size=5, replace=False)
+            toks = " ".join(f"{i}:1.0" for i in ids)
+            f.write(f"{rng.integers(0, 2)} {toks}\n")
+    outs = _run_workers(WORKER_ALLTOALL, tmp_path)
+    steps_per_epoch = -(-N_ROWS // 32)
+    for i, out in enumerate(outs):
+        assert f"[{i}] DONE step={2 * steps_per_epoch}" in out, out
+
+    # Overflow events reached the lead's metrics file.
+    records = [
+        json.loads(line)
+        for line in (tmp_path / "metrics_aa.jsonl").read_text().splitlines()
+    ]
+    assert sum(r.get("lookup_overflow_steps", 0) for r in records) >= 1
+
+    # Fallback semantics: equals single-process allgather training.
+    import jax
+
+    from fast_tffm_tpu.checkpoint import restore_checkpoint
+    from fast_tffm_tpu.config import Config
+    from fast_tffm_tpu.models import FMModel
+    from fast_tffm_tpu.trainer import init_state
+    from fast_tffm_tpu.training import train
+
+    model = FMModel(vocabulary_size=128, factor_num=4)
+    restored = restore_checkpoint(
+        str(tmp_path / "model_aa.orbax"), init_state(model, jax.random.key(0))
+    )
+    cfg = Config(
+        model="fm", factor_num=4, vocabulary_size=128,
+        model_file=str(tmp_path / "single_ag.ckpt"),
+        train_files=(str(tmp_path / "train.libsvm"),),
+        epoch_num=2, batch_size=32, learning_rate=0.1, log_every=10**9,
+    ).validate()
+    single = train(cfg, log=lambda *_: None)
+    np.testing.assert_allclose(
+        np.asarray(restored.table), np.asarray(single.table), rtol=2e-4, atol=2e-6
+    )
