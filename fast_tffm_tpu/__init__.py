@@ -13,6 +13,7 @@ __version__ = "0.1.0"
 
 from fast_tffm_tpu.config import Config, build_model, load_config  # noqa: F401
 from fast_tffm_tpu.data.binary import open_fmb, write_fmb  # noqa: F401
+from fast_tffm_tpu.metrics import StreamingAUC, auc  # noqa: F401
 from fast_tffm_tpu.models import Batch, DeepFMModel, FFMModel, FMModel  # noqa: F401
 from fast_tffm_tpu.ops.fm import fm_score  # noqa: F401
 
@@ -22,6 +23,8 @@ __all__ = [
     "DeepFMModel",
     "FFMModel",
     "FMModel",
+    "StreamingAUC",
+    "auc",
     "build_model",
     "fm_score",
     "load_config",
