@@ -128,3 +128,56 @@ def test_fmb_stream_parity_random(tmp_path_factory, file_rows, batch_size, epoch
         np.testing.assert_array_equal(pa.vals.view(np.uint32), pb.vals.view(np.uint32))
         np.testing.assert_array_equal(pa.nnz, pb.nnz)
         np.testing.assert_array_equal(wa, wb)
+
+
+@settings(max_examples=80, deadline=None)
+@given(
+    n=st.integers(2, 400),
+    chunking=st.integers(1, 97),
+    pos_rate=st.floats(0.05, 0.95),
+    seed=st.integers(0, 2**31 - 1),
+    weighted=st.booleans(),
+)
+def test_streaming_auc_exact_mode_matches_exact(n, chunking, pos_rate, seed, weighted):
+    """Below exact_cap the streaming accumulator must EQUAL the exact rank
+    AUC for any labels/scores/weights and any chunking of the stream —
+    ties, single-class prefixes, and weight-0 rows included."""
+    from fast_tffm_tpu.metrics import StreamingAUC, auc
+
+    rng = np.random.default_rng(seed)
+    labels = (rng.random(n) < pos_rate).astype(np.float32)
+    # Coarse quantization manufactures plenty of exact score ties.
+    scores = np.round(rng.random(n), 2)
+    weights = (rng.random(n) < 0.8).astype(np.float32) if weighted else None
+    s = StreamingAUC()
+    for lo in range(0, n, chunking):
+        sl = slice(lo, lo + chunking)
+        s.add(labels[sl], scores[sl], None if weights is None else weights[sl])
+    want = auc(labels, scores, weights)
+    got = s.value()
+    if np.isnan(want):
+        assert np.isnan(got)
+    else:
+        assert got == want
+
+
+@settings(max_examples=25, deadline=None)
+@given(
+    seed=st.integers(0, 2**31 - 1),
+    spread=st.floats(0.01, 4.0),
+)
+def test_streaming_auc_binned_mode_within_bound(seed, spread):
+    """Past the cap, the binned estimate must sit within its OWN reported
+    error_bound of the exact AUC (the self-check the warning relies on)."""
+    from fast_tffm_tpu.metrics import StreamingAUC, auc
+
+    rng = np.random.default_rng(seed)
+    n = 30_000
+    labels = (rng.random(n) < 0.4).astype(np.float32)
+    logits = spread * (labels - 0.5) + rng.normal(size=n)
+    scores = 1.0 / (1.0 + np.exp(-logits))
+    s = StreamingAUC(bins=1 << 12, exact_cap=4_000, warn_above=None)
+    for lo in range(0, n, 1999):
+        s.add(labels[lo : lo + 1999], scores[lo : lo + 1999])
+    assert s._edges is not None
+    assert abs(s.value() - auc(labels, scores)) <= s.error_bound() + 1e-12
