@@ -67,8 +67,11 @@ def zipf_ids(rng, shape, vocab):
     return ((z - 1) % vocab).astype(np.int32)
 
 
-def make_batch(ids):
-    rng = np.random.default_rng(ids[0, 0])
+def make_batch(ids, idx=0):
+    # Seeded by an explicit per-batch index (NOT ids[0,0]: Zipf's hot head
+    # collides on small values, giving several batches identical
+    # labels/vals).
+    rng = np.random.default_rng((idx, 0xB37C4))
     b, n = ids.shape
     return Batch(
         labels=jnp.asarray(rng.integers(0, 2, size=(b,)).astype(np.float32)),
@@ -212,7 +215,7 @@ def main():
         model = FMModel(vocabulary_size=cand, factor_num=SCALE_K, order=2)
         step = make_train_step(model, learning_rate=0.01)
         batches = [
-            make_batch(zipf_ids(rng, (BATCH, NNZ), cand)) for _ in range(16)
+            make_batch(zipf_ids(rng, (BATCH, NNZ), cand), i) for i in range(16)
         ]
         try:
             state = scale_state(cand, SCALE_K)
@@ -237,9 +240,9 @@ def main():
     try:
         uni = [
             make_batch(
-                rng.integers(0, vocab, size=(BATCH, NNZ)).astype(np.int32)
+                rng.integers(0, vocab, size=(BATCH, NNZ)).astype(np.int32), 100 + i
             )
-            for _ in range(16)
+            for i in range(16)
         ]
         state, uni_rate = measure(step, state, uni, iters=20)
         results["uniform_ids_value"] = round(uni_rate / jax.device_count(), 1)
@@ -278,9 +281,9 @@ def main():
         toy_step = make_train_step(toy_model, learning_rate=0.01)
         toy_batches = [
             make_batch(
-                rng.integers(0, 1 << 20, size=(BATCH, NNZ)).astype(np.int32)
+                rng.integers(0, 1 << 20, size=(BATCH, NNZ)).astype(np.int32), 200 + i
             )
-            for _ in range(8)
+            for i in range(8)
         ]
         toy_state = init_state(toy_model, jax.random.key(0))
         _, toy_rate = measure(toy_step, toy_state, toy_batches, iters=30)

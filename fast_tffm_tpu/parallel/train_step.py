@@ -139,18 +139,22 @@ def _make_gather(mesh: Mesh, local_ids_shape, lookup: str, capacity_factor: floa
 
     ``local_ids_shape`` is the PER-CHIP [B_local, N] shape (this is called
     from inside the shard_map body at trace time).  Returns
-    ``(gather_fn, capacity)`` — capacity is None on the all-gather path
-    and is THE single sizing both all-to-all directions share (the routed
-    update must use the same value)."""
+    ``(gather_fn, capacity, can_overflow)`` — capacity is None on the
+    all-gather path and is THE single sizing both all-to-all directions
+    share (the routed update must use the same value); ``can_overflow``
+    is False when the capacity caps at M = ids-per-chip (every id fits
+    one bucket, so overflow is statically impossible and callers may skip
+    the per-step routing_overflow check and its lax.cond dual-compile)."""
     if lookup == "allgather":
-        return sharded_gather, None
+        return sharded_gather, None, False
     if lookup != "alltoall":
         raise ValueError(f"unknown lookup {lookup!r} (allgather | alltoall)")
     from fast_tffm_tpu.parallel.alltoall import capacity_for, routed_gather
 
     b_local, n = local_ids_shape
-    cap = capacity_for(b_local * n, mesh.shape[ROW_AXIS], capacity_factor)
-    return (lambda table, ids: routed_gather(table, ids, cap)), cap
+    m = b_local * n
+    cap = capacity_for(m, mesh.shape[ROW_AXIS], capacity_factor)
+    return (lambda table, ids: routed_gather(table, ids, cap)), cap, cap < m
 
 
 def make_sharded_train_step(
@@ -192,7 +196,9 @@ def make_sharded_train_step(
         # Built per trace: the capacity is sized from THIS trace's batch
         # shape (a cached closure would pin a stale capacity across jit
         # retraces with bigger batches and spuriously overflow).
-        gather, cap = _make_gather(mesh, batch.ids.shape, lookup, capacity_factor)
+        gather, cap, can_overflow = _make_gather(
+            mesh, batch.ids.shape, lookup, capacity_factor
+        )
 
         def loss_fn(rows, dense):
             scores = model.score(rows, dense, batch)
@@ -233,7 +239,10 @@ def make_sharded_train_step(
                     dl = jnp.where(overflow, jnp.nan, dl)
                 return t2, a2, g_dense, dl
 
-            if fallback:
+            # When overflow is statically impossible, emit the routed branch
+            # alone — no bincount, no dual compile (HLO-pinned by
+            # test_impossible_overflow_skips_cond).
+            if fallback and can_overflow:
                 overflowed = routing_overflow(batch.ids, table.shape[0], cap)
                 table, accum, g_dense, data_loss_local = lax.cond(
                     overflowed, allgather_branch, routed_branch
@@ -298,8 +307,10 @@ def make_sharded_predict_step(
     fallback = lookup == "alltoall" and overflow_mode == "fallback"
 
     def shard_body(table, dense, batch: Batch):
-        gather, cap = _make_gather(mesh, batch.ids.shape, lookup, capacity_factor)
-        if fallback:
+        gather, cap, can_overflow = _make_gather(
+            mesh, batch.ids.shape, lookup, capacity_factor
+        )
+        if fallback and can_overflow:
             from fast_tffm_tpu.parallel.alltoall import routing_overflow
 
             rows = lax.cond(

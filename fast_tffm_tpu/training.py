@@ -450,19 +450,23 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
 
     extra_metrics = None
     if cfg.lookup == "alltoall" and cfg.lookup_overflow == "fallback":
-        # The fallback step returns a replicated overflow flag; keep the
-        # (tiny) device scalars unsynced and count them only at log points
-        # so the dispatch pipeline never stalls on a per-step fetch.
-        raw_step, pending = step_fn, []
+        # The fallback step returns a replicated overflow flag; fold it into
+        # ONE running device scalar (no host sync, no per-step buffer — a
+        # pending list would pin a live device scalar per step between log
+        # points) and fetch/reset it only at log points.
+        raw_step = step_fn
+        overflow_sum = [None]
 
         def step_fn(state, b):
             state, loss, overflowed = raw_step(state, b)
-            pending.append(overflowed)
+            overflow_sum[0] = (
+                overflowed if overflow_sum[0] is None else overflow_sum[0] + overflowed
+            )
             return state, loss
 
         def extra_metrics():
-            n = int(np.sum([np.asarray(x) for x in pending])) if pending else 0
-            pending.clear()
+            n = int(overflow_sum[0]) if overflow_sum[0] is not None else 0
+            overflow_sum[0] = None
             return {"lookup_overflow_steps": n}
 
     train_stream = examples_per_step = evaluate = None
