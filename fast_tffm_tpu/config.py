@@ -49,6 +49,8 @@ class Config:
     binary_cache_wait: float = 600.0  # multi-host: non-lead wait for lead's build (s)
     shuffle: bool = False  # per-epoch global shuffle of train rows (FMB input only)
     shuffle_seed: int = 0
+    device_cache: bool = False  # load the (FMB) train set to device HBM once,
+    #   slice batches on-chip — zero per-step host→device bytes (local train)
     queue_size: int = 8  # prefetch depth
     log_every: int = 100
     save_every_epochs: int = 1
@@ -187,6 +189,7 @@ def load_config(path: str) -> Config:
     cfg.binary_cache_wait = get(t, "binary_cache_wait", float, cfg.binary_cache_wait)
     cfg.shuffle = get(t, "shuffle", ini._convert_to_boolean, cfg.shuffle)
     cfg.shuffle_seed = get(t, "shuffle_seed", int, cfg.shuffle_seed)
+    cfg.device_cache = get(t, "device_cache", ini._convert_to_boolean, cfg.device_cache)
     cfg.queue_size = get(t, "queue_size", int, cfg.queue_size)
     cfg.log_every = get(t, "log_every", int, cfg.log_every)
     cfg.save_every_epochs = get(t, "save_every_epochs", int, cfg.save_every_epochs)

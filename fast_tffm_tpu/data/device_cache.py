@@ -1,0 +1,186 @@
+"""Device-resident dataset mode: load the epoch ONCE, slice batches on-chip.
+
+The reference streams every batch from the host every epoch — it had to,
+being CPU-only (`renyi533/fast_tffm` :: py/ input queues feeding the
+session loop).  On a TPU the jitted train step sustains hundreds of
+millions of examples/sec while the host→device link delivers a few million
+(and on this dev box the tunnel swings ~100×, README "Benchmarks") — so
+for any dataset whose packed arrays fit HBM **beside the table**, per-step
+H2D transfer is pure overhead the framework can eliminate entirely.
+
+``device_cache = true`` ([Train]) does that: the FMB-backed input is
+assembled into flat row-major device arrays ``[batches·B, ...]`` ONE time,
+and every train step slices its batch out with ``lax.dynamic_slice``
+inside the SAME jitted program as the model step — zero host↔device bytes
+per step, zero extra dispatches.  Epochs re-visit the resident arrays; a
+per-epoch ``shuffle`` uploads one [rows] permutation (the identical
+permutation the streamed path draws — bit-parity holds shuffled too) and
+the step gathers its batch through it.
+
+Bit-identity with the streamed path is BY CONSTRUCTION: the resident
+arrays are assembled by ``fmb_batch_stream`` itself (same padding, width
+clamping, per-file weights, header validation), and the step applies
+``trainer.train_step_body`` — the same function the streamed step jits —
+to the same values (test-pinned in tests/test_device_cache.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from fast_tffm_tpu.models.base import Batch
+from fast_tffm_tpu.trainer import TrainState, train_step_body
+
+__all__ = [
+    "DeviceDataset",
+    "load_device_dataset",
+    "epoch_permutation",
+    "full_epoch_perm",
+    "make_cached_train_step",
+]
+
+
+class DeviceDataset(NamedTuple):
+    """Flat row-major device-resident arrays: leading dim [batches·B]
+    (ONE copy serves both the sequential slice and the shuffled gather —
+    a second batch-major copy would halve the max cacheable dataset)."""
+
+    labels: Any  # f32 [batches·B]
+    ids: Any  # i32 [batches·B, N]
+    vals: Any  # f32 [batches·B, N]
+    fields: Any  # i32 [batches·B, N] (or [batches·B, 0] when unused)
+    weights: Any  # f32 [batches·B]  (0.0 on tail-padding rows)
+    batches: int
+    batch_size: int
+    n_rows: int  # real (unpadded) rows
+
+    @property
+    def nbytes(self) -> int:
+        return sum(
+            int(np.prod(a.shape)) * a.dtype.itemsize
+            for a in (self.labels, self.ids, self.vals, self.fields, self.weights)
+        )
+
+
+def load_device_dataset(
+    files,
+    *,
+    batch_size: int,
+    vocabulary_size: int,
+    hash_feature_id: bool = False,
+    max_nnz: int | None = None,
+    weights=None,
+    with_fields: bool = True,
+    device=None,
+) -> DeviceDataset:
+    """Assemble FMB files into one device-resident DeviceDataset.
+
+    Every row goes through ``fmb_batch_stream`` — the exact batches the
+    streamed trainer would see (same order, padding, weights, header
+    validation) — then the concatenated arrays transfer to the device
+    once, COMMITTED to ``device`` (default: the first device) so nothing
+    moves them implicitly later.
+    """
+    from fast_tffm_tpu.data.binary import fmb_batch_stream, open_fmb
+
+    files = [str(f) for f in files]
+    n_rows = sum(open_fmb(f).n_rows for f in files)
+    if n_rows == 0:
+        raise ValueError(f"device_cache: no rows in {files}")
+    batches = -(-n_rows // batch_size)  # ceil; tail pads with weight-0 rows
+    cols = {"labels": [], "ids": [], "vals": [], "fields": [], "weights": []}
+    for parsed, w in fmb_batch_stream(
+        files,
+        batch_size=batch_size,
+        vocabulary_size=vocabulary_size,
+        hash_feature_id=hash_feature_id,
+        max_nnz=max_nnz,
+        epochs=1,
+        weights=weights,
+    ):
+        cols["labels"].append(parsed.labels)
+        cols["ids"].append(parsed.ids.astype(np.int32, copy=False))
+        cols["vals"].append(parsed.vals)
+        cols["fields"].append(
+            parsed.fields if with_fields else parsed.fields[:, :0]
+        )
+        cols["weights"].append(w)
+    put = partial(jax.device_put, device=device or jax.devices()[0])
+    stack = {k: put(np.concatenate(v)) for k, v in cols.items()}
+    return DeviceDataset(
+        labels=stack["labels"],
+        ids=stack["ids"],
+        vals=stack["vals"],
+        fields=stack["fields"],
+        weights=stack["weights"],
+        batches=batches,
+        batch_size=batch_size,
+        n_rows=n_rows,
+    )
+
+
+def epoch_permutation(shuffle_seed: int, epoch: int, n_rows: int) -> np.ndarray:
+    """THE permutation the streamed path draws for this epoch
+    (training._stream folds the epoch into the seed, fmb_batch_stream
+    draws rng((seed, 0)) for its single-epoch stream) — shared here so
+    device-cached shuffling is bit-identical to streamed shuffling."""
+    seed = shuffle_seed * 1_000_003 + epoch
+    return np.random.default_rng((seed, 0)).permutation(n_rows)
+
+
+def full_epoch_perm(data: DeviceDataset, shuffle_seed: int, epoch: int) -> np.ndarray:
+    """Flat-row index order for one shuffled epoch: the streamed-path
+    permutation over the real rows, then the tail-padding rows in place
+    (they sit at flat positions [n_rows, batches·B) and always land in the
+    final batch, exactly like the streamed tail)."""
+    flat_rows = data.batches * data.batch_size
+    return np.concatenate(
+        [
+            epoch_permutation(shuffle_seed, epoch, data.n_rows),
+            np.arange(data.n_rows, flat_rows, dtype=np.int64),
+        ]
+    ).astype(np.int32)
+
+
+def make_cached_train_step(model, learning_rate: float, data: DeviceDataset):
+    """Returns jitted ``step(state, i) -> (state, data_loss)`` over the
+    resident arrays — and ``step_shuffled(state, perm, i)`` whose batch
+    rows come through a device-resident [rows] permutation.
+
+    ``i`` is a traced scalar (one executable serves every step; a Python
+    int would retrace per step).  The resident arrays are EXPLICIT jit
+    arguments, never closure captures: a closure-captured jax.Array
+    becomes an embedded constant, and this backend re-materializes
+    embedded constants per call — measured 217 ms/step vs 32 µs with the
+    same arrays passed as arguments (an 8000× cliff; see DESIGN §6).
+    One dispatch per step; XLA fuses the batch slice into the model
+    program, so the slice costs O(B·N) HBM reads, not a transfer.
+    """
+    B = data.batch_size
+    arrays = (data.labels, data.ids, data.vals, data.fields, data.weights)
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def _step(state: TrainState, arrs, i):
+        sl = lambda a: lax.dynamic_slice_in_dim(a, i * B, B, axis=0)
+        b = Batch(*map(sl, arrs))
+        return train_step_body(model, learning_rate, state, b)
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def _step_shuffled(state: TrainState, arrs, perm, i):
+        idx = lax.dynamic_slice_in_dim(perm, i * B, B)
+        b = Batch(*(jnp.take(a, idx, axis=0) for a in arrs))
+        return train_step_body(model, learning_rate, state, b)
+
+    def step(state, i):
+        return _step(state, arrays, i)
+
+    def step_shuffled(state, perm, i):
+        return _step_shuffled(state, arrays, perm, i)
+
+    return step, step_shuffled

@@ -27,7 +27,13 @@ from fast_tffm_tpu.optim import (
     sparse_adagrad_update,
 )
 
-__all__ = ["TrainState", "init_state", "make_train_step", "make_predict_step"]
+__all__ = [
+    "TrainState",
+    "init_state",
+    "train_step_body",
+    "make_train_step",
+    "make_predict_step",
+]
 
 
 class TrainState(NamedTuple):
@@ -68,6 +74,32 @@ def batch_loss(model, table_rows, dense, batch: Batch):
     return data_loss + reg, data_loss
 
 
+def train_step_body(model, learning_rate: float, state: TrainState, batch: Batch):
+    """The (unjitted) single-device step: gather → fused scorer → loss →
+    dedup → sparse Adagrad.  Shared verbatim by ``make_train_step`` and the
+    device-cache step (data/device_cache.py) so the two paths are the SAME
+    math on the same values — the bit-identity their parity test pins."""
+    rows = state.table[batch.ids]  # [B, N, D] gather of touched rows only
+
+    grad_fn = jax.value_and_grad(
+        partial(batch_loss, model), argnums=(0, 1), has_aux=True
+    )
+    (_, data_loss), (g_rows, g_dense) = grad_fn(rows, state.dense, batch)
+
+    table, table_opt = sparse_adagrad_update(
+        state.table, state.table_opt, batch.ids, g_rows, learning_rate
+    )
+    dense, dense_opt = state.dense, state.dense_opt
+    if jax.tree.leaves(state.dense):
+        dense, dense_opt = dense_adagrad_update(
+            state.dense, state.dense_opt, g_dense, learning_rate
+        )
+    return (
+        TrainState(table, table_opt, dense, dense_opt, state.step + 1),
+        data_loss,
+    )
+
+
 def make_train_step(model, learning_rate: float):
     """Returns jitted ``step(state, batch) -> (state, data_loss)``.
 
@@ -79,25 +111,7 @@ def make_train_step(model, learning_rate: float):
 
     @partial(jax.jit, donate_argnums=(0,))
     def step(state: TrainState, batch: Batch):
-        rows = state.table[batch.ids]  # [B, N, D] gather of touched rows only
-
-        grad_fn = jax.value_and_grad(
-            partial(batch_loss, model), argnums=(0, 1), has_aux=True
-        )
-        (_, data_loss), (g_rows, g_dense) = grad_fn(rows, state.dense, batch)
-
-        table, table_opt = sparse_adagrad_update(
-            state.table, state.table_opt, batch.ids, g_rows, learning_rate
-        )
-        dense, dense_opt = state.dense, state.dense_opt
-        if jax.tree.leaves(state.dense):
-            dense, dense_opt = dense_adagrad_update(
-                state.dense, state.dense_opt, g_dense, learning_rate
-            )
-        return (
-            TrainState(table, table_opt, dense, dense_opt, state.step + 1),
-            data_loss,
-        )
+        return train_step_body(model, learning_rate, state, batch)
 
     return step
 

@@ -383,10 +383,88 @@ def train(cfg: Config, *, resume: bool = False, log=print):
     if resume:
         state = restore_checkpoint(cfg.model_file, state)
         log(f"resumed from {cfg.model_file} at step {int(state.step)}")
-    step_fn = make_train_step(model, cfg.learning_rate)
     predict_step = make_predict_step(model)
     to_batch = lambda parsed, w: Batch.from_parsed(parsed, w, with_fields=model.uses_fields)
+    if cfg.device_cache:
+        step_fn, train_stream, examples_per_step = _device_cached_input(
+            cfg, model, max_nnz, log
+        )
+        return _run_training(
+            cfg, state, step_fn, predict_step, max_nnz, log,
+            train_stream=train_stream, to_batch=to_batch,
+            examples_per_step=examples_per_step,
+        )
+    step_fn = make_train_step(model, cfg.learning_rate)
     return _run_training(cfg, state, step_fn, predict_step, max_nnz, log, to_batch=to_batch)
+
+
+def _device_cached_input(cfg: Config, model, max_nnz: int, log):
+    """device_cache = true: the train set becomes device-resident arrays
+    sliced on-chip per step — zero per-step host→device bytes (the
+    streamed alternative moves every batch through the host every epoch;
+    on the bench regime that is a ~300× throughput gap, README
+    "Benchmarks").  Input must be FMB-backed: .fmb train_files directly,
+    or binary_cache = true to convert text once.  Returns
+    ``(step_fn, train_stream, examples_per_step)`` for _run_training; the
+    emitted "batch" is a device batch-index scalar and the jitted step
+    fuses the batch slice (or the shuffled gather) with the model step.
+    """
+    from fast_tffm_tpu.data.device_cache import (
+        full_epoch_perm,
+        load_device_dataset,
+        make_cached_train_step,
+    )
+
+    files = tuple(cfg.train_files)
+    if cfg.binary_cache:
+        from fast_tffm_tpu.data.binary import ensure_fmb_cache
+
+        files = ensure_fmb_cache(
+            files,
+            vocabulary_size=cfg.vocabulary_size,
+            hash_feature_id=cfg.hash_feature_id,
+            max_nnz=max_nnz,
+            parser=best_parser(cfg.thread_num),
+        )
+    if not binary_input(files):
+        raise ValueError(
+            "device_cache = true needs FMB-backed input: list .fmb files in "
+            "train_files, or set binary_cache = true to convert text once"
+        )
+    data = load_device_dataset(
+        files,
+        batch_size=cfg.batch_size,
+        vocabulary_size=cfg.vocabulary_size,
+        hash_feature_id=cfg.hash_feature_id,
+        max_nnz=max_nnz,
+        weights=cfg.weight_files if cfg.weight_files else None,
+        with_fields=model.uses_fields,
+    )
+    log(
+        f"device cache: {data.n_rows} rows resident "
+        f"({data.nbytes / 2**20:.1f} MiB, {data.batches} batches/epoch)"
+    )
+    cached_step, cached_step_shuffled = make_cached_train_step(
+        model, cfg.learning_rate, data
+    )
+    # Batch indices as pre-placed device scalars: the per-step "input" is
+    # an index that is already on device — no per-step H2D at all.
+    idx = [jax.device_put(np.int32(i)) for i in range(data.batches)]
+    perm_ref = [None]
+
+    def train_stream(epoch):
+        if cfg.shuffle:
+            perm_ref[0] = jax.device_put(
+                full_epoch_perm(data, cfg.shuffle_seed, epoch)
+            )
+        return ((idx[i], None, None) for i in range(data.batches))
+
+    def step_fn(state, i):
+        if perm_ref[0] is not None:
+            return cached_step_shuffled(state, perm_ref[0], i)
+        return cached_step(state, i)
+
+    return step_fn, train_stream, cfg.batch_size
 
 
 def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):

@@ -1,0 +1,168 @@
+"""Device-resident dataset mode (`device_cache = true`).
+
+The load-bearing property: training from the device-resident arrays is
+BIT-IDENTICAL to training from the streamed FMB path — same batches, same
+order, same padding and weights, same step math (they share
+trainer.train_step_body) — while moving zero host→device bytes per step.
+"""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from fast_tffm_tpu.config import Config
+from fast_tffm_tpu.data.binary import write_fmb
+from fast_tffm_tpu.training import train
+
+
+def _write_text(path, rows, rng, vocab=200):
+    with open(path, "w") as f:
+        for _ in range(rows):
+            label = rng.integers(0, 2)
+            nnz = rng.integers(1, 8)
+            toks = [
+                f"{rng.integers(0, vocab)}:{round(float(rng.normal()), 4)}"
+                for _ in range(nnz)
+            ]
+            f.write(f"{label} {' '.join(toks)}\n")
+    return str(path)
+
+
+def _cfg(tmp_path, files, tag, **kw):
+    return Config(
+        model="fm",
+        factor_num=4,
+        vocabulary_size=200,
+        model_file=str(tmp_path / f"model_{tag}.ckpt"),
+        train_files=tuple(files),
+        epoch_num=2,
+        batch_size=32,
+        learning_rate=0.05,
+        log_every=1,
+        metrics_path=str(tmp_path / f"m_{tag}.jsonl"),
+        **kw,
+    ).validate()
+
+
+def _losses(path):
+    return [
+        r["loss"]
+        for r in map(json.loads, open(path).read().splitlines())
+        if "loss" in r
+    ]
+
+
+@pytest.fixture()
+def fmb_files(tmp_path):
+    rng = np.random.default_rng(42)
+    out = []
+    for name, rows in (("a", 83), ("b", 41)):  # ragged: exercises tail padding
+        src = _write_text(tmp_path / f"{name}.libsvm", rows, rng)
+        out.append(write_fmb(src, src + ".fmb", vocabulary_size=200))
+    return out
+
+
+def _run(tmp_path, fmb_files, tag, **kw):
+    cfg = _cfg(tmp_path, fmb_files, tag, **kw)
+    state = train(cfg, log=lambda *_: None)
+    return state, _losses(cfg.metrics_path)
+
+
+def test_device_cache_bit_identical_to_streamed(tmp_path, fmb_files):
+    st_stream, l_stream = _run(tmp_path, fmb_files, "stream")
+    st_cache, l_cache = _run(tmp_path, fmb_files, "cache", device_cache=True)
+    assert l_stream == l_cache  # every logged step loss identical
+    np.testing.assert_array_equal(
+        np.asarray(st_stream.table), np.asarray(st_cache.table)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(st_stream.table_opt.accum), np.asarray(st_cache.table_opt.accum)
+    )
+    assert int(st_stream.step) == int(st_cache.step)
+
+
+def test_device_cache_shuffled_bit_identical(tmp_path, fmb_files):
+    """The shuffled epochs draw the SAME permutation as the streamed path
+    (shared seed folding), so bit-parity holds under shuffle too."""
+    kw = dict(shuffle=True, shuffle_seed=7, binary_cache=True)
+    st_stream, l_stream = _run(tmp_path, fmb_files, "sstream", **kw)
+    st_cache, l_cache = _run(tmp_path, fmb_files, "scache", device_cache=True, **kw)
+    assert l_stream == l_cache
+    np.testing.assert_array_equal(
+        np.asarray(st_stream.table), np.asarray(st_cache.table)
+    )
+    # And shuffling genuinely reordered rows vs the sequential run.
+    _, l_seq = _run(tmp_path, fmb_files, "seq")
+    assert l_stream != l_seq
+
+
+def test_device_cache_weight_files(tmp_path, fmb_files):
+    kw = dict(weight_files=(2.0, 0.5))
+    st_stream, l_stream = _run(tmp_path, fmb_files, "wstream", **kw)
+    st_cache, l_cache = _run(tmp_path, fmb_files, "wcache", device_cache=True, **kw)
+    assert l_stream == l_cache
+    np.testing.assert_array_equal(
+        np.asarray(st_stream.table), np.asarray(st_cache.table)
+    )
+
+
+def test_device_cache_requires_fmb(tmp_path):
+    rng = np.random.default_rng(0)
+    src = _write_text(tmp_path / "t.libsvm", 40, rng)
+    cfg = _cfg(tmp_path, [src], "text", device_cache=True)
+    with pytest.raises(ValueError, match="FMB-backed"):
+        train(cfg, log=lambda *_: None)
+
+
+def test_device_cache_with_binary_cache_autoconvert(tmp_path):
+    rng = np.random.default_rng(1)
+    src = _write_text(tmp_path / "t.libsvm", 70, rng)
+    st_cache, l_cache = _run(
+        tmp_path, [src], "auto", device_cache=True, binary_cache=True
+    )
+    st_stream, l_stream = _run(tmp_path, [src], "autostream", binary_cache=True)
+    assert l_stream == l_cache
+    np.testing.assert_array_equal(
+        np.asarray(st_stream.table), np.asarray(st_cache.table)
+    )
+
+
+def test_device_cache_zero_per_step_transfers(tmp_path, fmb_files):
+    """The per-step call moves NOTHING host→device: the resident arrays
+    are committed device buffers, the index scalars are pre-placed, and
+    the whole steady-state loop runs under jax.transfer_guard('disallow')
+    — any implicit transfer (a regression back to host-fed batches)
+    raises."""
+    from fast_tffm_tpu.config import build_model
+    from fast_tffm_tpu.data.device_cache import (
+        full_epoch_perm,
+        load_device_dataset,
+        make_cached_train_step,
+    )
+    from fast_tffm_tpu.trainer import init_state
+
+    cfg = _cfg(tmp_path, fmb_files, "struct")
+    model = build_model(cfg)
+    dev = jax.devices()[0]
+    data = load_device_dataset(
+        fmb_files, batch_size=32, vocabulary_size=200, max_nnz=8
+    )
+    assert data.n_rows == 124 and data.batches == 4
+    for a in (data.labels, data.ids, data.vals, data.fields, data.weights):
+        assert isinstance(a, jax.Array) and a.committed and a.devices() == {dev}
+    step, step_shuffled = make_cached_train_step(model, 0.05, data)
+    state = init_state(model, jax.random.key(0))
+    idx = [jax.device_put(np.int32(i), dev) for i in range(data.batches)]
+    perm = jax.device_put(full_epoch_perm(data, 3, 0), dev)
+    state, loss = step(state, idx[0])  # compile outside the guard
+    state, loss = step_shuffled(state, perm, idx[0])
+    jax.block_until_ready(loss)
+    with jax.transfer_guard("disallow"):
+        for i in range(data.batches):
+            state, loss = step(state, idx[i])
+        for i in range(data.batches):
+            state, loss = step_shuffled(state, perm, idx[i])
+        jax.block_until_ready(loss)
+    assert np.isfinite(float(loss))
