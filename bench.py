@@ -273,11 +273,15 @@ def main():
     except Exception as e:
         results["sharded_value"] = None
         results["sharded_error"] = str(e)[:120]
+    del state, batches
+
     # --- device-resident dataset (device_cache = true): the epoch lives in
     #     HBM beside the table and every step slices its batch on-chip —
     #     zero per-step H2D.  Expected within ~2× of the synthetic-batch
     #     headline (same program + a fused dynamic-slice), vs the ~300×
-    #     gap of the host-streamed path. ---
+    #     gap of the host-streamed path.  A FRESH single-device state:
+    #     the sharded section's mesh-committed buffers can't feed this
+    #     single-device step, and this is a one-chip number (no /n). ---
     try:
         from fast_tffm_tpu.data.device_cache import (
             load_device_dataset,
@@ -302,15 +306,14 @@ def main():
             def __len__(self):
                 return len(idx)
 
-        state, dc_rate = measure(cached_step, state, _IdxBatches(), iters=20)
-        results["device_cached_value"] = round(dc_rate / jax.device_count(), 1)
+        dc_state = scale_state(vocab, SCALE_K)
+        dc_state, dc_rate = measure(cached_step, dc_state, _IdxBatches(), iters=20)
+        results["device_cached_value"] = round(dc_rate, 1)
         results["device_cached_mib"] = round(data.nbytes / 2**20, 1)
-        del data, cached_step, idx
+        del data, cached_step, idx, dc_state
     except Exception as e:
         results["device_cached_value"] = None
         results["device_cached_error"] = str(e)[:120]
-
-    del state, batches
 
     # --- r1 continuity: the 1M-row uniform-id microbench ---
     try:

@@ -51,6 +51,8 @@ __all__ = [
     "write_fmb",
     "fmb_batch_stream",
     "ensure_fmb_cache",
+    "fold_epoch_seed",
+    "draw_permutation",
 ]
 
 FMB_MAGIC = b"FMB1"
@@ -265,6 +267,21 @@ def write_fmb(
     return out_path
 
 
+def fold_epoch_seed(shuffle_seed: int, epoch: int) -> int:
+    """THE per-epoch seed fold shared by every shuffling surface (the
+    streamed driver creates one single-epoch stream per training epoch and
+    folds the epoch in here; the device cache draws the same permutation).
+    One definition keeps shuffled bit-parity structural, not coincidental."""
+    return shuffle_seed * 1_000_003 + epoch
+
+
+def draw_permutation(seed: int, epoch: int, n: int) -> np.ndarray:
+    """THE permutation draw behind ``shuffle = true`` — all consumers
+    (fmb_batch_stream's slot order, device_cache's resident gather) must
+    call this, never default_rng directly."""
+    return np.random.default_rng((seed, epoch)).permutation(n)
+
+
 def _shard_runs(
     counter: int, n: int, shard_index: int, shard_count: int, block: int
 ) -> Iterator[tuple[int, int]]:
@@ -408,7 +425,7 @@ def fmb_batch_stream(
             # One permutation of ALL rows per epoch; slots are the output
             # order, and this shard owns slots by the block-cyclic rule —
             # every process derives the identical permutation from the seed.
-            perm = np.random.default_rng((shuffle_seed, e)).permutation(total)
+            perm = draw_permutation(shuffle_seed, e, total)
             slots = np.arange(total, dtype=np.int64)
             mine = ((slot_base + slots) // block) % shard_count == shard_index
             rows = perm[mine]  # source row per owned slot, in slot order

@@ -94,7 +94,13 @@ def load_device_dataset(
     if n_rows == 0:
         raise ValueError(f"device_cache: no rows in {files}")
     batches = -(-n_rows // batch_size)  # ceil; tail pads with weight-0 rows
-    cols = {"labels": [], "ids": [], "vals": [], "fields": [], "weights": []}
+    flat = batches * batch_size
+    # Preallocate the flat host staging arrays (shapes are known upfront)
+    # and fill per-batch slices — a list-then-concatenate would hold the
+    # whole dataset on the host TWICE, OOMing exactly the near-HBM-sized
+    # datasets this mode exists for.
+    host = None
+    lo = 0
     for parsed, w in fmb_batch_stream(
         files,
         batch_size=batch_size,
@@ -104,21 +110,30 @@ def load_device_dataset(
         epochs=1,
         weights=weights,
     ):
-        cols["labels"].append(parsed.labels)
-        cols["ids"].append(parsed.ids.astype(np.int32, copy=False))
-        cols["vals"].append(parsed.vals)
-        cols["fields"].append(
-            parsed.fields if with_fields else parsed.fields[:, :0]
-        )
-        cols["weights"].append(w)
+        if host is None:
+            width = parsed.ids.shape[1]
+            host = dict(
+                labels=np.zeros(flat, np.float32),
+                ids=np.zeros((flat, width), np.int32),
+                vals=np.zeros((flat, width), np.float32),
+                fields=np.zeros((flat, width if with_fields else 0), np.int32),
+                weights=np.zeros(flat, np.float32),
+            )
+        hi = lo + parsed.labels.shape[0]
+        host["labels"][lo:hi] = parsed.labels
+        host["ids"][lo:hi] = parsed.ids
+        host["vals"][lo:hi] = parsed.vals
+        if with_fields:
+            host["fields"][lo:hi] = parsed.fields
+        host["weights"][lo:hi] = w
+        lo = hi
     put = partial(jax.device_put, device=device or jax.devices()[0])
-    stack = {k: put(np.concatenate(v)) for k, v in cols.items()}
     return DeviceDataset(
-        labels=stack["labels"],
-        ids=stack["ids"],
-        vals=stack["vals"],
-        fields=stack["fields"],
-        weights=stack["weights"],
+        labels=put(host["labels"]),
+        ids=put(host["ids"]),
+        vals=put(host["vals"]),
+        fields=put(host["fields"]),
+        weights=put(host["weights"]),
         batches=batches,
         batch_size=batch_size,
         n_rows=n_rows,
@@ -126,12 +141,14 @@ def load_device_dataset(
 
 
 def epoch_permutation(shuffle_seed: int, epoch: int, n_rows: int) -> np.ndarray:
-    """THE permutation the streamed path draws for this epoch
-    (training._stream folds the epoch into the seed, fmb_batch_stream
-    draws rng((seed, 0)) for its single-epoch stream) — shared here so
-    device-cached shuffling is bit-identical to streamed shuffling."""
-    seed = shuffle_seed * 1_000_003 + epoch
-    return np.random.default_rng((seed, 0)).permutation(n_rows)
+    """THE permutation the streamed path draws for this epoch: the driver
+    folds the epoch into the seed (fold_epoch_seed) and the per-epoch
+    stream draws its epoch-0 permutation — both through binary.py's shared
+    helpers, so device-cached shuffling is STRUCTURALLY bit-identical to
+    streamed shuffling (one definition, not three synchronized copies)."""
+    from fast_tffm_tpu.data.binary import draw_permutation, fold_epoch_seed
+
+    return draw_permutation(fold_epoch_seed(shuffle_seed, epoch), 0, n_rows)
 
 
 def full_epoch_perm(data: DeviceDataset, shuffle_seed: int, epoch: int) -> np.ndarray:

@@ -126,8 +126,10 @@ def _stream(
     # Per-epoch shuffle (train streams only — drivers create one stream per
     # epoch and pass its index).  The seed folds the epoch so every epoch
     # draws a fresh permutation, identically on every process.
+    from fast_tffm_tpu.data.binary import fold_epoch_seed
+
     shuffle_seed = (
-        cfg.shuffle_seed * 1_000_003 + shuffle_epoch
+        fold_epoch_seed(cfg.shuffle_seed, shuffle_epoch)
         if cfg.shuffle and shuffle_epoch is not None
         else None
     )
@@ -500,6 +502,14 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
         raise ValueError(
             f"weight_files has {len(cfg.weight_files)} entries for "
             f"{len(cfg.train_files)} train_files (they align per-file)"
+        )
+    if cfg.device_cache:
+        # Silent fallback to host streaming would defeat the whole point
+        # of the flag (the ~300x feed gap it exists to close) — refuse
+        # loudly until the sharded resident path exists.
+        raise ValueError(
+            "device_cache = true is a local-train feature for now; "
+            "dist_train streams batches (drop the flag, or run `train`)"
         )
     maybe_initialize_distributed(cfg.coordinator_address, cfg.num_processes, cfg.process_id)
     model = build_model(cfg)

@@ -166,3 +166,12 @@ def test_device_cache_zero_per_step_transfers(tmp_path, fmb_files):
             state, loss = step_shuffled(state, perm, idx[i])
         jax.block_until_ready(loss)
     assert np.isfinite(float(loss))
+
+
+def test_device_cache_dist_train_refuses(tmp_path, fmb_files):
+    """dist_train must refuse device_cache loudly, never silently stream."""
+    from fast_tffm_tpu.training import dist_train
+
+    cfg = _cfg(tmp_path, fmb_files, "dist", device_cache=True)
+    with pytest.raises(ValueError, match="local-train"):
+        dist_train(cfg, log=lambda *_: None)
