@@ -29,6 +29,9 @@ class Config:
     vocabulary_size: int = 1 << 20
     vocabulary_block_num: int = 1  # reference key; default row_parallel
     hash_feature_id: bool = False
+    table_layout: str = "rows"  # rows ([V,D]) | packed (lane-packed [V/P,128]
+    #   tile rows — fixes the partial-lane scatter cliff, DESIGN §6; local
+    #   train/predict, element accumulator)
     model_file: str = "model.ckpt"
     checkpoint_format: str = "npz"  # npz | orbax (orbax = sharded, pod-scale)
     # [Train]
@@ -50,7 +53,8 @@ class Config:
     shuffle: bool = False  # per-epoch global shuffle of train rows (FMB input only)
     shuffle_seed: int = 0
     device_cache: bool = False  # load the (FMB) train set to device HBM once,
-    #   slice batches on-chip — zero per-step host→device bytes (local train)
+    #   slice batches on-chip — zero per-step host→device bytes; dist_train
+    #   shards the resident arrays over the mesh (single-process, no shuffle)
     queue_size: int = 8  # prefetch depth
     log_every: int = 100
     save_every_epochs: int = 1
@@ -109,6 +113,18 @@ class Config:
             raise ValueError(
                 f"unknown adagrad_accumulator {self.adagrad_accumulator!r} (element | row)"
             )
+        if self.table_layout not in ("rows", "packed"):
+            raise ValueError(
+                f"unknown table_layout {self.table_layout!r} (rows | packed)"
+            )
+        if self.table_layout == "packed" and self.adagrad_accumulator != "element":
+            # The packed update writes whole 128-lane tile rows; the
+            # element accumulator packs identically and zero-grad Adagrad
+            # is the identity, which is what makes that exact.  A packed
+            # row accumulator would be a narrow array again.
+            raise ValueError(
+                "table_layout = packed requires adagrad_accumulator = element"
+            )
         return self
 
 
@@ -162,6 +178,7 @@ def load_config(path: str) -> Config:
     cfg.vocabulary_size = get(g, "vocabulary_size", int, cfg.vocabulary_size)
     cfg.vocabulary_block_num = get(g, "vocabulary_block_num", int, cfg.vocabulary_block_num)
     cfg.hash_feature_id = get(g, "hash_feature_id", ini._convert_to_boolean, cfg.hash_feature_id)
+    cfg.table_layout = get(g, "table_layout", str, cfg.table_layout).lower()
     cfg.model_file = get(g, "model_file", str, cfg.model_file)
     cfg.checkpoint_format = get(g, "checkpoint_format", str, cfg.checkpoint_format).lower()
 

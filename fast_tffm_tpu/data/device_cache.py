@@ -68,7 +68,7 @@ class DeviceDataset(NamedTuple):
         )
 
 
-def load_device_dataset(
+def _load_host_arrays(
     files,
     *,
     batch_size: int,
@@ -77,16 +77,10 @@ def load_device_dataset(
     max_nnz: int | None = None,
     weights=None,
     with_fields: bool = True,
-    device=None,
-) -> DeviceDataset:
-    """Assemble FMB files into one device-resident DeviceDataset.
-
-    Every row goes through ``fmb_batch_stream`` — the exact batches the
-    streamed trainer would see (same order, padding, weights, header
-    validation) — then the concatenated arrays transfer to the device
-    once, COMMITTED to ``device`` (default: the first device) so nothing
-    moves them implicitly later.
-    """
+):
+    """Flat host staging arrays via fmb_batch_stream (shared by the local
+    and mesh-sharded loaders — the sharded one uploads straight from
+    host to its mesh placement, never bouncing through one device)."""
     from fast_tffm_tpu.data.binary import fmb_batch_stream, open_fmb
 
     files = [str(f) for f in files]
@@ -127,6 +121,37 @@ def load_device_dataset(
             host["fields"][lo:hi] = parsed.fields
         host["weights"][lo:hi] = w
         lo = hi
+    return host, batches, n_rows
+
+
+def load_device_dataset(
+    files,
+    *,
+    batch_size: int,
+    vocabulary_size: int,
+    hash_feature_id: bool = False,
+    max_nnz: int | None = None,
+    weights=None,
+    with_fields: bool = True,
+    device=None,
+) -> DeviceDataset:
+    """Assemble FMB files into one device-resident DeviceDataset.
+
+    Every row goes through ``fmb_batch_stream`` — the exact batches the
+    streamed trainer would see (same order, padding, weights, header
+    validation) — then the concatenated arrays transfer to the device
+    once, COMMITTED to ``device`` (default: the first device) so nothing
+    moves them implicitly later.
+    """
+    host, batches, n_rows = _load_host_arrays(
+        files,
+        batch_size=batch_size,
+        vocabulary_size=vocabulary_size,
+        hash_feature_id=hash_feature_id,
+        max_nnz=max_nnz,
+        weights=weights,
+        with_fields=with_fields,
+    )
     put = partial(jax.device_put, device=device or jax.devices()[0])
     return DeviceDataset(
         labels=put(host["labels"]),
@@ -165,7 +190,7 @@ def full_epoch_perm(data: DeviceDataset, shuffle_seed: int, epoch: int) -> np.nd
     ).astype(np.int32)
 
 
-def make_cached_train_step(model, learning_rate: float, data: DeviceDataset):
+def make_cached_train_step(model, learning_rate: float, data: DeviceDataset, body=None):
     """Returns jitted ``step(state, i) -> (state, data_loss)`` over the
     resident arrays — and ``step_shuffled(state, perm, i)`` whose batch
     rows come through a device-resident [rows] permutation.
@@ -181,18 +206,19 @@ def make_cached_train_step(model, learning_rate: float, data: DeviceDataset):
     """
     B = data.batch_size
     arrays = (data.labels, data.ids, data.vals, data.fields, data.weights)
+    body = body or train_step_body  # packed layout passes its own body
 
     @partial(jax.jit, donate_argnums=(0,))
     def _step(state: TrainState, arrs, i):
         sl = lambda a: lax.dynamic_slice_in_dim(a, i * B, B, axis=0)
         b = Batch(*map(sl, arrs))
-        return train_step_body(model, learning_rate, state, b)
+        return body(model, learning_rate, state, b)
 
     @partial(jax.jit, donate_argnums=(0,))
     def _step_shuffled(state: TrainState, arrs, perm, i):
         idx = lax.dynamic_slice_in_dim(perm, i * B, B)
         b = Batch(*(jnp.take(a, idx, axis=0) for a in arrs))
-        return train_step_body(model, learning_rate, state, b)
+        return body(model, learning_rate, state, b)
 
     def step(state, i):
         return _step(state, arrays, i)
@@ -201,3 +227,82 @@ def make_cached_train_step(model, learning_rate: float, data: DeviceDataset):
         return _step_shuffled(state, arrays, perm, i)
 
     return step, step_shuffled
+
+
+def load_sharded_device_dataset(
+    files,
+    *,
+    mesh,
+    batch_size: int,
+    vocabulary_size: int,
+    hash_feature_id: bool = False,
+    max_nnz: int | None = None,
+    weights=None,
+    with_fields: bool = True,
+) -> DeviceDataset:
+    """Device-resident dataset SHARDED over a ('data','row') mesh.
+
+    Layout is batch-major ``[batches, B, ...]`` with the BATCH dim sharded
+    over both mesh axes (P(None, ('data','row'))): every step's
+    ``dynamic_slice`` runs on the unsharded batches axis — trivially
+    SPMD-partitionable — and each chip holds exactly its micro-batch slice
+    of every batch, so per-chip HBM cost is total/n_devices.
+    Single-process meshes only (a multi-host resident dataset needs
+    per-process shard assembly — refused upstream).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from fast_tffm_tpu.parallel.mesh import DATA_AXIS, ROW_AXIS
+
+    host, batches, n_rows = _load_host_arrays(
+        files,
+        batch_size=batch_size,
+        vocabulary_size=vocabulary_size,
+        hash_feature_id=hash_feature_id,
+        max_nnz=max_nnz,
+        weights=weights,
+        with_fields=with_fields,
+    )
+
+    def shard(a):
+        # Upload straight from the host staging array to the mesh
+        # placement: each chip receives only its shard, so a dataset
+        # sized for AGGREGATE mesh HBM never has to fit one device.
+        bm = a.reshape((batches, batch_size) + a.shape[1:])
+        spec = P(None, (DATA_AXIS, ROW_AXIS), *([None] * (bm.ndim - 2)))
+        return jax.device_put(bm, NamedSharding(mesh, spec))
+
+    return DeviceDataset(
+        labels=shard(host["labels"]),
+        ids=shard(host["ids"]),
+        vals=shard(host["vals"]),
+        fields=shard(host["fields"]),
+        weights=shard(host["weights"]),
+        batches=batches,
+        batch_size=batch_size,
+        n_rows=n_rows,
+    )
+
+
+def make_cached_sharded_train_step(sharded_step, data: DeviceDataset):
+    """Wrap a ``make_sharded_train_step`` step so each call slices batch
+    ``i`` out of the mesh-sharded resident arrays on-device (sequential
+    order only — a shuffled gather across the sharded batch dim would be
+    per-step cross-chip traffic, exactly what this mode exists to avoid).
+
+    Same closure rule as the local cached step: resident arrays travel as
+    explicit jit arguments (embedded-constant cliff, DESIGN §6).
+    """
+    from fast_tffm_tpu.models.base import Batch as _Batch
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def _step(state, arrs, i):
+        sl = lambda a: lax.dynamic_slice_in_dim(a, i, 1, axis=0)[0]
+        return sharded_step(state, _Batch(*map(sl, arrs)))
+
+    arrays = (data.labels, data.ids, data.vals, data.fields, data.weights)
+
+    def step(state, i):
+        return _step(state, arrays, i)
+
+    return step

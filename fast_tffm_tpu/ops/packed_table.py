@@ -1,0 +1,175 @@
+"""Lane-packed embedding table: P logical [D] rows per 128-lane tile row.
+
+WHY (measured on this environment's chip, DESIGN §6 round-3 correction):
+a TPU f32 array is tiled (8, 128); a narrow embedding row (D = 1+k = 9
+for the flagship FM) occupies 9 of a tile row's 128 lanes, so every
+random-row scatter is a masked partial-lane read-modify-write — measured
+~104 ns/row (~0.35 GB/s payload), 7.5× slower than scattering full
+128-lane rows and ~70× slower than 1-D scatters.  The sparse Adagrad
+update, not compute, dominates the train step.
+
+The fix is physical layout, not a new algorithm: store the table as
+``[ceil(V/P), 128]`` with ``P = 128 // D`` logical rows packed per
+physical row (P=14 at D=9 → 126/128 lanes used).  Then:
+
+  * the LOOKUP gathers full 128-lane physical rows (measured ~271 GB/s
+    vs ~6 GB/s for narrow rows) and extracts each id's D-lane slice with
+    P static masked slices (dense VPU work);
+  * the UPDATE dedups ONCE at physical-row granularity *in lane space*:
+    per-occurrence grads are inserted into their slot lanes, sorted by
+    id (ids sorted ⇒ physical rows sorted), segment-summed at full 128
+    width, and applied with one wide gather + one wide scatter per
+    array.  Element-wise Adagrad with a zero gradient is the identity,
+    so writing whole 128-lane rows is EXACT — untouched neighbors in a
+    shared tile row read and write back their current values.
+
+Semantics are identical to the rows layout (same sums in the same
+order — test-pinned exactly); only bytes move differently.  Reference
+capability parity: this replaces the same TF sparse-Adagrad scatter the
+rows layout replaces (`renyi533/fast_tffm` :: graph builder's
+AdagradOptimizer sparse path); the layout itself has no reference analog
+because CPUs don't have lane tiles.
+
+Constraints: element-granularity accumulator (it packs identically and
+zero-grad identity makes whole-row RMW exact); D ≤ 64 so P ≥ 2.
+Checkpoints always store the LOGICAL [V, D] table (pack/unpack below),
+so packed and rows checkpoints are interchangeable.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "LANES",
+    "rows_per_tile",
+    "packed_rows",
+    "pack_table",
+    "pack_accum",
+    "unpack_table",
+    "packed_gather",
+    "packed_sparse_adagrad_update",
+]
+
+LANES = 128
+
+
+def rows_per_tile(d: int) -> int:
+    if d > LANES // 2:
+        raise ValueError(f"packed layout needs D <= {LANES // 2}, got {d}")
+    return LANES // d
+
+
+def packed_rows(vocab: int, d: int) -> int:
+    return -(-vocab // rows_per_tile(d))
+
+
+def pack_table(table: jax.Array) -> jax.Array:
+    """[V, D] logical -> [VP, 128] packed (pad lanes/rows zero)."""
+    v, d = table.shape
+    p = rows_per_tile(d)
+    vp = packed_rows(v, d)
+    flat = jnp.zeros((vp * p, d), table.dtype).at[:v].set(table)
+    packed = jnp.zeros((vp, LANES), table.dtype)
+    return packed.at[:, : p * d].set(flat.reshape(vp, p * d))
+
+
+def pack_accum(accum: jax.Array, init_value: float) -> jax.Array:
+    """pack_table for ACCUMULATORS: padding lanes/rows carry
+    ``init_value``, never zero — the whole-tile-row Adagrad RMW divides
+    by sqrt(acc), and a zero pad would turn 0/sqrt(0) into NaN the first
+    time a partially-used physical row updates."""
+    v, d = accum.shape
+    p = rows_per_tile(d)
+    vp = packed_rows(v, d)
+    flat = jnp.full((vp * p, d), init_value, accum.dtype).at[:v].set(accum)
+    packed = jnp.full((vp, LANES), init_value, accum.dtype)
+    return packed.at[:, : p * d].set(flat.reshape(vp, p * d))
+
+
+def unpack_table(packed: jax.Array, vocab: int, d: int) -> jax.Array:
+    """[VP, 128] packed -> [V, D] logical."""
+    p = rows_per_tile(d)
+    vp = packed.shape[0]
+    return packed[:, : p * d].reshape(vp * p, d)[:vocab]
+
+
+def packed_gather(packed: jax.Array, ids: jax.Array, d: int) -> jax.Array:
+    """rows[..., D] for logical ``ids`` from a packed table.
+
+    One wide gather of [M, 128] physical rows, then P static masked
+    slices sum into the [..., D] result (each id has exactly one live
+    slot, so the sum just selects)."""
+    p = rows_per_tile(d)
+    phys = ids // p
+    slot = ids % p
+    rows128 = packed[phys]  # [..., 128] full-tile-row gather
+    out = jnp.zeros(ids.shape + (d,), packed.dtype)
+    for s in range(p):
+        piece = rows128[..., s * d : (s + 1) * d]
+        out = out + jnp.where((slot == s)[..., None], piece, 0)
+    return out
+
+
+def packed_sparse_adagrad_update(
+    packed: jax.Array,
+    accum_packed: jax.Array,
+    ids: jax.Array,
+    row_grads: jax.Array,
+    lr: float,
+    vocab: int,
+):
+    """Sparse Adagrad on the packed table — one-pass lane-space dedup.
+
+    ids: [...] logical ids; row_grads: [..., D] per-occurrence grads.
+    Returns (packed, accum_packed).  Per-element semantics match
+    optim.sparse_adagrad_update with the element accumulator: every
+    element sees the occurrence-summed gradient exactly once
+    (duplicate ids land in the same lanes of the same physical segment
+    and sum there); untouched elements see gradient 0 — the Adagrad
+    identity — so whole-row writes are exact.
+    """
+    d = row_grads.shape[-1]
+    p = rows_per_tile(d)
+    vp = packed.shape[0]
+    flat_ids = ids.reshape(-1)
+    m = flat_ids.shape[0]
+    g = row_grads.reshape(m, d)
+
+    # Insert each occurrence's grad into its slot lanes: [M, 128].
+    slot = (flat_ids % p).astype(jnp.int32)
+    g128 = jnp.zeros((m, LANES), g.dtype)
+    for s in range(p):
+        g128 = g128.at[:, s * d : (s + 1) * d].add(
+            jnp.where((slot == s)[:, None], g, 0)
+        )
+
+    # Sort occurrences by id => physical rows grouped; WIDE permutation
+    # gather moves the [M, 128] payload (full-lane rows, fast path).
+    order = jnp.argsort(flat_ids)
+    sphys = (flat_ids[order] // p).astype(jnp.int32)
+    g128 = g128[order]
+
+    # Segment-sum per physical row at full width.
+    is_new = jnp.concatenate([jnp.ones((1,), bool), sphys[1:] != sphys[:-1]])
+    seg = jnp.cumsum(is_new) - 1
+    gsum = jax.ops.segment_sum(g128, seg, num_segments=m)  # [M, 128]
+    # Segment representative WITHOUT segment_max (measured ~9 ms as a 1-D
+    # scatter-max): every occurrence in a segment writes the SAME sphys
+    # value, so a plain scatter-set is correct regardless of which
+    # duplicate wins; unwritten slots keep the sentinel.
+    uphys = jnp.full((m,), vp, jnp.int32).at[seg].set(sphys)
+
+    # RMW: one wide gather + elementwise Adagrad + one wide scatter each.
+    # No validity masking needed: sentinel slots carry gsum == 0 (the
+    # Adagrad identity, new == cur) and their scatter drops anyway.
+    safe = jnp.minimum(uphys, vp - 1)
+    cur = packed[safe]
+    acc = accum_packed[safe]
+    acc2 = acc + gsum * gsum
+    new = cur - lr * gsum / jnp.sqrt(acc2)
+    packed = packed.at[uphys].set(new, mode="drop")
+    accum_packed = accum_packed.at[uphys].set(acc2, mode="drop")
+    return packed, accum_packed

@@ -99,10 +99,12 @@ def dedup_rows(ids: jax.Array, row_grads: jax.Array, num_rows: int):
     is_new = jnp.concatenate([jnp.ones((1,), bool), sid[1:] != sid[:-1]])
     seg = jnp.cumsum(is_new) - 1  # [M] segment index per occurrence
     gsum = jax.ops.segment_sum(sg, seg, num_segments=m)
-    uids = jax.ops.segment_max(sid, seg, num_segments=m)
-    n_unique = jnp.sum(is_new)
-    valid = jnp.arange(m) < n_unique
-    uids = jnp.where(valid, uids, num_rows)  # sentinel → dropped on scatter
+    # Segment representative via scatter-SET, not segment_max (measured
+    # ~9 ms slower as a 1-D scatter-max on this backend): every
+    # occurrence in a segment writes the SAME sid, so any duplicate
+    # winning is correct; unwritten trailing slots keep the sentinel
+    # ``num_rows`` (out of range → scattered with mode='drop').
+    uids = jnp.full((m,), num_rows, sid.dtype).at[seg].set(sid)
     return uids, gsum
 
 

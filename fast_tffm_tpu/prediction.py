@@ -121,8 +121,17 @@ def predict(cfg: Config, log=print) -> str:
         model, jax.random.key(0), cfg.init_accumulator_value, cfg.adagrad_accumulator
     )
     state = restore_checkpoint(cfg.model_file, state)
+    if cfg.table_layout == "packed":
+        # Checkpoints hold logical arrays; pack for the lane-packed
+        # scoring path (ops/packed_table.py).
+        from fast_tffm_tpu.trainer import make_packed_predict_step, pack_state
+
+        state = pack_state(state, cfg.init_accumulator_value)
+        step = make_packed_predict_step(model)
+    else:
+        step = make_predict_step(model)
     return _run_predict(
-        cfg, state, make_predict_step(model), max_nnz, log, with_fields=model.uses_fields
+        cfg, state, step, max_nnz, log, with_fields=model.uses_fields
     )
 
 

@@ -33,6 +33,11 @@ __all__ = [
     "train_step_body",
     "make_train_step",
     "make_predict_step",
+    "pack_state",
+    "init_packed_state",
+    "packed_train_step_body",
+    "make_packed_train_step",
+    "make_packed_predict_step",
 ]
 
 
@@ -122,6 +127,91 @@ def make_predict_step(model):
     @jax.jit
     def predict(state: TrainState, batch: Batch):
         rows = state.table[batch.ids]
+        return jax.nn.sigmoid(model.score(rows, state.dense, batch))
+
+    return predict
+
+
+# --- lane-packed table variants (ops/packed_table.py; DESIGN §6) ---------
+
+
+def pack_state(state: TrainState, init_accumulator_value: float = 0.1) -> TrainState:
+    """Lane-pack a LOGICAL TrainState (table via pack_table, accumulator
+    via pack_accum — padding lanes hold the init value so whole-tile-row
+    Adagrad never divides by sqrt(0)).  Shared by init, resume, and the
+    packed predict driver."""
+    from fast_tffm_tpu.ops.packed_table import pack_accum, pack_table
+
+    return state._replace(
+        table=pack_table(state.table),
+        table_opt=state.table_opt._replace(
+            accum=pack_accum(state.table_opt.accum, init_accumulator_value)
+        ),
+    )
+
+
+def init_packed_state(
+    model, key: jax.Array, init_accumulator_value: float = 0.1
+) -> TrainState:
+    """init_state with the table and (element) accumulator lane-packed.
+
+    The packed layout keeps the logical init EXACTLY (pack of the same
+    init_table draw), so packed and rows runs start from identical
+    parameters."""
+    return pack_state(
+        init_state(model, key, init_accumulator_value, "element"),
+        init_accumulator_value,
+    )
+
+
+def packed_train_step_body(model, learning_rate: float, state: TrainState, batch: Batch):
+    """train_step_body on a lane-packed table: identical math, tile-row
+    physical movement (the narrow-scatter cliff fix — DESIGN §6).
+    Shared by make_packed_train_step and the device-cache step."""
+    from fast_tffm_tpu.ops.packed_table import (
+        packed_gather,
+        packed_sparse_adagrad_update,
+    )
+
+    d = model.row_dim
+    rows = packed_gather(state.table, batch.ids, d)
+
+    grad_fn = jax.value_and_grad(
+        partial(batch_loss, model), argnums=(0, 1), has_aux=True
+    )
+    (_, data_loss), (g_rows, g_dense) = grad_fn(rows, state.dense, batch)
+
+    table, accum = packed_sparse_adagrad_update(
+        state.table, state.table_opt.accum, batch.ids, g_rows,
+        learning_rate, model.vocabulary_size,
+    )
+    dense, dense_opt = state.dense, state.dense_opt
+    if jax.tree.leaves(state.dense):
+        dense, dense_opt = dense_adagrad_update(
+            state.dense, state.dense_opt, g_dense, learning_rate
+        )
+    return (
+        TrainState(table, AdagradState(accum), dense, dense_opt, state.step + 1),
+        data_loss,
+    )
+
+
+def make_packed_train_step(model, learning_rate: float):
+    @partial(jax.jit, donate_argnums=(0,))
+    def step(state: TrainState, batch: Batch):
+        return packed_train_step_body(model, learning_rate, state, batch)
+
+    return step
+
+
+def make_packed_predict_step(model):
+    from fast_tffm_tpu.ops.packed_table import packed_gather
+
+    d = model.row_dim
+
+    @jax.jit
+    def predict(state: TrainState, batch: Batch):
+        rows = packed_gather(state.table, batch.ids, d)
         return jax.nn.sigmoid(model.score(rows, state.dense, batch))
 
     return predict

@@ -223,6 +223,7 @@ def _run_training(
     examples_per_step=None,
     evaluate=None,
     extra_metrics=None,
+    saveable=None,
 ):
     """Shared step loop.  ``train_stream(epoch)`` overrides the per-epoch
     input stream, ``to_batch(parsed, w)`` the host→device batch assembly,
@@ -230,7 +231,12 @@ def _run_training(
     sharded input + global-array stitching here without forking the loop.
     ``extra_metrics()`` (optional) is drained at every log point and its
     dict merged into the stdout line and the JSONL record (dist_train uses
-    it to report alltoall overflow-fallback step counts)."""
+    it to report alltoall overflow-fallback step counts).  ``saveable``
+    (optional) converts the live state to its checkpoint form before
+    every save — the packed table layout uses it to store LOGICAL [V, D]
+    arrays, keeping packed and rows checkpoints interchangeable."""
+    if saveable is None:
+        saveable = lambda st: st
     if train_stream is None:
         train_stream = lambda epoch: _stream(
             cfg, cfg.train_files, max_nnz, epochs=1, to_batch=to_batch,
@@ -337,7 +343,7 @@ def _run_training(
                 log(f"epoch {epoch} validation auc {val_auc:.5f}")
                 metrics.log(step=int(state.step), epoch=epoch, validation_auc=round(val_auc, 6))
             if cfg.save_every_epochs and (epoch + 1) % cfg.save_every_epochs == 0:
-                save_checkpoint(cfg.model_file, state, ckpt_format)
+                save_checkpoint(cfg.model_file, saveable(state), ckpt_format)
                 log(f"epoch {epoch} checkpoint -> {cfg.model_file}")
     finally:
         if extra_metrics is not None:
@@ -354,7 +360,7 @@ def _run_training(
                 signal.signal(sig, handler)
             except (ValueError, TypeError):
                 pass
-    save_checkpoint(cfg.model_file, state, ckpt_format)
+    save_checkpoint(cfg.model_file, saveable(state), ckpt_format)
     if stop_requested.is_set():
         log(
             f"stopped on signal at step {int(state.step)}, model -> {cfg.model_file} "
@@ -379,28 +385,69 @@ def train(cfg: Config, *, resume: bool = False, log=print):
         )
     model = build_model(cfg)
     max_nnz = scan_max_nnz(cfg)
-    state = init_state(
-        model, jax.random.key(0), cfg.init_accumulator_value, cfg.adagrad_accumulator
-    )
-    if resume:
-        state = restore_checkpoint(cfg.model_file, state)
-        log(f"resumed from {cfg.model_file} at step {int(state.step)}")
-    predict_step = make_predict_step(model)
+    packed = cfg.table_layout == "packed"
+    saveable = None
+    if packed:
+        from fast_tffm_tpu.ops.packed_table import unpack_table
+        from fast_tffm_tpu.trainer import (
+            init_packed_state,
+            make_packed_predict_step,
+            make_packed_train_step,
+            packed_train_step_body,
+        )
+
+        state = init_packed_state(model, jax.random.key(0), cfg.init_accumulator_value)
+        v, d = model.vocabulary_size, model.row_dim
+
+        def saveable(st):
+            # Checkpoints always hold the LOGICAL [V, D] arrays, so packed
+            # and rows runs restore each other's models freely.
+            return st._replace(
+                table=unpack_table(st.table, v, d),
+                table_opt=st.table_opt._replace(
+                    accum=unpack_table(st.table_opt.accum, v, d)
+                ),
+            )
+
+        if resume:
+            from fast_tffm_tpu.trainer import pack_state
+
+            logical = restore_checkpoint(
+                cfg.model_file,
+                init_state(model, jax.random.key(0), cfg.init_accumulator_value),
+            )
+            state = pack_state(logical, cfg.init_accumulator_value)
+            log(f"resumed from {cfg.model_file} at step {int(state.step)} (packed)")
+        predict_step = make_packed_predict_step(model)
+        step_body = packed_train_step_body
+        step_fn = make_packed_train_step(model, cfg.learning_rate)
+    else:
+        state = init_state(
+            model, jax.random.key(0), cfg.init_accumulator_value, cfg.adagrad_accumulator
+        )
+        if resume:
+            state = restore_checkpoint(cfg.model_file, state)
+            log(f"resumed from {cfg.model_file} at step {int(state.step)}")
+        predict_step = make_predict_step(model)
+        step_body = None
+        step_fn = make_train_step(model, cfg.learning_rate)
     to_batch = lambda parsed, w: Batch.from_parsed(parsed, w, with_fields=model.uses_fields)
     if cfg.device_cache:
         step_fn, train_stream, examples_per_step = _device_cached_input(
-            cfg, model, max_nnz, log
+            cfg, model, max_nnz, log, body=step_body
         )
         return _run_training(
             cfg, state, step_fn, predict_step, max_nnz, log,
             train_stream=train_stream, to_batch=to_batch,
-            examples_per_step=examples_per_step,
+            examples_per_step=examples_per_step, saveable=saveable,
         )
-    step_fn = make_train_step(model, cfg.learning_rate)
-    return _run_training(cfg, state, step_fn, predict_step, max_nnz, log, to_batch=to_batch)
+    return _run_training(
+        cfg, state, step_fn, predict_step, max_nnz, log, to_batch=to_batch,
+        saveable=saveable,
+    )
 
 
-def _device_cached_input(cfg: Config, model, max_nnz: int, log):
+def _device_cached_input(cfg: Config, model, max_nnz: int, log, body=None):
     """device_cache = true: the train set becomes device-resident arrays
     sliced on-chip per step — zero per-step host→device bytes (the
     streamed alternative moves every batch through the host every epoch;
@@ -447,7 +494,7 @@ def _device_cached_input(cfg: Config, model, max_nnz: int, log):
         f"({data.nbytes / 2**20:.1f} MiB, {data.batches} batches/epoch)"
     )
     cached_step, cached_step_shuffled = make_cached_train_step(
-        model, cfg.learning_rate, data
+        model, cfg.learning_rate, data, body=body
     )
     # Batch indices as pre-placed device scalars: the per-step "input" is
     # an index that is already on device — no per-step H2D at all.
@@ -503,15 +550,30 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
             f"weight_files has {len(cfg.weight_files)} entries for "
             f"{len(cfg.train_files)} train_files (they align per-file)"
         )
-    if cfg.device_cache:
-        # Silent fallback to host streaming would defeat the whole point
-        # of the flag (the ~300x feed gap it exists to close) — refuse
-        # loudly until the sharded resident path exists.
+    if cfg.table_layout == "packed":
         raise ValueError(
-            "device_cache = true is a local-train feature for now; "
-            "dist_train streams batches (drop the flag, or run `train`)"
+            "table_layout = packed is local train/predict only for now; "
+            "dist_train keeps the rows layout (drop the key, or run `train`)"
         )
     maybe_initialize_distributed(cfg.coordinator_address, cfg.num_processes, cfg.process_id)
+    if cfg.device_cache and jax.process_count() > 1:
+        # Silent fallback to host streaming would defeat the whole point
+        # of the flag (the ~300x feed gap it exists to close) — refuse
+        # loudly; the multi-host resident path needs per-process shard
+        # assembly and does not exist yet.
+        raise ValueError(
+            "device_cache = true supports single-process meshes only for "
+            "now (drop the flag on multi-host runs)"
+        )
+    if cfg.device_cache and cfg.shuffle:
+        # A shuffled gather across the mesh-sharded batch dim would move
+        # rows between chips every step — exactly the per-step traffic
+        # this mode exists to eliminate.  (Local `train` shuffles fine.)
+        raise ValueError(
+            "device_cache with shuffle is local-train only; dist_train "
+            "slices the resident epoch sequentially (drop shuffle, or "
+            "pre-shuffle at convert time)"
+        )
     model = build_model(cfg)
     max_nnz = scan_max_nnz(cfg)
     if mesh is None:
@@ -536,6 +598,52 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
         overflow_mode=cfg.lookup_overflow,
     )
 
+    cached_data = None
+    if cfg.device_cache:
+        # Mesh-sharded resident dataset: same zero-per-step-H2D contract
+        # as the local path, with each batch's rows sharded over every
+        # chip and the slice fused into the SPMD step.  Wraps the RAW
+        # jitted step (the slice traces inside jit); the overflow
+        # accumulator below then wraps at the Python level as usual.
+        from fast_tffm_tpu.data.device_cache import (
+            load_sharded_device_dataset,
+            make_cached_sharded_train_step,
+        )
+
+        files = tuple(cfg.train_files)
+        if cfg.binary_cache:
+            from fast_tffm_tpu.data.binary import ensure_fmb_cache
+
+            files = ensure_fmb_cache(
+                files,
+                vocabulary_size=cfg.vocabulary_size,
+                hash_feature_id=cfg.hash_feature_id,
+                max_nnz=max_nnz,
+                parser=best_parser(cfg.thread_num),
+            )
+        if not binary_input(files):
+            raise ValueError(
+                "device_cache = true needs FMB-backed input: list .fmb "
+                "files in train_files, or set binary_cache = true"
+            )
+        cached_data = load_sharded_device_dataset(
+            files,
+            mesh=mesh,
+            batch_size=cfg.batch_size,
+            vocabulary_size=cfg.vocabulary_size,
+            hash_feature_id=cfg.hash_feature_id,
+            max_nnz=max_nnz,
+            weights=cfg.weight_files if cfg.weight_files else None,
+            with_fields=model.uses_fields,
+        )
+        log(
+            f"device cache: {cached_data.n_rows} rows resident, sharded "
+            f"over {mesh.devices.size} devices "
+            f"({cached_data.nbytes / 2**20:.1f} MiB total, "
+            f"{cached_data.batches} batches/epoch)"
+        )
+        step_fn = make_cached_sharded_train_step(step_fn, cached_data)
+
     extra_metrics = None
     if cfg.lookup == "alltoall" and cfg.lookup_overflow == "fallback":
         # The fallback step returns a replicated overflow flag; fold it into
@@ -559,6 +667,14 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
 
     train_stream = examples_per_step = evaluate = None
     to_batch = lambda parsed, w: Batch.from_parsed(parsed, w, with_fields=model.uses_fields)
+    if cached_data is not None:
+        # Per-step "input" is a pre-placed device index scalar.
+        idx = [jax.device_put(np.int32(i)) for i in range(cached_data.batches)]
+
+        def train_stream(epoch):
+            return ((idx[i], None, None) for i in range(cached_data.batches))
+
+        examples_per_step = cfg.batch_size
     nproc = jax.process_count()
     if nproc > 1:
         from fast_tffm_tpu.data.native import count_lines

@@ -168,10 +168,56 @@ def test_device_cache_zero_per_step_transfers(tmp_path, fmb_files):
     assert np.isfinite(float(loss))
 
 
-def test_device_cache_dist_train_refuses(tmp_path, fmb_files):
-    """dist_train must refuse device_cache loudly, never silently stream."""
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs the 8-device CPU mesh")
+def test_device_cache_dist_train_bit_identical(tmp_path, fmb_files):
+    """The mesh-sharded resident path (dist_train + device_cache) must be
+    bit-identical to streamed dist_train: same batches, sharded over the
+    mesh, slice fused into the SPMD step."""
     from fast_tffm_tpu.training import dist_train
 
-    cfg = _cfg(tmp_path, fmb_files, "dist", device_cache=True)
-    with pytest.raises(ValueError, match="local-train"):
+    cfg_s = _cfg(tmp_path, fmb_files, "dstream", row_parallel=4, data_parallel=2)
+    st_stream = dist_train(cfg_s, log=lambda *_: None)
+    cfg_c = _cfg(
+        tmp_path, fmb_files, "dcache", row_parallel=4, data_parallel=2,
+        device_cache=True,
+    )
+    st_cache = dist_train(cfg_c, log=lambda *_: None)
+    assert _losses(cfg_s.metrics_path) == _losses(cfg_c.metrics_path)
+    np.testing.assert_array_equal(
+        np.asarray(st_stream.table), np.asarray(st_cache.table)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(st_stream.table_opt.accum), np.asarray(st_cache.table_opt.accum)
+    )
+    # And the resident arrays really shard over the mesh (not replicated).
+    from fast_tffm_tpu.data.device_cache import load_sharded_device_dataset
+    from fast_tffm_tpu.parallel import make_mesh
+
+    mesh = make_mesh(2, 4)
+    data = load_sharded_device_dataset(
+        fmb_files, mesh=mesh, batch_size=32, vocabulary_size=200, max_nnz=8
+    )
+    assert len(data.ids.addressable_shards) == 8
+    assert data.ids.addressable_shards[0].data.shape == (data.batches, 4, 8)
+
+
+def test_device_cache_dist_train_refuses_shuffle(tmp_path, fmb_files):
+    """dist_train + device_cache + shuffle would gather rows across chips
+    every step — refuse loudly."""
+    from fast_tffm_tpu.training import dist_train
+
+    cfg = _cfg(tmp_path, fmb_files, "dshuf", device_cache=True, shuffle=True)
+    with pytest.raises(ValueError, match="shuffle"):
         dist_train(cfg, log=lambda *_: None)
+
+
+def test_device_cache_with_packed_layout(tmp_path, fmb_files):
+    """device_cache composes with table_layout=packed: the cached step
+    runs the packed body and matches the streamed packed run exactly."""
+    kw = dict(table_layout="packed")
+    st_stream, l_stream = _run(tmp_path, fmb_files, "pstream", **kw)
+    st_cache, l_cache = _run(tmp_path, fmb_files, "pcache", device_cache=True, **kw)
+    assert l_stream == l_cache
+    np.testing.assert_array_equal(
+        np.asarray(st_stream.table), np.asarray(st_cache.table)
+    )

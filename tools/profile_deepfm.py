@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Profile the DeepFM (BASELINE config #4) step: where does the time go?
+
+VERDICT r2 #7: cfg4 is the one BASELINE config where dense MXU work
+(3×400 MLP) dominates, and no trace evidence existed that the matmuls are
+near roofline.  This traces a few steps (f32 and bf16 compute_dtype),
+aggregates device-op durations, and reports the MLP matmul share plus the
+implied MXU utilization for the [B, N·k]×[N·k, 400] chain.
+
+Prints one JSON object; run on the real chip.  Results land in DESIGN §6.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import _bench_watchdog
+
+_watchdog = _bench_watchdog.arm(seconds=1200, what="profile_deepfm.py")
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from fast_tffm_tpu.models import Batch, DeepFMModel  # noqa: E402
+from fast_tffm_tpu.trainer import init_state, make_train_step  # noqa: E402
+from tools.roofline import trace_steps, window  # noqa: E402
+
+VOCAB = 1 << 20
+FIELDS = 39
+K = 8
+BATCH = 16384
+HIDDEN = (400, 400, 400)
+
+
+def mlp_flops_per_step():
+    """Forward+backward matmul FLOPs for the MLP chain per step."""
+    dims = [FIELDS * K, *HIDDEN, 1]
+    fwd = sum(2 * BATCH * a * b for a, b in zip(dims[:-1], dims[1:]))
+    return 3 * fwd  # bwd ~2x fwd for matmuls
+
+
+def make_batch(rng, i):
+    return Batch(
+        labels=np.asarray(rng.integers(0, 2, BATCH), np.float32),
+        ids=np.asarray(rng.integers(0, VOCAB, (BATCH, FIELDS)), np.int32),
+        vals=np.abs(rng.normal(size=(BATCH, FIELDS)).astype(np.float32)) + 0.1,
+        fields=np.tile(np.arange(FIELDS, dtype=np.int32), (BATCH, 1)),
+        weights=np.ones(BATCH, np.float32),
+    )
+
+
+def run(compute_dtype):
+    model = DeepFMModel(
+        vocabulary_size=VOCAB, num_fields=FIELDS, factor_num=K,
+        hidden_dims=HIDDEN, compute_dtype=compute_dtype,
+    )
+    step = make_train_step(model, 0.01)
+    rng = np.random.default_rng(0)
+    batches = [make_batch(rng, i) for i in range(8)]
+    state = init_state(model, jax.random.key(0))
+    state, us0 = window(step, state, batches, iters=5)  # compile+warm
+    state, us = window(step, state, batches, iters=30)
+    state, prof = trace_steps(f"deepfm_{compute_dtype}", step, state, batches)
+    # Classify device ops: matmul/MXU vs rest.
+    mm_us = sum(
+        o["us_per_step"] for o in prof["ops"]
+        if any(t in o["op"] for t in ("dot", "conv", "matmul", "fusion"))
+        and any(t in o["op"] for t in ("dot", "matmul"))
+    )
+    flops = mlp_flops_per_step()
+    peak = {"float32": 98.3e12 / 2, "bfloat16": 394e12 / 2}[compute_dtype]
+    # v5e: 394 TFLOP/s bf16, ~1/4 for f32; /2 above is a conservative
+    # de-rate for the small inner dims (312..400) vs the 128x128 MXU tile.
+    return {
+        "us_per_step_wall": round(us, 1),
+        "examples_per_sec": round(BATCH / us * 1e6, 1),
+        "device_profile": prof,
+        "mlp_matmul_us_per_step": round(mm_us, 1),
+        "mlp_matmul_share": round(
+            mm_us / max(prof["per_step_device_us"], 1e-9), 3
+        ),
+        "mlp_flops_per_step": flops,
+        "mfu_vs_derated_peak": round(flops / (mm_us * 1e-6) / peak, 3)
+        if mm_us else None,
+    }
+
+
+def main():
+    out = {"batch": BATCH, "fields": FIELDS, "k": K, "hidden": HIDDEN}
+    for dt in ("float32", "bfloat16"):
+        out[dt] = run(dt)
+    out["device"] = str(jax.devices()[0])
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()
