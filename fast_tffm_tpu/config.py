@@ -30,8 +30,8 @@ class Config:
     vocabulary_block_num: int = 1  # reference key; default row_parallel
     hash_feature_id: bool = False
     table_layout: str = "rows"  # rows ([V,D]) | packed (lane-packed [V/P,128]
-    #   tile rows — fixes the partial-lane scatter cliff, DESIGN §6; local
-    #   train/predict, element accumulator)
+    #   tile rows — fixes the partial-lane scatter cliff, DESIGN §6; element
+    #   accumulator + allgather lookup; dist shards it, single-process meshes)
     model_file: str = "model.ckpt"
     checkpoint_format: str = "npz"  # npz | orbax (orbax = sharded, pod-scale)
     # [Train]

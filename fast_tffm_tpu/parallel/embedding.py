@@ -33,7 +33,13 @@ from jax import lax
 from fast_tffm_tpu.optim import AdagradState, dedup_rows
 from fast_tffm_tpu.parallel.mesh import DATA_AXIS, ROW_AXIS
 
-__all__ = ["sharded_gather", "sharded_sparse_adagrad_update", "apply_shard_adagrad"]
+__all__ = [
+    "sharded_gather",
+    "sharded_sparse_adagrad_update",
+    "apply_shard_adagrad",
+    "packed_sharded_gather",
+    "packed_sharded_update",
+]
 
 
 def apply_shard_adagrad(table_shard, accum_shard, guids, ggsum, lr, base):
@@ -104,3 +110,66 @@ def sharded_sparse_adagrad_update(
 
     base = lax.axis_index(ROW_AXIS) * table_shard.shape[0]
     return apply_shard_adagrad(table_shard, accum_shard, guids, ggsum, lr, base)
+
+
+# --- lane-packed shard variants (ops/packed_table.py; DESIGN §6) ---------
+#
+# Same collectives, tile-aligned physical movement: the shard serves its
+# rows from a lane-packed [VPs, 128] shard (wide gather + static slice
+# extraction) and applies the update with one wide RMW per array instead
+# of narrow partial-lane scatters.  Requires the shard's LOGICAL row count
+# to be a multiple of rows_per_tile(D) (the padded-vocab helper in
+# train_step guarantees it), so per-shard packing equals a row-block of
+# the globally packed table and checkpoints stay layout-independent.
+
+
+def packed_sharded_gather(
+    packed_shard: jax.Array, ids: jax.Array, d: int, shard_logical_rows: int
+) -> jax.Array:
+    """sharded_gather on a lane-packed shard: [B_local, N, D] rows."""
+    from fast_tffm_tpu.ops.packed_table import packed_gather
+
+    base = lax.axis_index(ROW_AXIS) * shard_logical_rows
+    all_ids = lax.all_gather(ids, ROW_AXIS, tiled=True)  # [R*B_local, N]
+    local = all_ids - base
+    owned = (local >= 0) & (local < shard_logical_rows)
+    local = jnp.where(owned, local, 0)
+    rows = packed_gather(packed_shard, local, d)
+    rows = rows * owned[..., None].astype(rows.dtype)
+    return lax.psum_scatter(rows, ROW_AXIS, scatter_dimension=0, tiled=True)
+
+
+def packed_sharded_update(
+    packed_shard: jax.Array,
+    accum_shard: jax.Array,
+    ids: jax.Array,
+    row_grads: jax.Array,
+    lr: float,
+    num_rows_global: int,
+    shard_logical_rows: int,
+):
+    """sharded_sparse_adagrad_update on a lane-packed shard.
+
+    Local dedup + the same two-axis all_gather combine; the second dedup
+    is SUBSUMED by the packed update's lane-space segment-sum (duplicate
+    logical ids land in the same lanes of the same physical segment and
+    sum there before the single RMW — Adagrad still sees the fully
+    summed gradient exactly once per element).  Unowned and sentinel ids
+    map past the last physical row and drop on scatter.
+    """
+    from fast_tffm_tpu.ops.packed_table import packed_sparse_adagrad_update, rows_per_tile
+
+    D = row_grads.shape[-1]
+    p = rows_per_tile(D)
+    uids, gsum = dedup_rows(ids.reshape(-1), row_grads.reshape(-1, D), num_rows_global)
+    all_uids = lax.all_gather(uids, (DATA_AXIS, ROW_AXIS), tiled=True)
+    all_gsum = lax.all_gather(gsum, (DATA_AXIS, ROW_AXIS), tiled=True)
+
+    base = lax.axis_index(ROW_AXIS) * shard_logical_rows
+    local = all_uids - base
+    owned = (local >= 0) & (local < shard_logical_rows)
+    # Past-the-end sentinel: phys = vp -> dropped by the packed scatter.
+    local = jnp.where(owned, local, packed_shard.shape[0] * p)
+    return packed_sparse_adagrad_update(
+        packed_shard, accum_shard, local, all_gsum, lr, shard_logical_rows
+    )

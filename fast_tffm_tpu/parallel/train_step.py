@@ -43,6 +43,9 @@ from fast_tffm_tpu.trainer import TrainState, init_state
 
 __all__ = [
     "init_sharded_state",
+    "packed_shard_meta",
+    "pack_logical_to_sharded",
+    "unpack_sharded_to_logical",
     "make_sharded_train_step",
     "make_sharded_predict_step",
     "make_global_batch",
@@ -105,11 +108,16 @@ def _batch_specs() -> Batch:
     )
 
 
-def _pad_model_vocab(model, mesh: Mesh):
-    """Round the table up so ROW_AXIS shards are equal (padded rows inert)."""
+def _pad_model_vocab(model, mesh: Mesh, pack: int = 1):
+    """Round the table up so ROW_AXIS shards are equal (padded rows inert).
+
+    ``pack`` > 1 additionally rounds each shard to a multiple of the
+    lane-packing factor rows_per_tile(D), so per-shard packing equals a
+    row-block of the globally packed table (checkpoints stay layout-free
+    and the packed shard's physical rows divide exactly)."""
     import dataclasses
 
-    rows = mesh.shape[ROW_AXIS]
+    rows = mesh.shape[ROW_AXIS] * pack
     padded = pad_vocab(model.vocabulary_size, rows)
     if padded == model.vocabulary_size:
         return model
@@ -118,11 +126,31 @@ def _pad_model_vocab(model, mesh: Mesh):
 
 def init_sharded_state(
     model, mesh: Mesh, key, init_accumulator_value: float = 0.1,
-    accumulator: str = "element",
+    accumulator: str = "element", table_layout: str = "rows",
 ):
-    """init_state placed with row-sharded table and replicated dense params."""
-    model = _pad_model_vocab(model, mesh)
-    state = init_state(model, key, init_accumulator_value, accumulator)
+    """init_state placed with row-sharded table and replicated dense params.
+
+    ``table_layout='packed'`` stores the shards lane-packed
+    ([VP_shard, 128] each — ops/packed_table.py); the shard-aligned vocab
+    padding makes the global packed array exactly the concatenation of the
+    per-shard packings."""
+    if table_layout == "packed":
+        from fast_tffm_tpu.ops.packed_table import (
+            pack_accum,
+            pack_table,
+            rows_per_tile,
+        )
+
+        if accumulator != "element":
+            raise ValueError("table_layout=packed requires the element accumulator")
+        model = _pad_model_vocab(model, mesh, pack=rows_per_tile(model.row_dim))
+        state = init_state(model, key, init_accumulator_value, "element")
+        table = pack_table(state.table)
+        accum = pack_accum(state.table_opt.accum, init_accumulator_value)
+        state = TrainState(table, AdagradState(accum), state.dense, state.dense_opt, state.step)
+    else:
+        model = _pad_model_vocab(model, mesh)
+        state = init_state(model, key, init_accumulator_value, accumulator)
     ts = table_sharding(mesh)
     rep = replicated(mesh)
     return TrainState(
@@ -131,6 +159,77 @@ def init_sharded_state(
         dense=jax.tree.map(lambda x: jax.device_put(x, rep), state.dense),
         dense_opt=jax.tree.map(lambda x: jax.device_put(x, rep), state.dense_opt),
         step=jax.device_put(state.step, rep),
+    )
+
+
+def packed_shard_meta(model, mesh: Mesh):
+    """(padded_model, shard_logical_rows, rows_per_tile) for the packed
+    sharded layout — the one place its padding arithmetic lives."""
+    from fast_tffm_tpu.ops.packed_table import rows_per_tile
+
+    p = rows_per_tile(model.row_dim)
+    padded = _pad_model_vocab(model, mesh, pack=p)
+    return padded, padded.vocabulary_size // mesh.shape[ROW_AXIS], p
+
+
+def pack_logical_to_sharded(
+    logical: TrainState, model, mesh: Mesh, init_accumulator_value: float = 0.1
+) -> TrainState:
+    """LOGICAL [V, D] state (e.g. a restored checkpoint) -> lane-packed
+    row-sharded state on ``mesh``.  Extends to the packed-aligned vocab
+    (padding rows inert: zero table, init accumulator) and packs GLOBALLY
+    — shard-aligned padding makes that identical to per-shard packing.
+    Shared by dist_train's packed resume and dist_predict's packed path."""
+    import numpy as np
+
+    from fast_tffm_tpu.ops.packed_table import pack_accum, pack_table
+
+    padded, _, _ = packed_shard_meta(model, mesh)
+    d = model.row_dim
+    vp_logical = padded.vocabulary_size
+    lt = np.asarray(logical.table)
+    la = np.asarray(logical.table_opt.accum)
+    ext_t = np.zeros((vp_logical, d), lt.dtype)
+    ext_t[: lt.shape[0]] = lt
+    ext_a = np.full((vp_logical, d), init_accumulator_value, la.dtype)
+    ext_a[: la.shape[0]] = la
+    ts = table_sharding(mesh)
+    rep = replicated(mesh)
+    return TrainState(
+        table=jax.device_put(pack_table(jnp.asarray(ext_t)), ts),
+        table_opt=AdagradState(
+            jax.device_put(
+                pack_accum(jnp.asarray(ext_a), init_accumulator_value), ts
+            )
+        ),
+        dense=jax.tree.map(lambda x: jax.device_put(x, rep), logical.dense),
+        dense_opt=jax.tree.map(lambda x: jax.device_put(x, rep), logical.dense_opt),
+        step=jax.device_put(logical.step, rep),
+    )
+
+
+def unpack_sharded_to_logical(state: TrainState, model, mesh: Mesh) -> TrainState:
+    """Lane-packed row-sharded state -> host LOGICAL [V, D] arrays
+    (per-shard unpack; checkpoints always hold the logical layout)."""
+    import numpy as np
+
+    from fast_tffm_tpu.ops.packed_table import unpack_table
+
+    _, shard_logical, p = packed_shard_meta(model, mesh)
+    R = mesh.shape[ROW_AXIS]
+    d = model.row_dim
+
+    def unp(arr):
+        a = np.asarray(arr)
+        per = a.shape[0] // R
+        return np.concatenate([
+            np.asarray(unpack_table(jnp.asarray(a[r * per : (r + 1) * per]), shard_logical, d))
+            for r in range(R)
+        ])
+
+    return state._replace(
+        table=unp(state.table),
+        table_opt=state.table_opt._replace(accum=unp(state.table_opt.accum)),
     )
 
 
@@ -160,6 +259,7 @@ def _make_gather(mesh: Mesh, local_ids_shape, lookup: str, capacity_factor: floa
 def make_sharded_train_step(
     model, learning_rate: float, mesh: Mesh, *, lookup: str = "allgather",
     capacity_factor: float = 2.0, overflow_mode: str = "abort",
+    table_layout: str = "rows",
 ):
     """Returns jitted SPMD ``step(state, batch) -> (state, global mean loss)``.
 
@@ -186,8 +286,18 @@ def make_sharded_train_step(
     ``(state, loss)`` return signature unless they opt into the flagged
     3-tuple.
     """
-    model = _pad_model_vocab(model, mesh)
+    packed = table_layout == "packed"
+    if packed:
+        if lookup != "allgather":
+            raise ValueError("table_layout=packed supports lookup=allgather only")
+        from fast_tffm_tpu.ops.packed_table import rows_per_tile
+
+        model = _pad_model_vocab(model, mesh, pack=rows_per_tile(model.row_dim))
+    else:
+        model = _pad_model_vocab(model, mesh)
     num_rows_global = model.vocabulary_size
+    shard_logical_rows = num_rows_global // mesh.shape[ROW_AXIS]
+    d_row = model.row_dim
     if overflow_mode not in ("abort", "fallback"):
         raise ValueError(f"unknown overflow_mode {overflow_mode!r} (abort | fallback)")
     fallback = lookup == "alltoall" and overflow_mode == "fallback"
@@ -215,6 +325,21 @@ def make_sharded_train_step(
         grad_fn = jax.value_and_grad(loss_fn, argnums=(0, 1), has_aux=True)
 
         def allgather_branch():
+            if packed:
+                from fast_tffm_tpu.parallel.embedding import (
+                    packed_sharded_gather,
+                    packed_sharded_update,
+                )
+
+                rows = packed_sharded_gather(
+                    table, batch.ids, d_row, shard_logical_rows
+                )
+                (_, dl), (g_rows, g_dense) = grad_fn(rows, dense)
+                t2, a2 = packed_sharded_update(
+                    table, accum, batch.ids, g_rows, learning_rate,
+                    num_rows_global, shard_logical_rows,
+                )
+                return t2, a2, g_dense, dl
             rows = sharded_gather(table, batch.ids)
             (_, dl), (g_rows, g_dense) = grad_fn(rows, dense)
             t2, a2 = sharded_sparse_adagrad_update(
@@ -296,14 +421,24 @@ def make_sharded_train_step(
 
 def make_sharded_predict_step(
     model, mesh: Mesh, *, lookup: str = "allgather", capacity_factor: float = 2.0,
-    overflow_mode: str = "abort",
+    overflow_mode: str = "abort", table_layout: str = "rows",
 ):
     """Returns jitted SPMD ``predict(state, batch) -> sigmoid scores [B]``.
 
     ``overflow_mode='fallback'`` (alltoall only) reruns an overflowing
     batch's lookup through the allgather collective instead of NaN-ing the
     scores — same ``lax.cond`` scheme as the train step."""
-    model = _pad_model_vocab(model, mesh)
+    packed = table_layout == "packed"
+    if packed:
+        if lookup != "allgather":
+            raise ValueError("table_layout=packed supports lookup=allgather only")
+        from fast_tffm_tpu.ops.packed_table import rows_per_tile
+
+        model = _pad_model_vocab(model, mesh, pack=rows_per_tile(model.row_dim))
+    else:
+        model = _pad_model_vocab(model, mesh)
+    shard_logical_rows = model.vocabulary_size // mesh.shape[ROW_AXIS]
+    d_row = model.row_dim
     fallback = lookup == "alltoall" and overflow_mode == "fallback"
 
     def shard_body(table, dense, batch: Batch):
@@ -318,6 +453,10 @@ def make_sharded_predict_step(
                 lambda: sharded_gather(table, batch.ids),
                 lambda: gather(table, batch.ids),
             )
+        elif packed:
+            from fast_tffm_tpu.parallel.embedding import packed_sharded_gather
+
+            rows = packed_sharded_gather(table, batch.ids, d_row, shard_logical_rows)
         else:
             rows = gather(table, batch.ids)
         scores = jax.nn.sigmoid(model.score(rows, dense, batch))

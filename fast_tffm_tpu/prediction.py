@@ -157,13 +157,26 @@ def dist_predict(cfg: Config, log=print, mesh=None) -> str:
         model, mesh, jax.random.key(0), cfg.init_accumulator_value, cfg.adagrad_accumulator
     )
     state = restore_checkpoint(cfg.model_file, state)
+    if cfg.table_layout == "packed":
+        # Checkpoints hold logical arrays; convert to the lane-packed
+        # sharded layout so scoring runs the packed lookup.
+        from fast_tffm_tpu.parallel import pack_logical_to_sharded
+
+        if jax.process_count() > 1:
+            raise ValueError(
+                "table_layout = packed supports single-process meshes only "
+                "for now (drop the key on multi-host runs)"
+            )
+        state = pack_logical_to_sharded(
+            state, model, mesh, cfg.init_accumulator_value
+        )
     return _run_predict(
         cfg,
         state,
         make_sharded_predict_step(
             model, mesh, lookup=cfg.lookup,
             capacity_factor=cfg.lookup_capacity_factor,
-            overflow_mode=cfg.lookup_overflow,
+            overflow_mode=cfg.lookup_overflow, table_layout=cfg.table_layout,
         ),
         max_nnz,
         log,

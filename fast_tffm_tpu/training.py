@@ -550,12 +550,15 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
             f"weight_files has {len(cfg.weight_files)} entries for "
             f"{len(cfg.train_files)} train_files (they align per-file)"
         )
-    if cfg.table_layout == "packed":
-        raise ValueError(
-            "table_layout = packed is local train/predict only for now; "
-            "dist_train keeps the rows layout (drop the key, or run `train`)"
-        )
     maybe_initialize_distributed(cfg.coordinator_address, cfg.num_processes, cfg.process_id)
+    if cfg.table_layout == "packed" and jax.process_count() > 1:
+        # Single-process meshes shard the packed table fine; the
+        # multi-host path needs per-process logical<->packed checkpoint
+        # assembly that does not exist yet — refuse loudly.
+        raise ValueError(
+            "table_layout = packed supports single-process meshes only for "
+            "now (drop the key on multi-host runs)"
+        )
     if cfg.device_cache and jax.process_count() > 1:
         # Silent fallback to host streaming would defeat the whole point
         # of the flag (the ~300x feed gap it exists to close) — refuse
@@ -564,6 +567,11 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
         raise ValueError(
             "device_cache = true supports single-process meshes only for "
             "now (drop the flag on multi-host runs)"
+        )
+    if cfg.device_cache and cfg.table_layout == "packed":
+        raise ValueError(
+            "device_cache + table_layout=packed on dist_train is not "
+            "supported yet (use one or the other)"
         )
     if cfg.device_cache and cfg.shuffle:
         # A shuffled gather across the mesh-sharded batch dim would move
@@ -582,21 +590,45 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
         mesh = make_mesh(data, row)
     log(f"mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))} on {mesh.devices.size} devices")
     check_batch_divides(cfg.batch_size, mesh)
-    state = init_sharded_state(
-        model, mesh, jax.random.key(0), cfg.init_accumulator_value, cfg.adagrad_accumulator
-    )
-    if resume:
-        state = restore_checkpoint(cfg.model_file, state)
+    if resume and cfg.table_layout == "packed":
+        # Restore the LOGICAL checkpoint into a rows-layout template and
+        # convert — no throwaway packed random init.
+        from fast_tffm_tpu.parallel import pack_logical_to_sharded
+
+        logical = restore_checkpoint(
+            cfg.model_file,
+            init_sharded_state(
+                model, mesh, jax.random.key(0), cfg.init_accumulator_value
+            ),
+        )
+        state = pack_logical_to_sharded(
+            logical, model, mesh, cfg.init_accumulator_value
+        )
         log(f"resumed from {cfg.model_file} at step {int(state.step)}")
+    else:
+        state = init_sharded_state(
+            model, mesh, jax.random.key(0), cfg.init_accumulator_value,
+            cfg.adagrad_accumulator, table_layout=cfg.table_layout,
+        )
+        if resume:
+            state = restore_checkpoint(cfg.model_file, state)
+            log(f"resumed from {cfg.model_file} at step {int(state.step)}")
     step_fn = make_sharded_train_step(
         model, cfg.learning_rate, mesh,
         lookup=cfg.lookup, capacity_factor=cfg.lookup_capacity_factor,
-        overflow_mode=cfg.lookup_overflow,
+        overflow_mode=cfg.lookup_overflow, table_layout=cfg.table_layout,
     )
     predict_step = make_sharded_predict_step(
         model, mesh, lookup=cfg.lookup, capacity_factor=cfg.lookup_capacity_factor,
-        overflow_mode=cfg.lookup_overflow,
+        overflow_mode=cfg.lookup_overflow, table_layout=cfg.table_layout,
     )
+    dist_saveable = None
+    if cfg.table_layout == "packed":
+        # Checkpoints hold LOGICAL [V, D] arrays (single-process mesh).
+        from fast_tffm_tpu.parallel import unpack_sharded_to_logical
+
+        def dist_saveable(st):
+            return unpack_sharded_to_logical(st, model, mesh)
 
     cached_data = None
     if cfg.device_cache:
@@ -767,4 +799,5 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
         examples_per_step=examples_per_step,
         evaluate=evaluate,
         extra_metrics=extra_metrics,
+        saveable=dist_saveable,
     )

@@ -1,0 +1,24 @@
+#!/usr/bin/env python
+"""One-line chip health probe: can we allocate + step at vocab 2^20?"""
+import sys, time
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _bench_watchdog
+_w = _bench_watchdog.arm(seconds=420, what="chip_probe")
+import jax, numpy as np
+import bench as B
+from fast_tffm_tpu.models import FMModel
+from fast_tffm_tpu.trainer import init_state, make_train_step
+try:
+    vocab = 1 << 20
+    model = FMModel(vocabulary_size=vocab, factor_num=8, order=2)
+    step = make_train_step(model, 0.01)
+    rng = np.random.default_rng(0)
+    bats = [B.make_batch(B.zipf_ids(rng, (B.BATCH, B.NNZ), vocab), i) for i in range(4)]
+    state = init_state(model, jax.random.key(0))
+    t0 = time.perf_counter()
+    state, rate = B.measure(step, state, bats, iters=5, windows=1)
+    print(f"HEALTHY rate={rate:,.0f} ex/s step={B.BATCH/rate*1e3:.0f}ms wall={time.perf_counter()-t0:.0f}s")
+except Exception as e:
+    print(f"DEGRADED {str(e)[:90]}")
+_w.cancel()
