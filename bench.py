@@ -381,9 +381,15 @@ def main():
             import subprocess
             import sys as _sys
 
+            global _watchdog
             for cand in SCALE_VOCABS:
                 if cand >= ladder[0]:
                     continue
+                # Each retry gets its own watchdog budget: the parent's
+                # may be nearly spent by the failed full run, and dying
+                # mid-retry without a line is worse than a late line.
+                _watchdog.cancel()
+                _watchdog = _bench_watchdog.arm(seconds=3000, what="bench.py retry")
                 env = dict(os.environ, BENCH_RUNG=str(cand))
                 try:
                     r = subprocess.run(
@@ -391,11 +397,30 @@ def main():
                         capture_output=True, text=True, timeout=2700, env=env,
                     )
                 except subprocess.TimeoutExpired:
+                    results.setdefault("scale_fallbacks", []).append(
+                        f"retry vocab={cand}: timed out (2700s)"
+                    )
                     continue
-                out = (r.stdout or "").strip()
-                if r.returncode == 0 and out.startswith("{"):
+                line = None
+                for cand_line in reversed((r.stdout or "").strip().splitlines()):
+                    if cand_line.startswith("{"):
+                        line = cand_line
+                        break
+                parsed = None
+                if r.returncode == 0 and line:
+                    try:
+                        parsed = json.loads(line)
+                    except ValueError:
+                        parsed = None
+                if parsed and parsed.get("value") is not None:
+                    # Merge the parent's audit trail so the artifact still
+                    # records why the bigger rungs were skipped.
+                    parsed.setdefault("scale_fallbacks", [])
+                    parsed["scale_fallbacks"] = (
+                        results.get("scale_fallbacks", []) + parsed["scale_fallbacks"]
+                    )
                     _watchdog.cancel()
-                    print(out.splitlines()[-1])
+                    print(json.dumps(parsed))
                     return
                 results.setdefault("scale_fallbacks", []).append(
                     f"retry vocab={cand}: {_error_line(r.stderr or r.stdout)}"

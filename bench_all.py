@@ -111,21 +111,28 @@ def time_step(step, state, batches, warmup=5, iters=30, windows=3, sync=None):
     return iters / best
 
 
-def bench_local(name, model, batch_size, nnz, vocab, num_fields=0, lr=0.01):
-    state = init_state(model, jax.random.key(0))
-    step = make_train_step(model, lr)
+def bench_local(name, model, batch_size, nnz, vocab, num_fields=0, lr=0.01,
+                layout="rows"):
+    if layout == "packed":
+        from fast_tffm_tpu.trainer import init_packed_state, make_packed_train_step
+
+        state = init_packed_state(model, jax.random.key(0))
+        step = make_packed_train_step(model, lr)
+    else:
+        state = init_state(model, jax.random.key(0))
+        step = make_train_step(model, lr)
     rng = np.random.default_rng(0)
     batches = [make_batch(rng, batch_size, nnz, vocab, num_fields) for _ in range(8)]
     sps = time_step(step, state, batches)
     report(name, batch_size * sps / jax.device_count())
 
 
-def bench_sharded(name, model, batch_size, nnz, vocab, lr=0.01):
+def bench_sharded(name, model, batch_size, nnz, vocab, lr=0.01, layout="rows"):
     from fast_tffm_tpu.parallel import init_sharded_state, make_mesh, make_sharded_train_step
 
     mesh = make_mesh(None, jax.device_count())  # all visible chips on the row axis
-    state = init_sharded_state(model, mesh, jax.random.key(0))
-    step = make_sharded_train_step(model, lr, mesh)
+    state = init_sharded_state(model, mesh, jax.random.key(0), table_layout=layout)
+    step = make_sharded_train_step(model, lr, mesh, table_layout=layout)
     rng = np.random.default_rng(0)
     batches = [make_batch(rng, batch_size, nnz, vocab) for _ in range(8)]
     sps = time_step(step, state, batches)
@@ -189,6 +196,31 @@ def main():
         "cfg5: train ex/s/chip (FM order3 k=8, nnz=11, vocab=1M, ANOVA kernel)",
         FMModel(vocabulary_size=1 << 20, factor_num=8, order=3),
         B, 11, 1 << 20, lr=0.05,
+    )
+    # The lane-packed layout (table_layout = packed) across the zoo: same
+    # math (test-pinned), tile-aligned physical movement — the measured
+    # fix for the partial-lane scatter bound (DESIGN §6).
+    bench_local(
+        "cfg1p: train ex/s/chip (cfg1 + table_layout=packed)",
+        FMModel(vocabulary_size=1 << 20, factor_num=8, order=2),
+        B, 39, 1 << 20, lr=0.05, layout="packed",
+    )
+    bench_sharded(
+        "cfg2p: train ex/s/chip (cfg2 mesh step + table_layout=packed)",
+        FMModel(vocabulary_size=1 << 24, factor_num=16, order=2),
+        B, 39, 1 << 24, lr=0.05, layout="packed",
+    )
+    bench_local(
+        "cfg3p: train ex/s/chip (cfg3 FFM + table_layout=packed)",
+        FFMModel(vocabulary_size=1 << 20, num_fields=22, factor_num=4),
+        8192, 22, 1 << 20, num_fields=22, lr=0.05, layout="packed",
+    )
+    bench_local(
+        "cfg4p: train ex/s/chip (cfg4 DeepFM bf16 + table_layout=packed)",
+        DeepFMModel(
+            vocabulary_size=1 << 20, num_fields=39, factor_num=8, compute_dtype="bfloat16"
+        ),
+        8192, 39, 1 << 20, lr=0.02, layout="packed",
     )
     bench_predict()
     bench_input()
