@@ -328,6 +328,8 @@ def _error_line(text: str) -> str:
 
 
 def main():
+    global _watchdog  # retries re-arm it (see the retry loop below)
+
     rng = np.random.default_rng(0)
     results = {}
 
@@ -381,7 +383,6 @@ def main():
             import subprocess
             import sys as _sys
 
-            global _watchdog
             for cand in SCALE_VOCABS:
                 if cand >= ladder[0]:
                     continue
