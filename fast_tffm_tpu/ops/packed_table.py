@@ -31,7 +31,9 @@ AdagradOptimizer sparse path); the layout itself has no reference analog
 because CPUs don't have lane tiles.
 
 Constraints: element-granularity accumulator (it packs identically and
-zero-grad identity makes whole-row RMW exact); D ≤ 64 so P ≥ 2.
+zero-grad identity makes whole-row RMW exact); D ≤ 128 (64 < D ≤ 128
+degrades to P=1 — one padded row per tile row, memory ×128/D, still the
+fast full-width scatter path; FFM at 22 fields × k=4 has D=89).
 Checkpoints always store the LOGICAL [V, D] table (pack/unpack below),
 so packed and rows checkpoints are interchangeable.
 """
@@ -57,9 +59,14 @@ LANES = 128
 
 
 def rows_per_tile(d: int) -> int:
-    if d > LANES // 2:
-        raise ValueError(f"packed layout needs D <= {LANES // 2}, got {d}")
-    return LANES // d
+    """Logical rows per 128-lane physical row.  P >= 2 packs multiple
+    rows per tile row; 64 < D <= 128 degrades to P = 1 — one logical row
+    padded to the full tile row (memory ×128/D, e.g. 1.44× for FFM's
+    D=89) which still converts every partial-lane scatter into the fast
+    full-width path."""
+    if d > LANES:
+        raise ValueError(f"packed layout needs D <= {LANES}, got {d}")
+    return max(1, LANES // d)
 
 
 def packed_rows(vocab: int, d: int) -> int:

@@ -135,19 +135,17 @@ def init_sharded_state(
     padding makes the global packed array exactly the concatenation of the
     per-shard packings."""
     if table_layout == "packed":
-        from fast_tffm_tpu.ops.packed_table import (
-            pack_accum,
-            pack_table,
-            rows_per_tile,
-        )
+        from fast_tffm_tpu.ops.packed_table import rows_per_tile
 
         if accumulator != "element":
             raise ValueError("table_layout=packed requires the element accumulator")
+        from fast_tffm_tpu.trainer import pack_state
+
         model = _pad_model_vocab(model, mesh, pack=rows_per_tile(model.row_dim))
-        state = init_state(model, key, init_accumulator_value, "element")
-        table = pack_table(state.table)
-        accum = pack_accum(state.table_opt.accum, init_accumulator_value)
-        state = TrainState(table, AdagradState(accum), state.dense, state.dense_opt, state.step)
+        state = pack_state(
+            init_state(model, key, init_accumulator_value, "element"),
+            init_accumulator_value,
+        )
     else:
         model = _pad_model_vocab(model, mesh)
         state = init_state(model, key, init_accumulator_value, accumulator)

@@ -139,14 +139,16 @@ def pack_state(state: TrainState, init_accumulator_value: float = 0.1) -> TrainS
     """Lane-pack a LOGICAL TrainState (table via pack_table, accumulator
     via pack_accum — padding lanes hold the init value so whole-tile-row
     Adagrad never divides by sqrt(0)).  Shared by init, resume, and the
-    packed predict driver."""
+    packed predict driver.  Packs ONE array at a time, dropping each
+    logical original before the next — the transient device-memory peak
+    is what OOMs big vocabs on a shared chip."""
     from fast_tffm_tpu.ops.packed_table import pack_accum, pack_table
 
+    state = state._replace(table=pack_table(state.table))
     return state._replace(
-        table=pack_table(state.table),
         table_opt=state.table_opt._replace(
             accum=pack_accum(state.table_opt.accum, init_accumulator_value)
-        ),
+        )
     )
 
 

@@ -141,8 +141,30 @@ def test_packed_training_matches_rows_layout(family):
 
 
 def test_packed_rejects_wide_rows():
+    assert rows_per_tile(65) == 1  # P=1: padded single-row tiles
+    assert rows_per_tile(89) == 1  # FFM 22 fields x k=4
     with pytest.raises(ValueError, match="D <="):
-        rows_per_tile(65)
+        rows_per_tile(129)
+
+
+def test_packed_training_matches_rows_layout_p1():
+    """P = 1 (wide-D) packing: FFM at the BASELINE shape (22 fields,
+    D=89) trains identically to the rows layout."""
+    model = FFMModel(vocabulary_size=V, num_fields=22, factor_num=4)
+    rng = np.random.default_rng(12)
+    batches = _batches(rng, n=3, F=22)
+    rs = init_state(model, jax.random.key(5))
+    rstep = make_train_step(model, 0.05)
+    ps = init_packed_state(model, jax.random.key(5))
+    pstep = make_packed_train_step(model, 0.05)
+    for b in batches:
+        rs, rloss = rstep(rs, b)
+        ps, ploss = pstep(ps, b)
+        np.testing.assert_allclose(float(ploss), float(rloss), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(unpack_table(ps.table, V, model.row_dim)),
+        np.asarray(rs.table), rtol=1e-6, atol=1e-7,
+    )
 
 
 def test_packed_driver_and_checkpoint_interop(tmp_path):
