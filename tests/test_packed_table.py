@@ -350,3 +350,34 @@ def test_dist_train_packed_driver(tmp_path):
     dist_predict(cfg_px, log=lambda *_: None)
     s_p = [float(x) for x in open(cfg_px.score_path).read().split()]
     np.testing.assert_allclose(s_p, s_r, rtol=1e-5)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs the 8-device CPU mesh")
+def test_sharded_packed_p1_ffm_matches_rows():
+    """P=1 (wide-D) packing through the MESH-SHARDED step: FFM at the
+    BASELINE width (22 fields, D=89) matches the rows-layout trajectory."""
+    from fast_tffm_tpu.parallel import (
+        init_sharded_state,
+        make_mesh,
+        make_sharded_train_step,
+        unpack_sharded_to_logical,
+    )
+
+    model = FFMModel(vocabulary_size=V, num_fields=22, factor_num=4)
+    mesh = make_mesh(2, 4)
+    rng = np.random.default_rng(13)
+    batches = _batches(rng, n=2, F=22)
+
+    rs = init_sharded_state(model, mesh, jax.random.key(9))
+    rstep = make_sharded_train_step(model, 0.1, mesh)
+    ps = init_sharded_state(model, mesh, jax.random.key(9), table_layout="packed")
+    pstep = make_sharded_train_step(model, 0.1, mesh, table_layout="packed")
+
+    for b in batches:
+        rs, rloss = rstep(rs, b)
+        ps, ploss = pstep(ps, b)
+        np.testing.assert_allclose(float(ploss), float(rloss), rtol=1e-5)
+    logical = np.asarray(unpack_sharded_to_logical(ps, model, mesh).table)[:V]
+    np.testing.assert_allclose(
+        logical, np.asarray(rs.table)[:V], rtol=1e-5, atol=1e-7
+    )
