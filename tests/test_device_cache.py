@@ -221,3 +221,15 @@ def test_device_cache_with_packed_layout(tmp_path, fmb_files):
     np.testing.assert_array_equal(
         np.asarray(st_stream.table), np.asarray(st_cache.table)
     )
+
+
+def test_device_cache_dist_refuses_packed(tmp_path, fmb_files):
+    """dist_train refuses device_cache + table_layout=packed (untested
+    composition) instead of silently running one of them."""
+    from fast_tffm_tpu.training import dist_train
+
+    cfg = _cfg(
+        tmp_path, fmb_files, "dcpk", device_cache=True, table_layout="packed"
+    )
+    with pytest.raises(ValueError, match="not\\s+supported"):
+        dist_train(cfg, log=lambda *_: None)
