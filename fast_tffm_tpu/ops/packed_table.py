@@ -73,14 +73,59 @@ def packed_rows(vocab: int, d: int) -> int:
     return -(-vocab // rows_per_tile(d))
 
 
-def pack_table(table: jax.Array) -> jax.Array:
-    """[V, D] logical -> [VP, 128] packed (pad lanes/rows zero)."""
+_CHUNK_LOGICAL_ROWS = 1 << 21  # chunked packing granularity (rounded to P)
+
+
+def _pack_block(block: jax.Array, p: int, pad_value: float) -> jax.Array:
+    """[n·P, D] logical rows -> [n, 128] packed rows (spare lanes carry
+    ``pad_value``)."""
+    n = block.shape[0] // p
+    d = block.shape[1]
+    out = jnp.full((n, LANES), pad_value, block.dtype)
+    return out.at[:, : p * d].set(block.reshape(n, p * d))
+
+
+from functools import partial as _partial
+
+
+@_partial(jax.jit, donate_argnums=(0,), static_argnums=(4,))
+def _chunk_write(buf, block, start_phys, pad_value, p):
+    """One donated chunk write.  ``start_phys`` and ``pad_value`` are
+    traced (ONE compile covers every full-size chunk; the ragged tail's
+    different block shape costs a second) — a static start would
+    recompile per chunk, ~112 times at a 235M-row table."""
+    return jax.lax.dynamic_update_slice_in_dim(
+        buf, _pack_block(block, p, pad_value), start_phys, axis=0
+    )
+
+
+def pack_table(table: jax.Array, pad_value: float = 0.0) -> jax.Array:
+    """[V, D] logical -> [VP, 128] packed (pad lanes/rows = pad_value).
+
+    Large tables pack in chunks through a donated accumulator so the
+    transient device-memory peak stays ~logical+packed (measured: the
+    whole-array path's extra flat copy OOMs 16M-row vocabs on a busy
+    shared chip)."""
     v, d = table.shape
     p = rows_per_tile(d)
     vp = packed_rows(v, d)
-    flat = jnp.zeros((vp * p, d), table.dtype).at[:v].set(table)
-    packed = jnp.zeros((vp, LANES), table.dtype)
-    return packed.at[:, : p * d].set(flat.reshape(vp, p * d))
+    chunk = (_CHUNK_LOGICAL_ROWS // p) * p
+    if v <= chunk:
+        flat = jnp.full((vp * p, d), pad_value, table.dtype).at[:v].set(table)
+        return _pack_block(flat, p, pad_value)
+    packed = jnp.full((vp, LANES), pad_value, table.dtype)
+    for lo in range(0, v, chunk):
+        hi = min(lo + chunk, v)
+        block = table[lo:hi]
+        if (hi - lo) % p:
+            pad = p - (hi - lo) % p
+            block = jnp.concatenate(
+                [block, jnp.full((pad, d), pad_value, table.dtype)]
+            )
+        packed = _chunk_write(
+            packed, block, jnp.int32(lo // p), jnp.asarray(pad_value, table.dtype), p
+        )
+    return packed
 
 
 def pack_accum(accum: jax.Array, init_value: float) -> jax.Array:
@@ -88,12 +133,7 @@ def pack_accum(accum: jax.Array, init_value: float) -> jax.Array:
     ``init_value``, never zero — the whole-tile-row Adagrad RMW divides
     by sqrt(acc), and a zero pad would turn 0/sqrt(0) into NaN the first
     time a partially-used physical row updates."""
-    v, d = accum.shape
-    p = rows_per_tile(d)
-    vp = packed_rows(v, d)
-    flat = jnp.full((vp * p, d), init_value, accum.dtype).at[:v].set(accum)
-    packed = jnp.full((vp, LANES), init_value, accum.dtype)
-    return packed.at[:, : p * d].set(flat.reshape(vp, p * d))
+    return pack_table(accum, pad_value=init_value)
 
 
 def unpack_table(packed: jax.Array, vocab: int, d: int) -> jax.Array:

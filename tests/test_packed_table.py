@@ -381,3 +381,25 @@ def test_sharded_packed_p1_ffm_matches_rows():
     np.testing.assert_allclose(
         logical, np.asarray(rs.table)[:V], rtol=1e-5, atol=1e-7
     )
+
+
+def test_chunked_pack_matches_whole_array_pack():
+    """The chunked (low-transient-peak) packing path produces exactly the
+    whole-array path's result, including pad values in rows and lanes."""
+    import fast_tffm_tpu.ops.packed_table as pt
+
+    rng = np.random.default_rng(14)
+    d = 9
+    v = 5 * 64 + 17  # several chunks + ragged tail at the test chunk size
+    t = jnp.asarray(rng.normal(size=(v, d)).astype(np.float32))
+    whole = pack_table(t, pad_value=0.25)
+    old = pt._CHUNK_LOGICAL_ROWS
+    try:
+        pt._CHUNK_LOGICAL_ROWS = 64
+        chunked = pack_table(t, pad_value=0.25)
+    finally:
+        pt._CHUNK_LOGICAL_ROWS = old
+    np.testing.assert_array_equal(np.asarray(chunked), np.asarray(whole))
+    np.testing.assert_array_equal(
+        np.asarray(unpack_table(chunked, v, d)), np.asarray(t)
+    )
