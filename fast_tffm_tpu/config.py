@@ -117,6 +117,16 @@ class Config:
             raise ValueError(
                 f"unknown table_layout {self.table_layout!r} (rows | packed)"
             )
+        if self.init_accumulator_value <= 0:
+            # TF AdagradOptimizer requires a positive initial accumulator
+            # for the same reason: a zero accumulator makes the first
+            # update of any element with zero summed gradient compute
+            # 0/sqrt(0) = NaN (rows layout: zero-grad elements of touched
+            # rows; packed layout: untouched logical rows sharing a tile
+            # row), silently corrupting the table.
+            raise ValueError(
+                f"init_accumulator_value must be > 0, got {self.init_accumulator_value}"
+            )
         if self.table_layout == "packed" and self.adagrad_accumulator != "element":
             # The packed update writes whole 128-lane tile rows; the
             # element accumulator packs identically and zero-grad Adagrad

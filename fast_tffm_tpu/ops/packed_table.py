@@ -166,12 +166,13 @@ def packed_sparse_adagrad_update(
     ids: jax.Array,
     row_grads: jax.Array,
     lr: float,
-    vocab: int,
 ):
     """Sparse Adagrad on the packed table — one-pass lane-space dedup.
 
-    ids: [...] logical ids; row_grads: [..., D] per-occurrence grads.
-    Returns (packed, accum_packed).  Per-element semantics match
+    ids: [...] logical ids (ids >= packed.shape[0] * rows_per_tile(D) act
+    as drop sentinels — their physical row lands past the last packed row
+    and the scatter drops it; the sharded update relies on this for
+    unowned ids).  Returns (packed, accum_packed).  Per-element semantics match
     optim.sparse_adagrad_update with the element accumulator: every
     element sees the occurrence-summed gradient exactly once
     (duplicate ids land in the same lanes of the same physical segment

@@ -171,5 +171,5 @@ def packed_sharded_update(
     # Past-the-end sentinel: phys = vp -> dropped by the packed scatter.
     local = jnp.where(owned, local, packed_shard.shape[0] * p)
     return packed_sparse_adagrad_update(
-        packed_shard, accum_shard, local, all_gsum, lr, shard_logical_rows
+        packed_shard, accum_shard, local, all_gsum, lr
     )

@@ -184,8 +184,7 @@ def packed_train_step_body(model, learning_rate: float, state: TrainState, batch
     (_, data_loss), (g_rows, g_dense) = grad_fn(rows, state.dense, batch)
 
     table, accum = packed_sparse_adagrad_update(
-        state.table, state.table_opt.accum, batch.ids, g_rows,
-        learning_rate, model.vocabulary_size,
+        state.table, state.table_opt.accum, batch.ids, g_rows, learning_rate
     )
     dense, dense_opt = state.dense, state.dense_opt
     if jax.tree.leaves(state.dense):

@@ -396,7 +396,6 @@ def train(cfg: Config, *, resume: bool = False, log=print):
             packed_train_step_body,
         )
 
-        state = init_packed_state(model, jax.random.key(0), cfg.init_accumulator_value)
         v, d = model.vocabulary_size, model.row_dim
 
         def saveable(st):
@@ -410,6 +409,10 @@ def train(cfg: Config, *, resume: bool = False, log=print):
             )
 
         if resume:
+            # Branch BEFORE allocating: building the fresh packed state
+            # first would peak at packed + 2x logical on exactly the large
+            # vocabs where OOMs were measured (dist_train's packed resume
+            # is structured the same way).
             from fast_tffm_tpu.trainer import pack_state
 
             logical = restore_checkpoint(
@@ -418,6 +421,10 @@ def train(cfg: Config, *, resume: bool = False, log=print):
             )
             state = pack_state(logical, cfg.init_accumulator_value)
             log(f"resumed from {cfg.model_file} at step {int(state.step)} (packed)")
+        else:
+            state = init_packed_state(
+                model, jax.random.key(0), cfg.init_accumulator_value
+            )
         predict_step = make_packed_predict_step(model)
         step_body = packed_train_step_body
         step_fn = make_packed_train_step(model, cfg.learning_rate)

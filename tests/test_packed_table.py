@@ -86,7 +86,7 @@ def test_packed_update_exact_vs_rows_layout():
     t2, st2 = sparse_adagrad_update(t, AdagradState(acc), ids, g, 0.1)
 
     tp, ap = pack_table(t), pack_table(acc)
-    tp2, ap2 = packed_sparse_adagrad_update(tp, ap, ids, g, 0.1, V)
+    tp2, ap2 = packed_sparse_adagrad_update(tp, ap, ids, g, 0.1)
     np.testing.assert_array_equal(
         np.asarray(unpack_table(tp2, V, d)), np.asarray(t2)
     )

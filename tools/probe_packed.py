@@ -1,0 +1,296 @@
+#!/usr/bin/env python
+"""Probe the packed train step's sparse tail, honestly (value-synced).
+
+Round-4 question (VERDICT r3 #1): the packed step spends ~6-7 sparse
+M-row ops per step (fwd gather, argsort, perm gather, segment-sum, 2 RMW
+gathers, 2 scatters).  Which of them actually cost, and does the
+candidate redesign — ONE wide scatter-add into a dense [VP, 128] grad
+buffer followed by a DENSE Adagrad sweep (zero-grad identity makes the
+sweep exact) — beat the sort+segsum+RMW pipeline, and at which vocab
+does the O(V) dense sweep stop paying?
+
+Everything here times marginal fori_loop slopes or interleaved A/B
+windows closed by a VALUE fetch (bench.forced_sync rationale, DESIGN §6
+round-3 correction).  Prints one JSON dict.
+"""
+
+import json
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import _bench_watchdog
+
+_watchdog = _bench_watchdog.arm(seconds=3000, what="probe_packed.py")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bench import forced_sync, make_batch, zipf_ids
+from fast_tffm_tpu.models import FMModel
+from fast_tffm_tpu.optim import AdagradState
+from fast_tffm_tpu.ops.packed_table import (
+    LANES,
+    packed_gather,
+    packed_rows,
+    rows_per_tile,
+)
+from fast_tffm_tpu.trainer import (
+    TrainState,
+    init_packed_state,
+    make_packed_train_step,
+    packed_train_step_body,
+)
+
+BATCH = 16384
+NNZ = 39
+K = 8
+D = 1 + K
+
+
+# --- candidate: dense-G packed step --------------------------------------
+
+
+def lane_spread(g, slot, p, d):
+    """[M, D] per-occurrence grads -> [M, 128] with each row's grad in its
+    slot lanes — ONE broadcast pass (one_hot [M,p] outer g) instead of p
+    masked-slice passes over [M,128]."""
+    m = g.shape[0]
+    oh = jax.nn.one_hot(slot, p, dtype=g.dtype)  # [M, p]
+    g128 = (oh[:, :, None] * g[:, None, :]).reshape(m, p * d)
+    if p * d < LANES:
+        g128 = jnp.pad(g128, ((0, 0), (0, LANES - p * d)))
+    return g128
+
+
+def dense_g_step_body(model, lr, state: TrainState, batch):
+    """packed_train_step_body with the sparse tail replaced by:
+    scatter-ADD g128 into a dense [VP, 128] zero buffer, then a dense
+    elementwise Adagrad sweep.  Untouched elements see G == 0, the
+    Adagrad identity, so the sweep is exact."""
+    from fast_tffm_tpu.models.base import Batch
+    from fast_tffm_tpu.trainer import batch_loss
+
+    d = model.row_dim
+    p = rows_per_tile(d)
+    rows = packed_gather(state.table, batch.ids, d)
+    grad_fn = jax.value_and_grad(
+        partial(batch_loss, model), argnums=(0, 1), has_aux=True
+    )
+    (_, data_loss), (g_rows, g_dense) = grad_fn(rows, state.dense, batch)
+
+    flat_ids = batch.ids.reshape(-1)
+    m = flat_ids.shape[0]
+    g = g_rows.reshape(m, d)
+    slot = (flat_ids % p).astype(jnp.int32)
+    phys = (flat_ids // p).astype(jnp.int32)
+    g128 = lane_spread(g, slot, p, d)
+    G = jnp.zeros_like(state.table).at[phys].add(g128, mode="drop")
+    acc2 = state.table_opt.accum + G * G
+    table = state.table - lr * G / jnp.sqrt(acc2)
+    return (
+        TrainState(table, AdagradState(acc2), state.dense, state.dense_opt,
+                   state.step + 1),
+        data_loss,
+    )
+
+
+def make_dense_g_step(model, lr):
+    @partial(jax.jit, donate_argnums=(0,))
+    def step(state, batch):
+        return dense_g_step_body(model, lr, state, batch)
+
+    return step
+
+
+# --- interleaved A/B of full steps ---------------------------------------
+
+
+def ab_steps(variants, batches, iters=10, windows=5):
+    """variants: {name: (step, state)}.  Interleave one window per variant
+    per round; value-sync closes every window.  Returns per-variant window
+    rates (ex/s)."""
+    out = {name: [] for name in variants}
+    states = {}
+    for name, (step, state) in variants.items():
+        state, _ = step(state, batches[0])  # compile
+        forced_sync(state)
+        for i in range(1, 3):
+            state, _ = step(state, batches[i % len(batches)])
+        forced_sync(state)
+        states[name] = state
+    for _ in range(windows):
+        for name, (step, _) in variants.items():
+            state = states[name]
+            t0 = time.perf_counter()
+            for i in range(iters):
+                state, _ = step(state, batches[i % len(batches)])
+            forced_sync(state)
+            dt = time.perf_counter() - t0
+            states[name] = state
+            out[name].append(BATCH * iters / dt)
+    return out
+
+
+# --- per-op fori_loop slopes ----------------------------------------------
+
+
+def slope_ms(fn, arrays, k_lo=4, k_hi=16, reps=3):
+    """Marginal ms per op application: fn(arrays, k) runs the op k times
+    inside one jit (carry-chained); cost = (t_hi - t_lo)/(k_hi - k_lo),
+    best of reps (contention only slows)."""
+    jfn = jax.jit(fn, static_argnums=(1,))
+    for k in (k_lo, k_hi):  # compile both
+        float(jfn(arrays, k))
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        float(jfn(arrays, k_lo))
+        t_lo = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        float(jfn(arrays, k_hi))
+        t_hi = time.perf_counter() - t0
+        best = min(best, (t_hi - t_lo) / (k_hi - k_lo))
+    return best * 1e3
+
+
+def main():
+    vocab = int(sys.argv[1]) if len(sys.argv) > 1 else 1 << 24
+    rng = np.random.default_rng(0)
+    res = {"vocab": vocab, "batch": BATCH, "nnz": NNZ, "d": D}
+    import atexit
+
+    atexit.register(lambda: print(json.dumps(res), flush=True))
+    p = rows_per_tile(D)
+    vp = packed_rows(vocab, D)
+    m = BATCH * NNZ
+    res["p"] = p
+    res["vp"] = vp
+    res["m"] = m
+
+    model = FMModel(vocabulary_size=vocab, factor_num=K, order=2)
+    batches = [make_batch(zipf_ids(rng, (BATCH, NNZ), vocab), i) for i in range(8)]
+
+    # --- full-step A/B: current packed vs dense-G ---
+    cur = make_packed_train_step(model, 0.01)
+    dng = make_dense_g_step(model, 0.01)
+    s_cur = init_packed_state(model, jax.random.key(0))
+    s_dng = init_packed_state(model, jax.random.key(0))
+    ab = ab_steps({"packed_current": (cur, s_cur), "dense_g": (dng, s_dng)}, batches)
+    for name, rates in ab.items():
+        res[f"{name}_exs_windows"] = [round(r, 1) for r in rates]
+        res[f"{name}_exs_median"] = round(float(np.median(rates)), 1)
+        res[f"{name}_step_ms_median"] = round(BATCH / np.median(rates) * 1e3, 2)
+    del s_cur, s_dng, cur, dng
+
+    # --- numerical agreement spot check (tiny vocab, CPU-free) ---
+    tm = FMModel(vocabulary_size=1 << 12, factor_num=K, order=2)
+    tb = make_batch(zipf_ids(rng, (256, NNZ), 1 << 12), 99)
+    sa = init_packed_state(tm, jax.random.key(1))
+    sb = init_packed_state(tm, jax.random.key(1))
+    sa, la = make_packed_train_step(tm, 0.01)(sa, tb)
+    sb, lb = make_dense_g_step(tm, 0.01)(sb, tb)
+    res["parity_max_abs_table_diff"] = float(
+        jnp.max(jnp.abs(sa.table - sb.table))
+    )
+    res["parity_loss_diff"] = float(jnp.abs(la - lb))
+    del sa, sb
+
+    # --- per-op slopes at the probe shapes ---
+    ids = jnp.asarray(zipf_ids(rng, (m,), vocab))
+    phys = (ids // p).astype(jnp.int32)
+    packed = jnp.zeros((vp, LANES), jnp.float32) + 0.01
+    g128 = jnp.asarray(rng.normal(size=(m, LANES)).astype(np.float32))
+
+    def chain_gather(arrays, k):
+        pk, ph = arrays
+
+        def body(i, s):
+            ph2 = jnp.minimum(ph + (jnp.int32(s) & 1), pk.shape[0] - 1)
+            return jnp.float32(jnp.sum(pk[ph2][:, :2]) * 1e-9) + s * 0.5
+
+        return jax.lax.fori_loop(0, k, body, jnp.float32(0))
+
+    res["op_wide_gather_big_ms"] = round(slope_ms(chain_gather, (packed, phys)), 3)
+
+    def chain_scatter_add(arrays, k):
+        pk, ph, g = arrays
+
+        def body(i, s):
+            ph2 = jnp.minimum(ph + (jnp.int32(s) & 1), pk.shape[0] - 1)
+            G = jnp.zeros_like(pk).at[ph2].add(g, mode="drop")
+            return jnp.float32(jnp.sum(G[:2]) * 1e-9) + s * 0.5
+
+        return jax.lax.fori_loop(0, k, body, jnp.float32(0))
+
+    res["op_wide_scatter_add_big_ms"] = round(
+        slope_ms(chain_scatter_add, (packed, phys, g128)), 3
+    )
+
+    def chain_sort(arrays, k):
+        (idv,) = arrays
+
+        def body(i, s):
+            srt = jnp.sort(idv ^ (jnp.int32(s) & 1))
+            return jnp.float32(srt[0] + srt[-1]) * 1e-9 + s * 0.5
+
+        return jax.lax.fori_loop(0, k, body, jnp.float32(0))
+
+    res["op_argsort_ms"] = round(slope_ms(chain_sort, (ids,)), 3)
+
+    def chain_perm_gather(arrays, k):
+        g, ph = arrays
+        order = jnp.argsort(ph)
+
+        def body(i, s):
+            o2 = jnp.minimum(order + (jnp.int32(s) & 1), g.shape[0] - 1)
+            return jnp.float32(jnp.sum(g[o2][:, :2]) * 1e-9) + s * 0.5
+
+        return jax.lax.fori_loop(0, k, body, jnp.float32(0))
+
+    res["op_perm_gather_ms"] = round(slope_ms(chain_perm_gather, (g128, phys)), 3)
+
+    def chain_segsum(arrays, k):
+        g, ph = arrays
+        sp = jnp.sort(ph)
+        is_new = jnp.concatenate([jnp.ones((1,), bool), sp[1:] != sp[:-1]])
+        seg = jnp.cumsum(is_new) - 1
+
+        def body(i, s):
+            g2 = g * (1.0 + 0.0 * s)
+            ss = jax.ops.segment_sum(g2, seg, num_segments=g.shape[0])
+            return jnp.float32(jnp.sum(ss[:2]) * 1e-9) + s * 0.5
+
+        return jax.lax.fori_loop(0, k, body, jnp.float32(0))
+
+    res["op_segment_sum_ms"] = round(slope_ms(chain_segsum, (g128, phys)), 3)
+
+    def chain_dense_sweep(arrays, k):
+        pk, g = arrays
+        acc0 = pk + 0.1
+
+        def body(i, carry):
+            t, a = carry
+            G = g * (1.0 + 0 * t[0, 0])
+            a2 = a + G * G
+            t2 = t - 0.01 * G / jnp.sqrt(a2)
+            return (t2, a2)
+
+        t2, a2 = jax.lax.fori_loop(0, k, body, (pk, acc0))
+        return jnp.float32(t2[0, 0] + a2[-1, -1])
+
+    gdense = jnp.zeros((vp, LANES), jnp.float32) + 1e-4
+    res["op_dense_sweep_ms"] = round(slope_ms(chain_dense_sweep, (packed, gdense)), 3)
+
+    res["uniq_logical_frac"] = round(
+        float(np.mean([np.unique(np.asarray(b.ids)).size / m for b in batches])), 4
+    )
+    _watchdog.cancel()
+
+
+if __name__ == "__main__":
+    main()
