@@ -46,7 +46,11 @@ class Config:
     factor_lambda: float = 0.0
     bias_lambda: float = 0.0
     init_accumulator_value: float = 0.1
-    adagrad_accumulator: str = "element"  # element (TF parity) | row (faster RMW)
+    adagrad_accumulator: str = "element"  # element (TF parity) | row (D×-smaller state)
+    packed_update: str = "auto"  # packed-layout sparse tail: auto | dense | sorted
+    #   (dense = wide scatter-add into a [VP,128] grad buffer + dense Adagrad
+    #   sweep, measured 3.5× the sorted pipeline; sorted = no table-sized
+    #   temporary, the giant-vocab fallback; auto picks by size)
     thread_num: int = 0  # host-side parse workers; 0 = all cores (reference: queue threads)
     binary_cache: bool = False  # parse text once into <file>.fmb, stream that
     binary_cache_wait: float = 600.0  # multi-host: non-lead wait for lead's build (s)
@@ -127,13 +131,32 @@ class Config:
             raise ValueError(
                 f"init_accumulator_value must be > 0, got {self.init_accumulator_value}"
             )
-        if self.table_layout == "packed" and self.adagrad_accumulator != "element":
-            # The packed update writes whole 128-lane tile rows; the
-            # element accumulator packs identically and zero-grad Adagrad
-            # is the identity, which is what makes that exact.  A packed
-            # row accumulator would be a narrow array again.
+        if self.packed_update not in ("auto", "dense", "sorted"):
             raise ValueError(
-                "table_layout = packed requires adagrad_accumulator = element"
+                f"unknown packed_update {self.packed_update!r} (auto | dense | sorted)"
+            )
+        if self.packed_update != "auto" and self.table_layout != "packed":
+            # Silently inert knobs corrupt A/B comparisons: a run that
+            # pins the update strategy but forgets the layout would
+            # measure the rows layout and call it dense/sorted.
+            raise ValueError(
+                f"packed_update = {self.packed_update} requires "
+                "table_layout = packed (it selects the packed layout's "
+                "sparse-tail strategy)"
+            )
+        if (
+            self.table_layout == "packed"
+            and self.adagrad_accumulator == "row"
+            and self.packed_update == "sorted"
+        ):
+            # The sorted packed update's whole-tile-row RMW is exact only
+            # with the element accumulator (zero-grad identity per LANE);
+            # the row accumulator's [VP, P] scalar slots need the dense-G
+            # sweep (which handles both granularities — the auto default).
+            raise ValueError(
+                "table_layout = packed with adagrad_accumulator = row "
+                "requires packed_update = auto or dense (the sorted "
+                "whole-tile-row RMW needs the element accumulator)"
             )
         return self
 
@@ -211,6 +234,7 @@ def load_config(path: str) -> Config:
     cfg.adagrad_accumulator = get(
         t, "adagrad_accumulator", str, cfg.adagrad_accumulator
     ).lower()
+    cfg.packed_update = get(t, "packed_update", str, cfg.packed_update).lower()
     cfg.thread_num = get(t, "thread_num", int, cfg.thread_num)
     cfg.binary_cache = get(t, "binary_cache", ini._convert_to_boolean, cfg.binary_cache)
     cfg.binary_cache_wait = get(t, "binary_cache_wait", float, cfg.binary_cache_wait)

@@ -39,7 +39,21 @@ __all__ = [
     "apply_shard_adagrad",
     "packed_sharded_gather",
     "packed_sharded_update",
+    "packed_sharded_dense_update",
 ]
+
+
+def owned_local_ids(global_ids, shard_logical_rows: int, sentinel: int):
+    """Map global row ids to this ROW shard's local ids.
+
+    Returns local ids with every unowned id replaced by ``sentinel``
+    (callers pick the convention: 0 for masked gathers, past-the-end for
+    dropped scatters) — the ONE place the base/owned arithmetic lives so
+    the gather/update paths cannot diverge."""
+    base = lax.axis_index(ROW_AXIS) * shard_logical_rows
+    local = global_ids - base
+    owned = (local >= 0) & (local < shard_logical_rows)
+    return jnp.where(owned, local, sentinel), owned
 
 
 def apply_shard_adagrad(table_shard, accum_shard, guids, ggsum, lr, base):
@@ -129,11 +143,8 @@ def packed_sharded_gather(
     """sharded_gather on a lane-packed shard: [B_local, N, D] rows."""
     from fast_tffm_tpu.ops.packed_table import packed_gather
 
-    base = lax.axis_index(ROW_AXIS) * shard_logical_rows
     all_ids = lax.all_gather(ids, ROW_AXIS, tiled=True)  # [R*B_local, N]
-    local = all_ids - base
-    owned = (local >= 0) & (local < shard_logical_rows)
-    local = jnp.where(owned, local, 0)
+    local, owned = owned_local_ids(all_ids, shard_logical_rows, 0)
     rows = packed_gather(packed_shard, local, d)
     rows = rows * owned[..., None].astype(rows.dtype)
     return lax.psum_scatter(rows, ROW_AXIS, scatter_dimension=0, tiled=True)
@@ -165,11 +176,47 @@ def packed_sharded_update(
     all_uids = lax.all_gather(uids, (DATA_AXIS, ROW_AXIS), tiled=True)
     all_gsum = lax.all_gather(gsum, (DATA_AXIS, ROW_AXIS), tiled=True)
 
-    base = lax.axis_index(ROW_AXIS) * shard_logical_rows
-    local = all_uids - base
-    owned = (local >= 0) & (local < shard_logical_rows)
     # Past-the-end sentinel: phys = vp -> dropped by the packed scatter.
-    local = jnp.where(owned, local, packed_shard.shape[0] * p)
+    local, _ = owned_local_ids(all_uids, shard_logical_rows, packed_shard.shape[0] * p)
     return packed_sparse_adagrad_update(
         packed_shard, accum_shard, local, all_gsum, lr
     )
+
+
+def packed_sharded_dense_update(
+    packed_shard: jax.Array,
+    accum_shard: jax.Array,
+    ids: jax.Array,
+    row_grads: jax.Array,
+    lr: float,
+    shard_logical_rows: int,
+):
+    """packed_sharded_update via the DENSE gradient buffer — no sorts.
+
+    The sorted path dedups locally before the all-gather only to keep
+    Adagrad's sum-once semantics through its segment pipeline; the dense
+    buffer gets those semantics from the scatter-ADD itself (duplicates
+    sum in flat order), so this path ships the RAW per-occurrence grads
+    — the all-gather payload is the same [M, D] bytes either way — and
+    each shard scatter-adds the ids it owns into its own [VPs, 128]
+    buffer (unowned ids map past the last physical row and drop).  Every
+    ROW replica sees the identical gathered arrays in the identical
+    order, so the summed G (and hence the shard) is bit-consistent
+    across replicas, and the whole update is bit-identical to the
+    single-device dense step on the same global batch (flat-order sums;
+    test-pinned on the CPU mesh).
+    """
+    from fast_tffm_tpu.ops.packed_table import (
+        packed_dense_adagrad_update,
+        rows_per_tile,
+    )
+
+    D = row_grads.shape[-1]
+    p = rows_per_tile(D)
+    flat_ids = ids.reshape(-1)
+    all_ids = lax.all_gather(flat_ids, (DATA_AXIS, ROW_AXIS), tiled=True)
+    all_g = lax.all_gather(
+        row_grads.reshape(-1, D), (DATA_AXIS, ROW_AXIS), tiled=True
+    )
+    local, _ = owned_local_ids(all_ids, shard_logical_rows, packed_shard.shape[0] * p)
+    return packed_dense_adagrad_update(packed_shard, accum_shard, local, all_g, lr)

@@ -133,17 +133,16 @@ def init_sharded_state(
     ``table_layout='packed'`` stores the shards lane-packed
     ([VP_shard, 128] each — ops/packed_table.py); the shard-aligned vocab
     padding makes the global packed array exactly the concatenation of the
-    per-shard packings."""
+    per-shard packings.  ``accumulator='row'`` with the packed layout
+    packs the [V, 1] accumulator as [VP_shard, P] scalar slots (dense-G
+    update only — resolve_packed_update)."""
     if table_layout == "packed":
         from fast_tffm_tpu.ops.packed_table import rows_per_tile
-
-        if accumulator != "element":
-            raise ValueError("table_layout=packed requires the element accumulator")
         from fast_tffm_tpu.trainer import pack_state
 
         model = _pad_model_vocab(model, mesh, pack=rows_per_tile(model.row_dim))
         state = pack_state(
-            init_state(model, key, init_accumulator_value, "element"),
+            init_state(model, key, init_accumulator_value, accumulator),
             init_accumulator_value,
         )
     else:
@@ -180,7 +179,11 @@ def pack_logical_to_sharded(
     Shared by dist_train's packed resume and dist_predict's packed path."""
     import numpy as np
 
-    from fast_tffm_tpu.ops.packed_table import pack_accum, pack_table
+    from fast_tffm_tpu.ops.packed_table import (
+        pack_accum,
+        pack_accum_rows,
+        pack_table,
+    )
 
     padded, _, _ = packed_shard_meta(model, mesh)
     d = model.row_dim
@@ -189,17 +192,18 @@ def pack_logical_to_sharded(
     la = np.asarray(logical.table_opt.accum)
     ext_t = np.zeros((vp_logical, d), lt.dtype)
     ext_t[: lt.shape[0]] = lt
-    ext_a = np.full((vp_logical, d), init_accumulator_value, la.dtype)
+    ext_a = np.full((vp_logical, la.shape[-1]), init_accumulator_value, la.dtype)
     ext_a[: la.shape[0]] = la
+    packed_acc = (
+        pack_accum_rows(jnp.asarray(ext_a), d, init_accumulator_value)
+        if la.shape[-1] == 1
+        else pack_accum(jnp.asarray(ext_a), init_accumulator_value)
+    )
     ts = table_sharding(mesh)
     rep = replicated(mesh)
     return TrainState(
         table=jax.device_put(pack_table(jnp.asarray(ext_t)), ts),
-        table_opt=AdagradState(
-            jax.device_put(
-                pack_accum(jnp.asarray(ext_a), init_accumulator_value), ts
-            )
-        ),
+        table_opt=AdagradState(jax.device_put(packed_acc, ts)),
         dense=jax.tree.map(lambda x: jax.device_put(x, rep), logical.dense),
         dense_opt=jax.tree.map(lambda x: jax.device_put(x, rep), logical.dense_opt),
         step=jax.device_put(logical.step, rep),
@@ -211,7 +215,7 @@ def unpack_sharded_to_logical(state: TrainState, model, mesh: Mesh) -> TrainStat
     (per-shard unpack; checkpoints always hold the logical layout)."""
     import numpy as np
 
-    from fast_tffm_tpu.ops.packed_table import unpack_table
+    from fast_tffm_tpu.ops.packed_table import LANES, unpack_accum_rows, unpack_table
 
     _, shard_logical, p = packed_shard_meta(model, mesh)
     R = mesh.shape[ROW_AXIS]
@@ -220,8 +224,13 @@ def unpack_sharded_to_logical(state: TrainState, model, mesh: Mesh) -> TrainStat
     def unp(arr):
         a = np.asarray(arr)
         per = a.shape[0] // R
+        unpack = (
+            unpack_table
+            if a.shape[-1] == LANES
+            else unpack_accum_rows  # [VPs, P] row accumulator -> [V, 1]
+        )
         return np.concatenate([
-            np.asarray(unpack_table(jnp.asarray(a[r * per : (r + 1) * per]), shard_logical, d))
+            np.asarray(unpack(jnp.asarray(a[r * per : (r + 1) * per]), shard_logical, d))
             for r in range(R)
         ])
 
@@ -257,7 +266,7 @@ def _make_gather(mesh: Mesh, local_ids_shape, lookup: str, capacity_factor: floa
 def make_sharded_train_step(
     model, learning_rate: float, mesh: Mesh, *, lookup: str = "allgather",
     capacity_factor: float = 2.0, overflow_mode: str = "abort",
-    table_layout: str = "rows",
+    table_layout: str = "rows", packed_update: str = "auto",
 ):
     """Returns jitted SPMD ``step(state, batch) -> (state, global mean loss)``.
 
@@ -324,7 +333,9 @@ def make_sharded_train_step(
 
         def allgather_branch():
             if packed:
+                from fast_tffm_tpu.ops.packed_table import resolve_packed_update
                 from fast_tffm_tpu.parallel.embedding import (
+                    packed_sharded_dense_update,
                     packed_sharded_gather,
                     packed_sharded_update,
                 )
@@ -333,10 +344,19 @@ def make_sharded_train_step(
                     table, batch.ids, d_row, shard_logical_rows
                 )
                 (_, dl), (g_rows, g_dense) = grad_fn(rows, dense)
-                t2, a2 = packed_sharded_update(
-                    table, accum, batch.ids, g_rows, learning_rate,
-                    num_rows_global, shard_logical_rows,
+                mode = resolve_packed_update(
+                    packed_update, table.shape[0], accum.shape[-1]
                 )
+                if mode == "dense":
+                    t2, a2 = packed_sharded_dense_update(
+                        table, accum, batch.ids, g_rows, learning_rate,
+                        shard_logical_rows,
+                    )
+                else:
+                    t2, a2 = packed_sharded_update(
+                        table, accum, batch.ids, g_rows, learning_rate,
+                        num_rows_global, shard_logical_rows,
+                    )
                 return t2, a2, g_dense, dl
             rows = sharded_gather(table, batch.ids)
             (_, dl), (g_rows, g_dense) = grad_fn(rows, dense)

@@ -136,43 +136,67 @@ def make_predict_step(model):
 
 
 def pack_state(state: TrainState, init_accumulator_value: float = 0.1) -> TrainState:
-    """Lane-pack a LOGICAL TrainState (table via pack_table, accumulator
-    via pack_accum — padding lanes hold the init value so whole-tile-row
-    Adagrad never divides by sqrt(0)).  Shared by init, resume, and the
-    packed predict driver.  Packs ONE array at a time, dropping each
-    logical original before the next — the transient device-memory peak
-    is what OOMs big vocabs on a shared chip."""
-    from fast_tffm_tpu.ops.packed_table import pack_accum, pack_table
-
-    state = state._replace(table=pack_table(state.table))
-    return state._replace(
-        table_opt=state.table_opt._replace(
-            accum=pack_accum(state.table_opt.accum, init_accumulator_value)
-        )
+    """Lane-pack a LOGICAL TrainState (table via pack_table; the
+    accumulator via pack_accum for element granularity [V, D] or
+    pack_accum_rows for row granularity [V, 1] — padding slots hold the
+    init value so packed Adagrad never divides by sqrt(0)).  Shared by
+    init, resume, and the packed predict driver.  Packs ONE array at a
+    time, dropping each logical original before the next — the transient
+    device-memory peak is what OOMs big vocabs on a shared chip."""
+    from fast_tffm_tpu.ops.packed_table import (
+        pack_accum,
+        pack_accum_rows,
+        pack_table,
     )
+
+    d = state.table.shape[-1]
+    state = state._replace(table=pack_table(state.table))
+    acc = state.table_opt.accum
+    packed_acc = (
+        pack_accum_rows(acc, d, init_accumulator_value)
+        if acc.shape[-1] == 1
+        else pack_accum(acc, init_accumulator_value)
+    )
+    return state._replace(table_opt=state.table_opt._replace(accum=packed_acc))
 
 
 def init_packed_state(
-    model, key: jax.Array, init_accumulator_value: float = 0.1
+    model,
+    key: jax.Array,
+    init_accumulator_value: float = 0.1,
+    accumulator: str = "element",
 ) -> TrainState:
-    """init_state with the table and (element) accumulator lane-packed.
+    """init_state with the table and accumulator lane-packed.
 
     The packed layout keeps the logical init EXACTLY (pack of the same
     init_table draw), so packed and rows runs start from identical
-    parameters."""
+    parameters.  ``accumulator`` follows init_state: ``element`` packs
+    [V, D] → [VP, 128]; ``row`` packs [V, 1] → [VP, P] (dense-G update
+    only — see ops.packed_table.resolve_packed_update)."""
     return pack_state(
-        init_state(model, key, init_accumulator_value, "element"),
+        init_state(model, key, init_accumulator_value, accumulator),
         init_accumulator_value,
     )
 
 
-def packed_train_step_body(model, learning_rate: float, state: TrainState, batch: Batch):
+def packed_train_step_body(
+    model, learning_rate: float, state: TrainState, batch: Batch,
+    update: str = "auto",
+):
     """train_step_body on a lane-packed table: identical math, tile-row
     physical movement (the narrow-scatter cliff fix — DESIGN §6).
-    Shared by make_packed_train_step and the device-cache step."""
+    Shared by make_packed_train_step and the device-cache step.
+
+    ``update`` picks the sparse-tail strategy (resolve_packed_update):
+    ``dense`` — one wide scatter-add into a [VP, 128] gradient buffer +
+    a dense Adagrad sweep (measured 3.5× the sorted path at vocab 2^24);
+    ``sorted`` — sort/segment-sum/RMW, no table-sized temporary (the
+    giant-vocab fallback); ``auto`` — dense under DENSE_G_MAX_BYTES."""
     from fast_tffm_tpu.ops.packed_table import (
+        packed_dense_adagrad_update,
         packed_gather,
         packed_sparse_adagrad_update,
+        resolve_packed_update,
     )
 
     d = model.row_dim
@@ -183,8 +207,14 @@ def packed_train_step_body(model, learning_rate: float, state: TrainState, batch
     )
     (_, data_loss), (g_rows, g_dense) = grad_fn(rows, state.dense, batch)
 
-    table, accum = packed_sparse_adagrad_update(
-        state.table, state.table_opt.accum, batch.ids, g_rows, learning_rate
+    acc = state.table_opt.accum
+    mode = resolve_packed_update(update, state.table.shape[0], acc.shape[-1])
+    update_fn = (
+        packed_dense_adagrad_update if mode == "dense"
+        else packed_sparse_adagrad_update
+    )
+    table, accum = update_fn(
+        state.table, acc, batch.ids, g_rows, learning_rate
     )
     dense, dense_opt = state.dense, state.dense_opt
     if jax.tree.leaves(state.dense):
@@ -197,10 +227,10 @@ def packed_train_step_body(model, learning_rate: float, state: TrainState, batch
     )
 
 
-def make_packed_train_step(model, learning_rate: float):
+def make_packed_train_step(model, learning_rate: float, update: str = "auto"):
     @partial(jax.jit, donate_argnums=(0,))
     def step(state: TrainState, batch: Batch):
-        return packed_train_step_body(model, learning_rate, state, batch)
+        return packed_train_step_body(model, learning_rate, state, batch, update)
 
     return step
 

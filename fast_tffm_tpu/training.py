@@ -388,7 +388,11 @@ def train(cfg: Config, *, resume: bool = False, log=print):
     packed = cfg.table_layout == "packed"
     saveable = None
     if packed:
-        from fast_tffm_tpu.ops.packed_table import unpack_table
+        from fast_tffm_tpu.ops.packed_table import (
+            LANES,
+            unpack_accum_rows,
+            unpack_table,
+        )
         from fast_tffm_tpu.trainer import (
             init_packed_state,
             make_packed_predict_step,
@@ -399,12 +403,18 @@ def train(cfg: Config, *, resume: bool = False, log=print):
         v, d = model.vocabulary_size, model.row_dim
 
         def saveable(st):
-            # Checkpoints always hold the LOGICAL [V, D] arrays, so packed
-            # and rows runs restore each other's models freely.
+            # Checkpoints always hold the LOGICAL arrays ([V, D] table;
+            # [V, D] or [V, 1] accumulator by granularity), so packed and
+            # rows runs restore each other's models freely.
+            acc = st.table_opt.accum
             return st._replace(
                 table=unpack_table(st.table, v, d),
                 table_opt=st.table_opt._replace(
-                    accum=unpack_table(st.table_opt.accum, v, d)
+                    accum=(
+                        unpack_table(acc, v, d)
+                        if acc.shape[-1] == LANES
+                        else unpack_accum_rows(acc, v, d)
+                    )
                 ),
             )
 
@@ -417,17 +427,23 @@ def train(cfg: Config, *, resume: bool = False, log=print):
 
             logical = restore_checkpoint(
                 cfg.model_file,
-                init_state(model, jax.random.key(0), cfg.init_accumulator_value),
+                init_state(
+                    model, jax.random.key(0), cfg.init_accumulator_value,
+                    cfg.adagrad_accumulator,
+                ),
             )
             state = pack_state(logical, cfg.init_accumulator_value)
             log(f"resumed from {cfg.model_file} at step {int(state.step)} (packed)")
         else:
             state = init_packed_state(
-                model, jax.random.key(0), cfg.init_accumulator_value
+                model, jax.random.key(0), cfg.init_accumulator_value,
+                cfg.adagrad_accumulator,
             )
         predict_step = make_packed_predict_step(model)
-        step_body = packed_train_step_body
-        step_fn = make_packed_train_step(model, cfg.learning_rate)
+        step_body = lambda mdl, lr, st, b: packed_train_step_body(
+            mdl, lr, st, b, cfg.packed_update
+        )
+        step_fn = make_packed_train_step(model, cfg.learning_rate, cfg.packed_update)
     else:
         state = init_state(
             model, jax.random.key(0), cfg.init_accumulator_value, cfg.adagrad_accumulator
@@ -605,7 +621,8 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
         logical = restore_checkpoint(
             cfg.model_file,
             init_sharded_state(
-                model, mesh, jax.random.key(0), cfg.init_accumulator_value
+                model, mesh, jax.random.key(0), cfg.init_accumulator_value,
+                cfg.adagrad_accumulator,
             ),
         )
         state = pack_logical_to_sharded(
@@ -624,6 +641,7 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
         model, cfg.learning_rate, mesh,
         lookup=cfg.lookup, capacity_factor=cfg.lookup_capacity_factor,
         overflow_mode=cfg.lookup_overflow, table_layout=cfg.table_layout,
+        packed_update=cfg.packed_update,
     )
     predict_step = make_sharded_predict_step(
         model, mesh, lookup=cfg.lookup, capacity_factor=cfg.lookup_capacity_factor,

@@ -16,11 +16,15 @@ import pytest
 from fast_tffm_tpu.models import Batch, DeepFMModel, FFMModel, FMModel
 from fast_tffm_tpu.ops.packed_table import (
     LANES,
+    pack_accum_rows,
     pack_table,
+    packed_dense_adagrad_update,
     packed_gather,
     packed_rows,
     packed_sparse_adagrad_update,
+    resolve_packed_update,
     rows_per_tile,
+    unpack_accum_rows,
     unpack_table,
 )
 from fast_tffm_tpu.trainer import (
@@ -99,8 +103,88 @@ def test_packed_update_exact_vs_rows_layout():
     )
 
 
+def test_packed_dense_update_exact_vs_rows_layout():
+    """The DENSE-G update (wide scatter-add + dense Adagrad sweep) is
+    bit-identical to the rows-layout update: scatter-add sums duplicate
+    occurrences in flat order — the same order the stable-sorted
+    segment-sum uses — and untouched elements see the exact zero-grad
+    identity through the dense sweep."""
+    from fast_tffm_tpu.optim import AdagradState, sparse_adagrad_update
+
+    rng = np.random.default_rng(21)
+    d = 9
+    t = jnp.asarray(rng.normal(size=(V, d)).astype(np.float32))
+    acc = jnp.full((V, d), 0.1, jnp.float32)
+    ids = jnp.asarray(
+        np.concatenate([rng.integers(0, V, 150), [7, 7, 7]]).astype(np.int32)
+    )
+    g = jnp.asarray(rng.normal(size=(ids.shape[0], d)).astype(np.float32))
+
+    t2, st2 = sparse_adagrad_update(t, AdagradState(acc), ids, g, 0.1)
+    tp2, ap2 = packed_dense_adagrad_update(
+        pack_table(t), pack_table(acc), ids, g, 0.1
+    )
+    np.testing.assert_array_equal(np.asarray(unpack_table(tp2, V, d)), np.asarray(t2))
+    np.testing.assert_array_equal(
+        np.asarray(unpack_table(ap2, V, d)), np.asarray(st2.accum)
+    )
+    untouched = np.setdiff1d(np.arange(V), np.asarray(ids))
+    np.testing.assert_array_equal(
+        np.asarray(unpack_table(tp2, V, d))[untouched], np.asarray(t)[untouched]
+    )
+
+
+def test_packed_dense_update_row_accumulator():
+    """Dense-G with the ROW-granularity accumulator ([VP, P] scalar
+    slots) matches the rows-layout row-mode update bit-for-bit, and the
+    accumulator pack/unpack round-trips."""
+    from fast_tffm_tpu.optim import AdagradState, sparse_adagrad_update
+
+    rng = np.random.default_rng(22)
+    for d in (5, 9, 89):  # P = 25, 14, 1
+        t = jnp.asarray(rng.normal(size=(V, d)).astype(np.float32))
+        acc = jnp.full((V, 1), 0.1, jnp.float32)
+        ids = jnp.asarray(
+            np.concatenate([rng.integers(0, V, 80), [3, 3, 3]]).astype(np.int32)
+        )
+        g = jnp.asarray(rng.normal(size=(ids.shape[0], d)).astype(np.float32))
+
+        packed_acc = pack_accum_rows(acc, d, 0.1)
+        np.testing.assert_array_equal(
+            np.asarray(unpack_accum_rows(packed_acc, V, d)), np.asarray(acc)
+        )
+
+        t2, st2 = sparse_adagrad_update(t, AdagradState(acc), ids, g, 0.1)
+        tp2, ap2 = packed_dense_adagrad_update(
+            pack_table(t), packed_acc, ids, g, 0.1
+        )
+        np.testing.assert_array_equal(
+            np.asarray(unpack_table(tp2, V, d)), np.asarray(t2)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(unpack_accum_rows(ap2, V, d)), np.asarray(st2.accum)
+        )
+
+
+def test_resolve_packed_update():
+    import fast_tffm_tpu.ops.packed_table as pt
+
+    small_vp = 1000
+    huge_vp = pt.DENSE_G_MAX_BYTES // (LANES * 4) + 1
+    assert resolve_packed_update("auto", small_vp, LANES) == "dense"
+    assert resolve_packed_update("auto", huge_vp, LANES) == "sorted"
+    assert resolve_packed_update("auto", huge_vp, 14) == "dense"  # row forces dense
+    assert resolve_packed_update("dense", huge_vp, LANES) == "dense"
+    assert resolve_packed_update("sorted", small_vp, LANES) == "sorted"
+    with pytest.raises(ValueError, match="element"):
+        resolve_packed_update("sorted", small_vp, 14)
+    with pytest.raises(ValueError, match="unknown"):
+        resolve_packed_update("fast", small_vp, LANES)
+
+
+@pytest.mark.parametrize("update", ["dense", "sorted"])
 @pytest.mark.parametrize("family", ["fm2", "fm3", "ffm", "deepfm"])
-def test_packed_training_matches_rows_layout(family):
+def test_packed_training_matches_rows_layout(family, update):
     model = {
         "fm2": FMModel(vocabulary_size=V, factor_num=4, order=2,
                        factor_lambda=1e-4, bias_lambda=1e-4),
@@ -115,7 +199,7 @@ def test_packed_training_matches_rows_layout(family):
     rs = init_state(model, jax.random.key(5))
     rstep = make_train_step(model, 0.05)
     ps = init_packed_state(model, jax.random.key(5))
-    pstep = make_packed_train_step(model, 0.05)
+    pstep = make_packed_train_step(model, 0.05, update)
 
     for b in batches:
         rs, rloss = rstep(rs, b)
@@ -223,18 +307,55 @@ def test_packed_driver_and_checkpoint_interop(tmp_path):
     np.testing.assert_allclose(s_x, s_p, rtol=1e-6)
 
 
-def test_packed_requires_element_accumulator():
+def test_packed_row_accumulator_config_rules():
+    """packed + row accumulator is allowed (dense-G handles it) EXCEPT
+    under the sorted update, whose whole-tile-row RMW needs the element
+    accumulator's per-lane zero-grad identity."""
     from fast_tffm_tpu.config import Config
 
+    Config(table_layout="packed", adagrad_accumulator="row").validate()
+    Config(
+        table_layout="packed", adagrad_accumulator="row", packed_update="dense"
+    ).validate()
     with pytest.raises(ValueError, match="element"):
-        Config(table_layout="packed", adagrad_accumulator="row").validate()
+        Config(
+            table_layout="packed", adagrad_accumulator="row",
+            packed_update="sorted",
+        ).validate()
+
+
+def test_packed_training_row_accumulator_matches_rows_layout():
+    """End-to-end: packed + row accumulator trains the SAME trajectory
+    as the rows layout with the row accumulator (the scale-regime
+    pairing — D×-smaller optimizer state on the fast layout)."""
+    model = FMModel(vocabulary_size=V, factor_num=4, order=2,
+                    factor_lambda=1e-4)
+    rng = np.random.default_rng(23)
+    batches = _batches(rng)
+    rs = init_state(model, jax.random.key(7), accumulator="row")
+    rstep = make_train_step(model, 0.05)
+    ps = init_packed_state(model, jax.random.key(7), accumulator="row")
+    pstep = make_packed_train_step(model, 0.05)
+    for b in batches:
+        rs, rloss = rstep(rs, b)
+        ps, ploss = pstep(ps, b)
+        np.testing.assert_allclose(float(ploss), float(rloss), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(unpack_table(ps.table, V, model.row_dim)),
+        np.asarray(rs.table), rtol=1e-6, atol=1e-7,
+    )
+    np.testing.assert_allclose(
+        np.asarray(unpack_accum_rows(ps.table_opt.accum, V, model.row_dim)),
+        np.asarray(rs.table_opt.accum), rtol=1e-6, atol=1e-7,
+    )
 
 
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs the 8-device CPU mesh")
+@pytest.mark.parametrize("update", ["dense", "sorted"])
 @pytest.mark.parametrize(
     "mesh_shape", [(1, 8), (2, 4), (8, 1)], ids=lambda s: f"data{s[0]}xrow{s[1]}"
 )
-def test_sharded_packed_matches_sharded_rows(mesh_shape):
+def test_sharded_packed_matches_sharded_rows(mesh_shape, update):
     """The mesh-sharded packed step reproduces the mesh-sharded rows
     step's trajectory (and both the single-device step's) — the packed
     layout changes shard-local physical movement only; the collectives
@@ -255,7 +376,9 @@ def test_sharded_packed_matches_sharded_rows(mesh_shape):
     rs = init_sharded_state(model, mesh, jax.random.key(9))
     rstep = make_sharded_train_step(model, 0.1, mesh)
     ps = init_sharded_state(model, mesh, jax.random.key(9), table_layout="packed")
-    pstep = make_sharded_train_step(model, 0.1, mesh, table_layout="packed")
+    pstep = make_sharded_train_step(
+        model, 0.1, mesh, table_layout="packed", packed_update=update
+    )
 
     for b in batches:
         rs, rloss = rstep(rs, b)
@@ -277,6 +400,77 @@ def test_sharded_packed_matches_sharded_rows(mesh_shape):
         np.asarray(ppred(ps, batches[0])),
         np.asarray(rpred(rs, batches[0])),
         rtol=1e-5,
+    )
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs the 8-device CPU mesh")
+def test_sharded_packed_dense_bitwise_matches_local_dense():
+    """The sharded dense-G step sums occurrences in GLOBAL flat order —
+    exactly the single-device dense step's order — so the two are
+    bit-identical on the same global batch (a stronger pin than the
+    rows-layout allclose)."""
+    from fast_tffm_tpu.parallel import (
+        init_sharded_state,
+        make_mesh,
+        make_sharded_train_step,
+        unpack_sharded_to_logical,
+    )
+
+    model = FMModel(vocabulary_size=V, factor_num=4, order=2)
+    mesh = make_mesh(2, 4)
+    rng = np.random.default_rng(24)
+    batches = _batches(rng, n=3)
+
+    ls = init_packed_state(model, jax.random.key(11))
+    lstep = make_packed_train_step(model, 0.05, "dense")
+    ss = init_sharded_state(model, mesh, jax.random.key(11), table_layout="packed")
+    sstep = make_sharded_train_step(
+        model, 0.05, mesh, table_layout="packed", packed_update="dense"
+    )
+    for b in batches:
+        ls, lloss = lstep(ls, b)
+        ss, sloss = sstep(ss, b)
+    logical_s = np.asarray(unpack_sharded_to_logical(ss, model, mesh).table)[:V]
+    logical_l = np.asarray(unpack_table(ls.table, V, model.row_dim))
+    np.testing.assert_array_equal(logical_s, logical_l)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs the 8-device CPU mesh")
+def test_sharded_packed_row_accumulator_matches_rows():
+    """packed + row accumulator through the MESH-SHARDED step tracks the
+    rows-layout row-accumulator sharded step, and the [VPs, P] shard
+    accumulator unpacks to the logical [V, 1]."""
+    from fast_tffm_tpu.parallel import (
+        init_sharded_state,
+        make_mesh,
+        make_sharded_train_step,
+        unpack_sharded_to_logical,
+    )
+
+    model = FMModel(vocabulary_size=V, factor_num=4, order=2)
+    mesh = make_mesh(2, 4)
+    rng = np.random.default_rng(25)
+    batches = _batches(rng, n=3)
+
+    rs = init_sharded_state(model, mesh, jax.random.key(12), accumulator="row")
+    rstep = make_sharded_train_step(model, 0.1, mesh)
+    ps = init_sharded_state(
+        model, mesh, jax.random.key(12), accumulator="row", table_layout="packed"
+    )
+    pstep = make_sharded_train_step(model, 0.1, mesh, table_layout="packed")
+    for b in batches:
+        rs, rloss = rstep(rs, b)
+        ps, ploss = pstep(ps, b)
+        np.testing.assert_allclose(float(ploss), float(rloss), rtol=1e-5)
+    un = unpack_sharded_to_logical(ps, model, mesh)
+    np.testing.assert_allclose(
+        np.asarray(un.table)[:V], np.asarray(rs.table)[:V], rtol=1e-5, atol=1e-7
+    )
+    assert un.table_opt.accum.shape[-1] == 1
+    np.testing.assert_allclose(
+        np.asarray(un.table_opt.accum)[:V],
+        np.asarray(rs.table_opt.accum)[:V],
+        rtol=1e-5, atol=1e-7,
     )
 
 

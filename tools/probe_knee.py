@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Round-4 measurements: dense-G packed step across batch size (the
+knee — VERDICT r3 #4), accumulator granularity, and vocab scale.
+
+Value-synced interleaved windows throughout (bench.forced_sync).
+Prints one JSON dict; partial results flush on exit.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import _bench_watchdog
+
+_watchdog = _bench_watchdog.arm(seconds=3000, what="probe_knee.py")
+
+import jax
+import numpy as np
+
+from bench import forced_sync, make_batch, zipf_ids
+from fast_tffm_tpu.models import FMModel
+from fast_tffm_tpu.trainer import init_packed_state, make_packed_train_step
+
+NNZ = 39
+K = 8
+
+
+def measure_rate(step, state, batches, iters, batch_size, windows=3):
+    state, _ = step(state, batches[0])
+    forced_sync(state)
+    for i in range(1, 3):
+        state, _ = step(state, batches[i % len(batches)])
+    forced_sync(state)
+    best = float("inf")
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for i in range(iters):
+            state, _ = step(state, batches[i % len(batches)])
+        forced_sync(state)
+        best = min(best, time.perf_counter() - t0)
+    return state, batch_size * iters / best
+
+
+def main():
+    rng = np.random.default_rng(0)
+    res = {}
+    import atexit
+
+    atexit.register(lambda: print(json.dumps(res), flush=True))
+
+    # --- batch knee at vocab 2^24, element accumulator, dense update ---
+    vocab = 1 << 24
+    model = FMModel(vocabulary_size=vocab, factor_num=K, order=2)
+    knee = {}
+    for b in (16384, 65536, 262144):
+        try:
+            step = make_packed_train_step(model, 0.01, "dense")
+            batches = [
+                make_batch(zipf_ids(rng, (b, NNZ), vocab), 400 + i) for i in range(4)
+            ]
+            state = init_packed_state(model, jax.random.key(0))
+            iters = max(4, (1 << 21) // b)
+            state, rate = measure_rate(step, state, batches, iters, b)
+            knee[str(b)] = round(rate, 1)
+            del state, batches
+        except Exception as e:
+            knee[str(b)] = f"FAILED: {str(e)[:80]}"
+    res["knee_dense_vocab16m_exs"] = knee
+
+    # --- element vs row accumulator, dense update, interleaved ---
+    b = 16384
+    batches = [make_batch(zipf_ids(rng, (b, NNZ), vocab), 500 + i) for i in range(8)]
+    step_e = make_packed_train_step(model, 0.01, "dense")
+    step_r = make_packed_train_step(model, 0.01, "dense")
+    st_e = init_packed_state(model, jax.random.key(0))
+    st_r = init_packed_state(model, jax.random.key(0), accumulator="row")
+    for s, st in ((step_e, st_e), (step_r, st_r)):
+        st2, _ = s(st, batches[0])
+        forced_sync(st2)
+        if st is st_e:
+            st_e = st2
+        else:
+            st_r = st2
+    rates = {"element": [], "row": []}
+    for _ in range(4):
+        for name, s in (("element", step_e), ("row", step_r)):
+            st = st_e if name == "element" else st_r
+            t0 = time.perf_counter()
+            for i in range(10):
+                st, _ = s(st, batches[i % len(batches)])
+            forced_sync(st)
+            rates[name].append(b * 10 / (time.perf_counter() - t0))
+            if name == "element":
+                st_e = st
+            else:
+                st_r = st
+    res["accum_dense_vocab16m_exs"] = {
+        k: round(float(np.median(v)), 1) for k, v in rates.items()
+    }
+    del st_e, st_r, batches
+
+    # --- vocab scale with row accumulator (the scale pairing) ---
+    for vexp in (26, 27):
+        v = 1 << vexp
+        try:
+            m = FMModel(vocabulary_size=v, factor_num=K, order=2)
+            step = make_packed_train_step(m, 0.01, "dense")
+            bt = [make_batch(zipf_ids(rng, (b, NNZ), v), 600 + i) for i in range(4)]
+            st = init_packed_state(m, jax.random.key(0), accumulator="row")
+            st, rate = measure_rate(step, st, bt, 10, b)
+            res[f"dense_row_vocab2e{vexp}_exs"] = round(rate, 1)
+            del st, bt, step
+        except Exception as e:
+            res[f"dense_row_vocab2e{vexp}_exs"] = f"FAILED: {str(e)[:80]}"
+
+    _watchdog.cancel()
+
+
+if __name__ == "__main__":
+    main()
