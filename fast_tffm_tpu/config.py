@@ -30,8 +30,9 @@ class Config:
     vocabulary_block_num: int = 1  # reference key; default row_parallel
     hash_feature_id: bool = False
     table_layout: str = "rows"  # rows ([V,D]) | packed (lane-packed [V/P,128]
-    #   tile rows — fixes the partial-lane scatter cliff, DESIGN §6; element
-    #   accumulator + allgather lookup; dist shards it, single-process meshes)
+    #   tile rows — fixes the partial-lane scatter cliff, DESIGN §6; composes
+    #   with both accumulator granularities and both lookup collectives;
+    #   dist shards it, single-process meshes)
     model_file: str = "model.ckpt"
     checkpoint_format: str = "npz"  # npz | orbax (orbax = sharded, pod-scale)
     # [Train]

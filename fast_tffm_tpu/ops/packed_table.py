@@ -52,8 +52,10 @@ __all__ = [
     "pack_table",
     "pack_accum",
     "pack_accum_rows",
+    "pack_accum_any",
     "unpack_table",
     "unpack_accum_rows",
+    "unpack_accum_any",
     "packed_gather",
     "lane_spread",
     "packed_dense_grad",
@@ -266,17 +268,33 @@ def resolve_packed_update(update: str, vp: int, accum_trailing: int) -> str:
     auto: dense while the G buffer stays under DENSE_G_MAX_BYTES, else
     sorted.  A row-granularity accumulator forces dense (the sorted
     whole-tile-row RMW requires the element accumulator's zero-grad
-    identity per LANE; config.validate() enforces the same rule)."""
+    identity per LANE; config.validate() enforces the same rule) — and
+    because row mode has NO sorted fallback, 'auto' refuses loudly when
+    the G buffer would blow the ceiling instead of silently allocating a
+    table-sized transient in exactly the regime where the table barely
+    fits; pass packed_update='dense' explicitly to accept the memory."""
     if update not in ("auto", "dense", "sorted"):
         raise ValueError(f"unknown packed update {update!r} (auto | dense | sorted)")
     row_mode = accum_trailing != LANES
+    g_bytes = vp * LANES * 4
     if update == "sorted":
         if row_mode:
             raise ValueError("packed_update=sorted requires the element accumulator")
         return "sorted"
-    if update == "dense" or row_mode:
+    if update == "dense":
         return "dense"
-    return "dense" if vp * LANES * 4 <= DENSE_G_MAX_BYTES else "sorted"
+    if row_mode:
+        if g_bytes > DENSE_G_MAX_BYTES:
+            raise ValueError(
+                f"packed_update=auto with the row accumulator needs a dense "
+                f"[{vp}, {LANES}] gradient buffer ({g_bytes / 2**30:.1f} GiB > "
+                f"{DENSE_G_MAX_BYTES / 2**30:.0f} GiB ceiling) and row mode has "
+                "no sorted fallback — shard the table over more row-parallel "
+                "chips, use adagrad_accumulator=element, or set "
+                "packed_update=dense to accept the per-step buffer"
+            )
+        return "dense"
+    return "dense" if g_bytes <= DENSE_G_MAX_BYTES else "sorted"
 
 
 def pack_accum_rows(accum: jax.Array, d: int, init_value: float) -> jax.Array:
@@ -294,6 +312,28 @@ def unpack_accum_rows(acc_packed: jax.Array, vocab: int, d: int) -> jax.Array:
     """[VP, P] packed row accumulator -> [V, 1] logical."""
     p = rows_per_tile(d)
     return acc_packed.reshape(acc_packed.shape[0] * p, 1)[:vocab]
+
+
+def pack_accum_any(accum: jax.Array, d: int, init_value: float) -> jax.Array:
+    """Pack a LOGICAL accumulator of either granularity — [V, D] element
+    (→ [VP, 128]) or [V, 1] row (→ [VP, P]).  The trailing-dim sniff
+    lives HERE, next to the packers whose convention it encodes; callers
+    (trainer.pack_state, train_step.pack_logical_to_sharded, ...) must
+    not re-implement it."""
+    if accum.shape[-1] == 1:
+        return pack_accum_rows(accum, d, init_value)
+    return pack_accum(accum, init_value)
+
+
+def unpack_accum_any(acc_packed: jax.Array, vocab: int, d: int) -> jax.Array:
+    """Inverse of pack_accum_any: [VP, 128] → [V, D] or [VP, P] → [V, 1].
+
+    NOTE d == 1 makes P == LANES and the two conventions coincide — then
+    both branches compute the same reshape-and-slice, so the ambiguity is
+    harmless by construction, not by luck."""
+    if acc_packed.shape[-1] == LANES and rows_per_tile(d) != LANES:
+        return unpack_table(acc_packed, vocab, d)
+    return unpack_accum_rows(acc_packed, vocab, d)
 
 
 def packed_sparse_adagrad_update(

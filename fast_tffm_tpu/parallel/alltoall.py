@@ -98,16 +98,29 @@ def _bucketize(owner: jnp.ndarray, n_buckets: int, capacity: int):
     return order, sorted_owner, send_pos, in_cap, overflow
 
 
-def routed_gather(table_shard: jnp.ndarray, ids: jnp.ndarray, capacity: int) -> jnp.ndarray:
+def routed_gather(
+    table_shard: jnp.ndarray,
+    ids: jnp.ndarray,
+    capacity: int,
+    *,
+    d: int | None = None,
+    shard_logical_rows: int | None = None,
+) -> jnp.ndarray:
     """Assemble this chip's rows via all-to-all id routing.
 
-    table_shard: [V/R, D] contiguous row shard.
+    table_shard: [V/R, D] contiguous row shard — or, when ``d`` is given,
+                 a lane-packed [VPs, 128] shard (ops/packed_table.py) of
+                 ``shard_logical_rows`` logical rows.  The routing math is
+                 identical either way (ids are LOGICAL everywhere); only
+                 the local serve step reads the packed layout, via a wide
+                 full-tile-row gather instead of a narrow one.
     ids:         [B_local, N] global row ids for THIS chip's micro-batch.
     capacity:    static per-destination slot count (see capacity_for).
     Returns:     [B_local, N, D] rows (NaN-poisoned if any destination
                  overflowed its capacity — never silently wrong).
     """
-    shard_rows = table_shard.shape[0]
+    packed = d is not None
+    shard_rows = shard_logical_rows if packed else table_shard.shape[0]
     base = lax.axis_index(ROW_AXIS) * shard_rows
     R = lax.axis_size(ROW_AXIS)
     B, N = ids.shape
@@ -127,14 +140,21 @@ def routed_gather(table_shard: jnp.ndarray, ids: jnp.ndarray, capacity: int) -> 
     recv_ids = lax.all_to_all(send_ids, ROW_AXIS, 0, 0, tiled=True)  # [R, C]
     local = recv_ids - base
     ok = (local >= 0) & (local < shard_rows)  # sentinels fail
-    served = table_shard[jnp.where(ok, local, 0)] * ok[..., None].astype(table_shard.dtype)
+    safe = jnp.where(ok, local, 0)
+    if packed:
+        from fast_tffm_tpu.ops.packed_table import packed_gather
+
+        served = packed_gather(table_shard, safe, d)
+    else:
+        served = table_shard[safe]
+    served = served * ok[..., None].astype(served.dtype)
     recv_rows = lax.all_to_all(served, ROW_AXIS, 0, 0, tiled=True)  # [R, C, D]
 
     # recv_rows[s, c] answers MY request in send slot [s, c]; invert the
     # bucket placement, then the sort.
     mine_sorted = recv_rows[sorted_owner, jnp.minimum(send_pos, capacity - 1)]
     mine_sorted = mine_sorted * in_cap[:, None].astype(mine_sorted.dtype)
-    out = jnp.zeros((M, table_shard.shape[-1]), table_shard.dtype).at[order].set(mine_sorted)
+    out = jnp.zeros((M, served.shape[-1]), served.dtype).at[order].set(mine_sorted)
     out = jnp.where(overflow, jnp.nan, out)
     return out.reshape(B, N, -1)
 
@@ -147,9 +167,18 @@ def routed_update(
     lr: float,
     num_rows_global: int,
     capacity: int,
+    *,
+    shard_logical_rows: int | None = None,
+    packed_mode: str | None = None,
 ):
     """Sparse Adagrad update via routed gradients (the all-to-all analog of
     ``embedding.sharded_sparse_adagrad_update``).
+
+    When ``shard_logical_rows`` is given the shards are LANE-PACKED
+    ([VPs, 128] — ops/packed_table.py) and ``packed_mode`` picks the
+    packed tail ('dense' | 'sorted'); the routing itself is unchanged
+    (deduped logical ids + summed grads ride the same all_to_all), only
+    the final per-shard apply reads/writes the packed layout.
 
     Per chip: dedup local occurrences, route each (id, summed grad) to its
     home shard over ROW (all_to_all, capacity C per destination), then
@@ -167,8 +196,14 @@ def routed_update(
     """
     from fast_tffm_tpu.optim import dedup_rows
 
-    D = table_shard.shape[-1]
-    shard_rows = table_shard.shape[0]
+    packed = shard_logical_rows is not None
+    if packed and packed_mode not in ("dense", "sorted"):
+        raise ValueError(
+            f"packed routed_update needs packed_mode 'dense' or 'sorted', "
+            f"got {packed_mode!r} (pass resolve_packed_update's result)"
+        )
+    D = row_grads.shape[-1]
+    shard_rows = shard_logical_rows if packed else table_shard.shape[0]
     base = lax.axis_index(ROW_AXIS) * shard_rows
     R = lax.axis_size(ROW_AXIS)
     uids, gsum = dedup_rows(ids.reshape(-1), row_grads.reshape(-1, D), num_rows_global)
@@ -193,10 +228,30 @@ def routed_update(
     all_g = lax.all_gather(recv_g.reshape(-1, D), DATA_AXIS, tiled=True)
     guids, ggsum = dedup_rows(all_ids, all_g, num_rows_global)
 
-    from fast_tffm_tpu.parallel.embedding import apply_shard_adagrad
+    if packed:
+        from fast_tffm_tpu.ops.packed_table import (
+            packed_dense_adagrad_update,
+            packed_sparse_adagrad_update,
+            rows_per_tile,
+        )
+        from fast_tffm_tpu.parallel.embedding import owned_local_ids
 
-    table_shard, accum_shard = apply_shard_adagrad(
-        table_shard, accum_shard, guids, ggsum, lr, base
-    )
+        p = rows_per_tile(D)
+        # Unowned and sentinel ids map past the last physical row → drop.
+        local, _ = owned_local_ids(guids, shard_rows, table_shard.shape[0] * p)
+        update_fn = (
+            packed_dense_adagrad_update
+            if packed_mode == "dense"
+            else packed_sparse_adagrad_update  # packed_mode == 'sorted'
+        )
+        table_shard, accum_shard = update_fn(
+            table_shard, accum_shard, local, ggsum, lr
+        )
+    else:
+        from fast_tffm_tpu.parallel.embedding import apply_shard_adagrad
+
+        table_shard, accum_shard = apply_shard_adagrad(
+            table_shard, accum_shard, guids, ggsum, lr, base
+        )
     overflow = lax.psum(overflow.astype(jnp.int32), (DATA_AXIS, ROW_AXIS)) > 0
     return table_shard, accum_shard, overflow

@@ -179,11 +179,7 @@ def pack_logical_to_sharded(
     Shared by dist_train's packed resume and dist_predict's packed path."""
     import numpy as np
 
-    from fast_tffm_tpu.ops.packed_table import (
-        pack_accum,
-        pack_accum_rows,
-        pack_table,
-    )
+    from fast_tffm_tpu.ops.packed_table import pack_accum_any, pack_table
 
     padded, _, _ = packed_shard_meta(model, mesh)
     d = model.row_dim
@@ -194,11 +190,7 @@ def pack_logical_to_sharded(
     ext_t[: lt.shape[0]] = lt
     ext_a = np.full((vp_logical, la.shape[-1]), init_accumulator_value, la.dtype)
     ext_a[: la.shape[0]] = la
-    packed_acc = (
-        pack_accum_rows(jnp.asarray(ext_a), d, init_accumulator_value)
-        if la.shape[-1] == 1
-        else pack_accum(jnp.asarray(ext_a), init_accumulator_value)
-    )
+    packed_acc = pack_accum_any(jnp.asarray(ext_a), d, init_accumulator_value)
     ts = table_sharding(mesh)
     rep = replicated(mesh)
     return TrainState(
@@ -215,43 +207,53 @@ def unpack_sharded_to_logical(state: TrainState, model, mesh: Mesh) -> TrainStat
     (per-shard unpack; checkpoints always hold the logical layout)."""
     import numpy as np
 
-    from fast_tffm_tpu.ops.packed_table import LANES, unpack_accum_rows, unpack_table
+    from fast_tffm_tpu.ops.packed_table import unpack_accum_any, unpack_table
 
     _, shard_logical, p = packed_shard_meta(model, mesh)
     R = mesh.shape[ROW_AXIS]
     d = model.row_dim
 
-    def unp(arr):
+    def unp(arr, unpack):
         a = np.asarray(arr)
         per = a.shape[0] // R
-        unpack = (
-            unpack_table
-            if a.shape[-1] == LANES
-            else unpack_accum_rows  # [VPs, P] row accumulator -> [V, 1]
-        )
         return np.concatenate([
             np.asarray(unpack(jnp.asarray(a[r * per : (r + 1) * per]), shard_logical, d))
             for r in range(R)
         ])
 
     return state._replace(
-        table=unp(state.table),
-        table_opt=state.table_opt._replace(accum=unp(state.table_opt.accum)),
+        table=unp(state.table, unpack_table),
+        table_opt=state.table_opt._replace(
+            accum=unp(state.table_opt.accum, unpack_accum_any)
+        ),
     )
 
 
-def _make_gather(mesh: Mesh, local_ids_shape, lookup: str, capacity_factor: float):
+def _make_gather(
+    mesh: Mesh, local_ids_shape, lookup: str, capacity_factor: float,
+    packed_meta=None,
+):
     """Pick the lookup collective: all-gather (default) or all-to-all routing.
 
     ``local_ids_shape`` is the PER-CHIP [B_local, N] shape (this is called
-    from inside the shard_map body at trace time).  Returns
-    ``(gather_fn, capacity, can_overflow)`` — capacity is None on the
-    all-gather path and is THE single sizing both all-to-all directions
-    share (the routed update must use the same value); ``can_overflow``
-    is False when the capacity caps at M = ids-per-chip (every id fits
-    one bucket, so overflow is statically impossible and callers may skip
-    the per-step routing_overflow check and its lax.cond dual-compile)."""
+    from inside the shard_map body at trace time).  ``packed_meta`` is
+    ``(d_row, shard_logical_rows)`` when the shards are lane-packed —
+    routing is identical, only the local serve reads the packed layout.
+    Returns ``(gather_fn, capacity, can_overflow)`` — capacity is None on
+    the all-gather path and is THE single sizing both all-to-all
+    directions share (the routed update must use the same value);
+    ``can_overflow`` is False when the capacity caps at M = ids-per-chip
+    (every id fits one bucket, so overflow is statically impossible and
+    callers may skip the per-step routing_overflow check and its lax.cond
+    dual-compile)."""
     if lookup == "allgather":
+        if packed_meta is not None:
+            from fast_tffm_tpu.parallel.embedding import packed_sharded_gather
+
+            d_row, slr = packed_meta
+            return (
+                lambda table, ids: packed_sharded_gather(table, ids, d_row, slr)
+            ), None, False
         return sharded_gather, None, False
     if lookup != "alltoall":
         raise ValueError(f"unknown lookup {lookup!r} (allgather | alltoall)")
@@ -260,6 +262,13 @@ def _make_gather(mesh: Mesh, local_ids_shape, lookup: str, capacity_factor: floa
     b_local, n = local_ids_shape
     m = b_local * n
     cap = capacity_for(m, mesh.shape[ROW_AXIS], capacity_factor)
+    if packed_meta is not None:
+        d_row, slr = packed_meta
+        return (
+            lambda table, ids: routed_gather(
+                table, ids, cap, d=d_row, shard_logical_rows=slr
+            )
+        ), cap, cap < m
     return (lambda table, ids: routed_gather(table, ids, cap)), cap, cap < m
 
 
@@ -295,8 +304,6 @@ def make_sharded_train_step(
     """
     packed = table_layout == "packed"
     if packed:
-        if lookup != "allgather":
-            raise ValueError("table_layout=packed supports lookup=allgather only")
         from fast_tffm_tpu.ops.packed_table import rows_per_tile
 
         model = _pad_model_vocab(model, mesh, pack=rows_per_tile(model.row_dim))
@@ -308,13 +315,14 @@ def make_sharded_train_step(
     if overflow_mode not in ("abort", "fallback"):
         raise ValueError(f"unknown overflow_mode {overflow_mode!r} (abort | fallback)")
     fallback = lookup == "alltoall" and overflow_mode == "fallback"
+    packed_meta = (d_row, shard_logical_rows) if packed else None
 
     def shard_body(table, accum, dense, dense_acc, batch: Batch):
         # Built per trace: the capacity is sized from THIS trace's batch
         # shape (a cached closure would pin a stale capacity across jit
         # retraces with bigger batches and spuriously overflow).
         gather, cap, can_overflow = _make_gather(
-            mesh, batch.ids.shape, lookup, capacity_factor
+            mesh, batch.ids.shape, lookup, capacity_factor, packed_meta
         )
 
         def loss_fn(rows, dense):
@@ -371,10 +379,22 @@ def make_sharded_train_step(
             def routed_branch():
                 rows = gather(table, batch.ids)
                 (_, dl), (g_rows, g_dense) = grad_fn(rows, dense)
-                t2, a2, overflow = routed_update(
-                    table, accum, batch.ids, g_rows, learning_rate,
-                    num_rows_global, cap,
-                )
+                if packed:
+                    from fast_tffm_tpu.ops.packed_table import resolve_packed_update
+
+                    pmode = resolve_packed_update(
+                        packed_update, table.shape[0], accum.shape[-1]
+                    )
+                    t2, a2, overflow = routed_update(
+                        table, accum, batch.ids, g_rows, learning_rate,
+                        num_rows_global, cap,
+                        shard_logical_rows=shard_logical_rows, packed_mode=pmode,
+                    )
+                else:
+                    t2, a2, overflow = routed_update(
+                        table, accum, batch.ids, g_rows, learning_rate,
+                        num_rows_global, cap,
+                    )
                 if not fallback:
                     # A dropped contribution must never persist silently:
                     # NaN the loss so the training loop aborts before
@@ -386,7 +406,10 @@ def make_sharded_train_step(
             # alone — no bincount, no dual compile (HLO-pinned by
             # test_impossible_overflow_skips_cond).
             if fallback and can_overflow:
-                overflowed = routing_overflow(batch.ids, table.shape[0], cap)
+                # shard_logical_rows == table.shape[0] for the rows layout;
+                # for packed shards the table's leading dim is PHYSICAL, so
+                # the closure's logical count is the correct one either way.
+                overflowed = routing_overflow(batch.ids, shard_logical_rows, cap)
                 table, accum, g_dense, data_loss_local = lax.cond(
                     overflowed, allgather_branch, routed_branch
                 )
@@ -448,8 +471,6 @@ def make_sharded_predict_step(
     scores — same ``lax.cond`` scheme as the train step."""
     packed = table_layout == "packed"
     if packed:
-        if lookup != "allgather":
-            raise ValueError("table_layout=packed supports lookup=allgather only")
         from fast_tffm_tpu.ops.packed_table import rows_per_tile
 
         model = _pad_model_vocab(model, mesh, pack=rows_per_tile(model.row_dim))
@@ -458,23 +479,25 @@ def make_sharded_predict_step(
     shard_logical_rows = model.vocabulary_size // mesh.shape[ROW_AXIS]
     d_row = model.row_dim
     fallback = lookup == "alltoall" and overflow_mode == "fallback"
+    packed_meta = (d_row, shard_logical_rows) if packed else None
 
     def shard_body(table, dense, batch: Batch):
         gather, cap, can_overflow = _make_gather(
-            mesh, batch.ids.shape, lookup, capacity_factor
+            mesh, batch.ids.shape, lookup, capacity_factor, packed_meta
         )
         if fallback and can_overflow:
             from fast_tffm_tpu.parallel.alltoall import routing_overflow
 
+            # The allgather fallback is exactly _make_gather's allgather
+            # selection (packed-aware) — build it there, not by hand.
+            ag_gather, _, _ = _make_gather(
+                mesh, batch.ids.shape, "allgather", capacity_factor, packed_meta
+            )
             rows = lax.cond(
-                routing_overflow(batch.ids, table.shape[0], cap),
-                lambda: sharded_gather(table, batch.ids),
+                routing_overflow(batch.ids, shard_logical_rows, cap),
+                lambda: ag_gather(table, batch.ids),
                 lambda: gather(table, batch.ids),
             )
-        elif packed:
-            from fast_tffm_tpu.parallel.embedding import packed_sharded_gather
-
-            rows = packed_sharded_gather(table, batch.ids, d_row, shard_logical_rows)
         else:
             rows = gather(table, batch.ids)
         scores = jax.nn.sigmoid(model.score(rows, dense, batch))

@@ -143,20 +143,11 @@ def pack_state(state: TrainState, init_accumulator_value: float = 0.1) -> TrainS
     init, resume, and the packed predict driver.  Packs ONE array at a
     time, dropping each logical original before the next — the transient
     device-memory peak is what OOMs big vocabs on a shared chip."""
-    from fast_tffm_tpu.ops.packed_table import (
-        pack_accum,
-        pack_accum_rows,
-        pack_table,
-    )
+    from fast_tffm_tpu.ops.packed_table import pack_accum_any, pack_table
 
     d = state.table.shape[-1]
     state = state._replace(table=pack_table(state.table))
-    acc = state.table_opt.accum
-    packed_acc = (
-        pack_accum_rows(acc, d, init_accumulator_value)
-        if acc.shape[-1] == 1
-        else pack_accum(acc, init_accumulator_value)
-    )
+    packed_acc = pack_accum_any(state.table_opt.accum, d, init_accumulator_value)
     return state._replace(table_opt=state.table_opt._replace(accum=packed_acc))
 
 

@@ -388,11 +388,7 @@ def train(cfg: Config, *, resume: bool = False, log=print):
     packed = cfg.table_layout == "packed"
     saveable = None
     if packed:
-        from fast_tffm_tpu.ops.packed_table import (
-            LANES,
-            unpack_accum_rows,
-            unpack_table,
-        )
+        from fast_tffm_tpu.ops.packed_table import unpack_accum_any, unpack_table
         from fast_tffm_tpu.trainer import (
             init_packed_state,
             make_packed_predict_step,
@@ -406,15 +402,10 @@ def train(cfg: Config, *, resume: bool = False, log=print):
             # Checkpoints always hold the LOGICAL arrays ([V, D] table;
             # [V, D] or [V, 1] accumulator by granularity), so packed and
             # rows runs restore each other's models freely.
-            acc = st.table_opt.accum
             return st._replace(
                 table=unpack_table(st.table, v, d),
                 table_opt=st.table_opt._replace(
-                    accum=(
-                        unpack_table(acc, v, d)
-                        if acc.shape[-1] == LANES
-                        else unpack_accum_rows(acc, v, d)
-                    )
+                    accum=unpack_accum_any(st.table_opt.accum, v, d)
                 ),
             )
 

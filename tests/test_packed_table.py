@@ -173,7 +173,14 @@ def test_resolve_packed_update():
     huge_vp = pt.DENSE_G_MAX_BYTES // (LANES * 4) + 1
     assert resolve_packed_update("auto", small_vp, LANES) == "dense"
     assert resolve_packed_update("auto", huge_vp, LANES) == "sorted"
-    assert resolve_packed_update("auto", huge_vp, 14) == "dense"  # row forces dense
+    assert resolve_packed_update("auto", small_vp, 14) == "dense"  # row forces dense
+    # Row mode has no sorted fallback: auto REFUSES past the G ceiling
+    # (silently allocating a table-sized transient in the one regime
+    # where the table barely fits would be an OOM trap); explicit
+    # 'dense' accepts the buffer.
+    with pytest.raises(ValueError, match="no sorted fallback"):
+        resolve_packed_update("auto", huge_vp, 14)
+    assert resolve_packed_update("dense", huge_vp, 14) == "dense"
     assert resolve_packed_update("dense", huge_vp, LANES) == "dense"
     assert resolve_packed_update("sorted", small_vp, LANES) == "sorted"
     with pytest.raises(ValueError, match="element"):
@@ -474,15 +481,137 @@ def test_sharded_packed_row_accumulator_matches_rows():
     )
 
 
-def test_sharded_packed_rejects_alltoall():
-    from fast_tffm_tpu.parallel import make_mesh, make_sharded_train_step
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs the 8-device CPU mesh")
+@pytest.mark.parametrize(
+    "mesh_shape", [(1, 8), (2, 4)], ids=lambda s: f"data{s[0]}xrow{s[1]}"
+)
+@pytest.mark.parametrize("packed_update", ["dense", "sorted"])
+def test_sharded_packed_alltoall_matches_allgather(mesh_shape, packed_update):
+    """table_layout=packed composes with lookup=alltoall (VERDICT r3 #3):
+    the routed packed step tracks the allgather packed step — and hence
+    the rows layout — on both packed sparse-tail strategies, and the
+    routed packed predict matches."""
+    from fast_tffm_tpu.parallel import (
+        init_sharded_state,
+        make_mesh,
+        make_sharded_predict_step,
+        make_sharded_train_step,
+    )
 
-    model = FMModel(vocabulary_size=V, factor_num=4)
+    model = FMModel(vocabulary_size=V, factor_num=4, order=2)
+    mesh = make_mesh(*mesh_shape)
+    rng = np.random.default_rng(31)
+    batches = _batches(rng, n=3)
+
+    ag = init_sharded_state(model, mesh, jax.random.key(5), table_layout="packed")
+    ag_step = make_sharded_train_step(
+        model, 0.1, mesh, table_layout="packed", packed_update=packed_update
+    )
+    aa = init_sharded_state(model, mesh, jax.random.key(5), table_layout="packed")
+    aa_step = make_sharded_train_step(
+        model, 0.1, mesh, lookup="alltoall", table_layout="packed",
+        packed_update=packed_update,
+    )
+    for b in batches:
+        ag, ag_loss = ag_step(ag, b)
+        aa, aa_loss = aa_step(aa, b)
+        np.testing.assert_allclose(float(aa_loss), float(ag_loss), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(aa.table), np.asarray(ag.table), rtol=1e-5, atol=1e-7
+    )
+    np.testing.assert_allclose(
+        np.asarray(aa.table_opt.accum), np.asarray(ag.table_opt.accum),
+        rtol=1e-5, atol=1e-7,
+    )
+
+    ag_pred = make_sharded_predict_step(model, mesh, table_layout="packed")
+    aa_pred = make_sharded_predict_step(
+        model, mesh, lookup="alltoall", table_layout="packed"
+    )
+    np.testing.assert_allclose(
+        np.asarray(aa_pred(aa, batches[0])),
+        np.asarray(ag_pred(ag, batches[0])),
+        rtol=1e-5,
+    )
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs the 8-device CPU mesh")
+def test_sharded_packed_alltoall_row_accum_matches_rows_layout():
+    """packed + alltoall + ROW accumulator: the full scale-path stack
+    (fast layout, routed lookup, DX-smaller optimizer state) tracks the
+    plain rows-layout allgather step with the row accumulator."""
+    from fast_tffm_tpu.parallel import (
+        init_sharded_state,
+        make_mesh,
+        make_sharded_train_step,
+        unpack_sharded_to_logical,
+    )
+
+    model = FMModel(vocabulary_size=V, factor_num=4, order=2)
     mesh = make_mesh(2, 4)
-    with pytest.raises(ValueError, match="allgather"):
-        make_sharded_train_step(
-            model, 0.1, mesh, lookup="alltoall", table_layout="packed"
-        )
+    rng = np.random.default_rng(32)
+    batches = _batches(rng, n=3)
+
+    rs = init_sharded_state(model, mesh, jax.random.key(6), accumulator="row")
+    rstep = make_sharded_train_step(model, 0.1, mesh)
+    ps = init_sharded_state(
+        model, mesh, jax.random.key(6), accumulator="row", table_layout="packed"
+    )
+    pstep = make_sharded_train_step(
+        model, 0.1, mesh, lookup="alltoall", table_layout="packed"
+    )
+    for b in batches:
+        rs, rloss = rstep(rs, b)
+        ps, ploss = pstep(ps, b)
+        np.testing.assert_allclose(float(ploss), float(rloss), rtol=1e-5)
+    un = unpack_sharded_to_logical(ps, model, mesh)
+    np.testing.assert_allclose(
+        np.asarray(un.table)[:V], np.asarray(rs.table)[:V], rtol=1e-5, atol=1e-7
+    )
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs the 8-device CPU mesh")
+def test_sharded_packed_alltoall_overflow_fallback_matches():
+    """packed + alltoall under capacity pressure: the fallback lax.cond
+    reruns the packed allgather branch and the trajectory stays equal to
+    the pure-allgather packed run (skewed ids force real overflows)."""
+    from fast_tffm_tpu.parallel import (
+        init_sharded_state,
+        make_mesh,
+        make_sharded_train_step,
+    )
+
+    model = FMModel(vocabulary_size=V, factor_num=4, order=2)
+    mesh = make_mesh(2, 4)
+    rng = np.random.default_rng(33)
+    # Skew every id into one shard's range so some destination overflows.
+    import dataclasses
+
+    # Big enough that capacity_for's binomial-tail floor stays below M
+    # (tiny batches cap at C == M where overflow is impossible).
+    batches = _batches(rng, n=3, B=64, N=8)
+    batches = [
+        dataclasses.replace(b, ids=jnp.minimum(b.ids, 10).astype(jnp.int32))
+        for b in batches
+    ]
+
+    ag = init_sharded_state(model, mesh, jax.random.key(7), table_layout="packed")
+    ag_step = make_sharded_train_step(model, 0.1, mesh, table_layout="packed")
+    aa = init_sharded_state(model, mesh, jax.random.key(7), table_layout="packed")
+    aa_step = make_sharded_train_step(
+        model, 0.1, mesh, lookup="alltoall", table_layout="packed",
+        capacity_factor=0.25, overflow_mode="fallback",
+    )
+    overflowed_any = False
+    for b in batches:
+        ag, ag_loss = ag_step(ag, b)
+        aa, aa_loss, ovf = aa_step(aa, b)
+        overflowed_any = overflowed_any or bool(np.asarray(ovf))
+        np.testing.assert_allclose(float(aa_loss), float(ag_loss), rtol=1e-5)
+    assert overflowed_any, "test intended to exercise the overflow fallback"
+    np.testing.assert_allclose(
+        np.asarray(aa.table), np.asarray(ag.table), rtol=1e-5, atol=1e-7
+    )
 
 
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs the 8-device CPU mesh")

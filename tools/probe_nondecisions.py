@@ -1,0 +1,311 @@
+#!/usr/bin/env python
+"""Round-4 re-measurement of the artifact-era non-decisions (VERDICT r3 #2).
+
+Every DESIGN §6/§8.5 bullet measured before the round-3 methodology
+correction is re-stamped here with value-synced, same-window INTERLEAVED
+A/B timing (bench.forced_sync closes every window):
+
+  1. bf16 parameter table — rows layout (the original "2× slower" claim)
+     and the packed layout, where bf16 halves table bytes on both the
+     wide gather and the dense Adagrad sweep.
+  2. dedup-before-forward-gather — plus the structural note: under jit
+     the unique-row count must be a STATIC shape, so "gather fewer rows"
+     is only realizable as gather-same-count-sorted; the measurable
+     lever is sorted-id locality, which is what we time.
+  3. [V, 2D] (and packed [VP, 256]) table+accum interleave for the
+     sorted sparse tail's RMW.
+  4. XLA wide-gather effective bandwidth (the "Pallas gather has no
+     headroom" input: if XLA's gather already rides the HBM roof there
+     is no headroom; if not, the gap IS the Pallas headroom).
+
+Prints one JSON dict; partial results flush on exit.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import _bench_watchdog
+
+_watchdog = _bench_watchdog.arm(seconds=3000, what="probe_nondecisions.py")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from functools import partial
+
+from bench import forced_sync, make_batch, zipf_ids
+from fast_tffm_tpu.models import FMModel
+from fast_tffm_tpu.optim import AdagradState, sparse_adagrad_update
+from fast_tffm_tpu.ops.packed_table import (
+    LANES,
+    packed_dense_adagrad_update,
+    packed_gather,
+    rows_per_tile,
+)
+from fast_tffm_tpu.trainer import (
+    TrainState,
+    batch_loss,
+    init_packed_state,
+    make_packed_train_step,
+)
+
+NNZ = 39
+K = 8
+B = 16384
+
+
+def _sync(state):
+    """forced_sync for TrainState OR (table, ...) tuples: value-fetch a
+    slice of the first table-like array so the chained updates must have
+    landed (bench.forced_sync rationale)."""
+    t = state.table if hasattr(state, "table") else state[0]
+    return float(jnp.sum(jax.lax.dynamic_slice_in_dim(t, 0, 2, axis=0)))
+
+
+def interleaved(step_a, state_a, step_b, state_b, batches, iters, rounds=5):
+    """Median per-step seconds for A and B, timed in ALTERNATING windows
+    of the same session (A B A B ...), each window closed by a value
+    fetch that depends on the final table (forced_sync)."""
+    state_a, _ = step_a(state_a, batches[0])
+    _sync(state_a)
+    state_b, _ = step_b(state_b, batches[0])
+    _sync(state_b)
+    ta, tb = [], []
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        for i in range(iters):
+            state_a, _ = step_a(state_a, batches[i % len(batches)])
+        _sync(state_a)
+        ta.append((time.perf_counter() - t0) / iters)
+        t0 = time.perf_counter()
+        for i in range(iters):
+            state_b, _ = step_b(state_b, batches[i % len(batches)])
+        _sync(state_b)
+        tb.append((time.perf_counter() - t0) / iters)
+    return float(np.median(ta)), float(np.median(tb)), state_a, state_b
+
+
+def main():
+    rng = np.random.default_rng(0)
+    res = {"device": jax.devices()[0].device_kind}
+    import atexit
+
+    atexit.register(lambda: print(json.dumps(res), flush=True))
+
+    # ---------------- 1a. bf16 table, rows layout ----------------
+    # Mini-step isolating what the original claim was about: the [V, D]
+    # gather + RMW sparse-Adagrad path with the table stored bf16 vs f32
+    # (accumulator stays f32 in both arms — Adagrad accumulation in bf16
+    # would change semantics, not just layout).
+    vocab = 1 << 20
+    d = 1 + K
+    key = jax.random.key(0)
+    table_f32 = jax.random.normal(key, (vocab, d), jnp.float32) * 0.01
+
+    def mini_step(state, batch, compute=jnp.float32):
+        table, acc = state
+        rows = table[batch.ids].astype(jnp.float32)  # [B, N, D]
+        g_rows = rows * batch.vals[..., None]  # cheap stand-in gradient
+        new_table, opt = sparse_adagrad_update(
+            table.astype(jnp.float32), AdagradState(acc), batch.ids, g_rows, 0.01
+        )
+        return (new_table.astype(table.dtype), opt.accum), jnp.sum(rows[0, 0])
+
+    step_f32 = jax.jit(partial(mini_step), donate_argnums=(0,))
+    step_bf16 = jax.jit(partial(mini_step), donate_argnums=(0,))
+    batches = [make_batch(zipf_ids(rng, (B, NNZ), vocab), i) for i in range(8)]
+    sa = (table_f32, jnp.full((vocab, d), 0.1, jnp.float32))
+    sb = (table_f32.astype(jnp.bfloat16), jnp.full((vocab, d), 0.1, jnp.float32))
+    f32_s, bf16_s, sa, sb = interleaved(step_f32, sa, step_bf16, sb, batches, 10)
+    res["rows_bf16"] = {
+        "f32_ms": round(f32_s * 1e3, 2),
+        "bf16_ms": round(bf16_s * 1e3, 2),
+        "bf16_over_f32": round(bf16_s / f32_s, 3),
+    }
+    del sa, sb
+
+    # ---------------- 1b. bf16 table, packed layout, dense update -------
+    # The packed table in bf16 halves the bytes of the wide forward
+    # gather AND the dense sweep's table read/write; G and the
+    # accumulator stay f32 (same Adagrad semantics).
+    vocab = 1 << 24
+    model = FMModel(vocabulary_size=vocab, factor_num=K, order=2)
+    batches = [make_batch(zipf_ids(rng, (B, NNZ), vocab), 100 + i) for i in range(8)]
+
+    def packed_bf16_body(state, batch):
+        rows = packed_gather(state.table, batch.ids, d).astype(jnp.float32)
+        grad_fn = jax.value_and_grad(
+            partial(batch_loss, model), argnums=(0, 1), has_aux=True
+        )
+        (_, data_loss), (g_rows, _) = grad_fn(rows, state.dense, batch)
+        table_f32, accum = packed_dense_adagrad_update(
+            state.table.astype(jnp.float32),
+            state.table_opt.accum,
+            batch.ids,
+            g_rows,
+            0.01,
+        )
+        return (
+            TrainState(
+                table_f32.astype(jnp.bfloat16),
+                AdagradState(accum),
+                state.dense,
+                state.dense_opt,
+                state.step + 1,
+            ),
+            data_loss,
+        )
+
+    step_f32 = make_packed_train_step(model, 0.01, "dense")
+    step_bf16 = jax.jit(packed_bf16_body, donate_argnums=(0,))
+    sa = init_packed_state(model, jax.random.key(0))
+    sb0 = init_packed_state(model, jax.random.key(0))
+    sb = TrainState(
+        sb0.table.astype(jnp.bfloat16),
+        sb0.table_opt,
+        sb0.dense,
+        sb0.dense_opt,
+        sb0.step,
+    )
+    del sb0
+    f32_s, bf16_s, sa, sb = interleaved(step_f32, sa, step_bf16, sb, batches, 8)
+    res["packed_bf16_dense"] = {
+        "f32_ms": round(f32_s * 1e3, 2),
+        "bf16_ms": round(bf16_s * 1e3, 2),
+        "bf16_over_f32": round(bf16_s / f32_s, 3),
+        "f32_ex_s": round(B / f32_s, 1),
+        "bf16_ex_s": round(B / bf16_s, 1),
+    }
+    del sa, sb
+
+    # ---------------- 2. dedup / sorted-id locality on the wide gather --
+    # Under jit the unique count is dynamic => a real dedup cannot shrink
+    # the gather's static shape.  The realizable lever is LOCALITY:
+    # gather the same M rows with ids pre-sorted (duplicates adjacent)
+    # vs raw order.  Timed as marginal slope: 1 vs 4 chained gathers.
+    p = rows_per_tile(d)
+    vp = -(-vocab // p)
+    packed = jax.random.normal(jax.random.key(1), (vp, LANES), jnp.float32)
+    flat = zipf_ids(rng, (B * NNZ,), vocab).astype(np.int32)
+    phys_raw = jnp.asarray(flat // p)
+    phys_sorted = jnp.asarray(np.sort(flat // p))
+
+    def gather_n(table, phys, n):
+        out = jnp.zeros((phys.shape[0],), table.dtype)
+        t = table
+        for i in range(n):
+            g = t[(phys + i) % vp]  # shift breaks inter-iteration caching
+            out = out + jnp.sum(g, axis=-1)
+        return out
+
+    g1 = jax.jit(partial(gather_n, n=1))
+    g4 = jax.jit(partial(gather_n, n=4))
+
+    def slope(phys):
+        ts = {}
+        for fn, n in ((g1, 1), (g4, 4)):
+            fn(packed, phys).block_until_ready()
+            best = float("inf")
+            for _ in range(5):
+                t0 = time.perf_counter()
+                v = fn(packed, phys)
+                float(v[0])  # value dependency
+                best = min(best, time.perf_counter() - t0)
+            ts[n] = best
+        return (ts[4] - ts[1]) / 3
+
+    raw_s = slope(phys_raw)
+    sorted_s = slope(phys_sorted)
+    res["gather_sorted_locality"] = {
+        "raw_ms": round(raw_s * 1e3, 2),
+        "sorted_ms": round(sorted_s * 1e3, 2),
+        "sorted_over_raw": round(sorted_s / raw_s, 3),
+        "rows": int(flat.size),
+        "unique_rows": int(np.unique(flat // p).size),
+        "payload_mb": round(flat.size * LANES * 4 / 1e6, 1),
+        "raw_gbps": round(flat.size * LANES * 4 / raw_s / 1e9, 1),
+    }
+
+    # ---------------- 4. Pallas-gather headroom input -------------------
+    # (computed from the same slope): effective GB/s vs dense-copy GB/s.
+    x = jnp.zeros((vp, LANES), jnp.float32)
+    cp = jax.jit(lambda a: a * 1.000001)
+    cp(x).block_until_ready()
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        y = cp(x)
+        float(y[0, 0])
+        best = min(best, time.perf_counter() - t0)
+    dense_gbps = 2 * vp * LANES * 4 / best / 1e9
+    res["dense_copy_gbps"] = round(dense_gbps, 1)
+    res["gather_headroom_x"] = round(
+        dense_gbps / res["gather_sorted_locality"]["raw_gbps"], 2
+    )
+    del packed, x
+
+    # ---------------- 3. merged table+accum interleave -------------------
+    # Sorted sparse tail: split [VP,128]+[VP,128] (2 RMW gathers + 2
+    # scatters) vs ONE merged [VP,256] array (1 gather + 1 scatter of
+    # 256-lane rows).  Mini-kernel isolating just the RMW tail.
+    ids_b = [jnp.asarray(zipf_ids(rng, (B * NNZ,), vocab) // p) for i in range(4)]
+    m = B * NNZ
+    gsum = jax.random.normal(jax.random.key(2), (m, LANES), jnp.float32) * 1e-3
+
+    def rmw_split(state, uphys):
+        tab, acc = state
+        cur = tab[uphys]
+        a = acc[uphys]
+        a2 = a + gsum * gsum
+        new = cur - 0.01 * gsum / jnp.sqrt(a2)
+        return (tab.at[uphys].set(new), acc.at[uphys].set(a2)), new[0, 0]
+
+    def rmw_merged(merged, uphys):
+        cur = merged[uphys]  # [M, 256]
+        a2 = cur[:, LANES:] + gsum * gsum
+        new = cur[:, :LANES] - 0.01 * gsum / jnp.sqrt(a2)
+        return merged.at[uphys].set(jnp.concatenate([new, a2], -1)), new[0, 0]
+
+    js = jax.jit(rmw_split, donate_argnums=(0,))
+    jm = jax.jit(rmw_merged, donate_argnums=(0,))
+    ss = (
+        jax.random.normal(jax.random.key(3), (vp, LANES), jnp.float32),
+        jnp.full((vp, LANES), 0.1, jnp.float32),
+    )
+    sm = jnp.concatenate(
+        [
+            jax.random.normal(jax.random.key(3), (vp, LANES), jnp.float32),
+            jnp.full((vp, LANES), 0.1, jnp.float32),
+        ],
+        -1,
+    )
+    ts_, tm_ = [], []
+    ss, _ = js(ss, ids_b[0])  # compile (donated input rebinds to output)
+    float(ss[0][0, 0])
+    sm, _ = jm(sm, ids_b[0])
+    float(sm[0, 0])
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for i in range(4):
+            ss, v = js(ss, ids_b[i])
+        float(ss[0][0, 0])
+        ts_.append((time.perf_counter() - t0) / 4)
+        t0 = time.perf_counter()
+        for i in range(4):
+            sm, v = jm(sm, ids_b[i])
+        float(sm[0, 0])
+        tm_.append((time.perf_counter() - t0) / 4)
+    split_s, merged_s = float(np.median(ts_)), float(np.median(tm_))
+    res["merged_rmw"] = {
+        "split_ms": round(split_s * 1e3, 2),
+        "merged_ms": round(merged_s * 1e3, 2),
+        "merged_over_split": round(merged_s / split_s, 3),
+    }
+
+
+if __name__ == "__main__":
+    main()
