@@ -167,8 +167,20 @@ def _restore_orbax_inplace(path: str, like: TrainState, meta_item=None):
 
 def _load_orbax_host(path: str, like: TrainState):
     import orbax.checkpoint as ocp
+    from jax.sharding import SingleDeviceSharding
 
-    raw = ocp.StandardCheckpointer().restore(os.path.abspath(path))
+    # Restore with an EXPLICIT target built from the checkpoint's own
+    # metadata, every array placed whole on one local device: a bare
+    # restore() replays the SAVED device topology and fails outright when
+    # the checkpoint came from a different mesh/process count — exactly
+    # the cross-topology case this host-side path exists for.
+    ckptr = ocp.StandardCheckpointer()
+    dev = SingleDeviceSharding(jax.local_devices()[0])
+    abstract = jax.tree.map(
+        lambda m: jax.ShapeDtypeStruct(tuple(m.shape), m.dtype, sharding=dev),
+        _orbax_metadata_item(path),
+    )
+    raw = ckptr.restore(os.path.abspath(path), abstract)
     table = np.asarray(raw.table if hasattr(raw, "table") else raw["table"])
     if hasattr(raw, "table_opt"):
         accum = np.asarray(raw.table_opt.accum)

@@ -318,7 +318,7 @@ def pack_accum_any(accum: jax.Array, d: int, init_value: float) -> jax.Array:
     """Pack a LOGICAL accumulator of either granularity — [V, D] element
     (→ [VP, 128]) or [V, 1] row (→ [VP, P]).  The trailing-dim sniff
     lives HERE, next to the packers whose convention it encodes; callers
-    (trainer.pack_state, train_step.pack_logical_to_sharded, ...) must
+    (trainer.pack_state, train_step.pack_sharded_on_device, ...) must
     not re-implement it."""
     if accum.shape[-1] == 1:
         return pack_accum_rows(accum, d, init_value)

@@ -13,7 +13,8 @@ from fast_tffm_tpu.parallel.train_step import (  # noqa: F401
     make_global_batch,
     make_sharded_predict_step,
     make_sharded_train_step,
-    pack_logical_to_sharded,
+    pack_sharded_on_device,
     packed_shard_meta,
     unpack_sharded_to_logical,
+    unpack_sharded_on_device,
 )

@@ -44,8 +44,9 @@ from fast_tffm_tpu.trainer import TrainState, init_state
 __all__ = [
     "init_sharded_state",
     "packed_shard_meta",
-    "pack_logical_to_sharded",
+    "pack_sharded_on_device",
     "unpack_sharded_to_logical",
+    "unpack_sharded_on_device",
     "make_sharded_train_step",
     "make_sharded_predict_step",
     "make_global_batch",
@@ -169,39 +170,6 @@ def packed_shard_meta(model, mesh: Mesh):
     return padded, padded.vocabulary_size // mesh.shape[ROW_AXIS], p
 
 
-def pack_logical_to_sharded(
-    logical: TrainState, model, mesh: Mesh, init_accumulator_value: float = 0.1
-) -> TrainState:
-    """LOGICAL [V, D] state (e.g. a restored checkpoint) -> lane-packed
-    row-sharded state on ``mesh``.  Extends to the packed-aligned vocab
-    (padding rows inert: zero table, init accumulator) and packs GLOBALLY
-    — shard-aligned padding makes that identical to per-shard packing.
-    Shared by dist_train's packed resume and dist_predict's packed path."""
-    import numpy as np
-
-    from fast_tffm_tpu.ops.packed_table import pack_accum_any, pack_table
-
-    padded, _, _ = packed_shard_meta(model, mesh)
-    d = model.row_dim
-    vp_logical = padded.vocabulary_size
-    lt = np.asarray(logical.table)
-    la = np.asarray(logical.table_opt.accum)
-    ext_t = np.zeros((vp_logical, d), lt.dtype)
-    ext_t[: lt.shape[0]] = lt
-    ext_a = np.full((vp_logical, la.shape[-1]), init_accumulator_value, la.dtype)
-    ext_a[: la.shape[0]] = la
-    packed_acc = pack_accum_any(jnp.asarray(ext_a), d, init_accumulator_value)
-    ts = table_sharding(mesh)
-    rep = replicated(mesh)
-    return TrainState(
-        table=jax.device_put(pack_table(jnp.asarray(ext_t)), ts),
-        table_opt=AdagradState(jax.device_put(packed_acc, ts)),
-        dense=jax.tree.map(lambda x: jax.device_put(x, rep), logical.dense),
-        dense_opt=jax.tree.map(lambda x: jax.device_put(x, rep), logical.dense_opt),
-        step=jax.device_put(logical.step, rep),
-    )
-
-
 def unpack_sharded_to_logical(state: TrainState, model, mesh: Mesh) -> TrainState:
     """Lane-packed row-sharded state -> host LOGICAL [V, D] arrays
     (per-shard unpack; checkpoints always hold the logical layout)."""
@@ -225,6 +193,86 @@ def unpack_sharded_to_logical(state: TrainState, model, mesh: Mesh) -> TrainStat
         table=unp(state.table, unpack_table),
         table_opt=state.table_opt._replace(
             accum=unp(state.table_opt.accum, unpack_accum_any)
+        ),
+    )
+
+
+from functools import lru_cache
+
+
+@lru_cache(maxsize=32)
+def _packed_io_fns(mesh: Mesh, shard_logical: int, d: int, init_value: float):
+    """Jitted per-shard pack/unpack transforms for one (mesh, layout)
+    combination, built ONCE and cached: dist_saveable calls the unpack at
+    every checkpoint save, and rebuilding shard_map around fresh lambdas
+    each time would retrace and recompile per save.  Mesh is hashable;
+    the cache key pins everything the traces close over."""
+    from fast_tffm_tpu.ops.packed_table import (
+        pack_accum_any,
+        pack_table,
+        unpack_accum_any,
+        unpack_table,
+    )
+
+    spec = P(ROW_AXIS, None)
+
+    def mapped(fn):
+        return jax.jit(
+            shard_map(fn, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False)
+        )
+
+    return {
+        "unpack_table": mapped(lambda s: unpack_table(s, shard_logical, d)),
+        "unpack_accum": mapped(lambda s: unpack_accum_any(s, shard_logical, d)),
+        "pack_table": mapped(pack_table),
+        "pack_accum": mapped(lambda s: pack_accum_any(s, d, init_value)),
+    }
+
+
+def unpack_sharded_on_device(state: TrainState, model, mesh: Mesh) -> TrainState:
+    """Lane-packed row-sharded state -> LOGICAL row-sharded state, each
+    shard unpacked ON ITS OWN DEVICES under shard_map — no host gather,
+    so it works on multi-host meshes where ``unpack_sharded_to_logical``
+    cannot (its np.asarray would touch non-addressable shards).  The
+    result's logical table is [Vpad, D] row-sharded with the same mesh
+    placement, ready for the sharded (orbax) checkpoint writer: every
+    host saves only its own unpacked shards, which is exactly the
+    per-process logical<->packed checkpoint assembly multi-host packed
+    runs need.  Shard-aligned padding (packed_shard_meta) makes the
+    concatenation of per-shard unpacks equal the global unpack."""
+    _, shard_logical, _ = packed_shard_meta(model, mesh)
+    fns = _packed_io_fns(mesh, shard_logical, model.row_dim, 0.0)
+    return state._replace(
+        table=fns["unpack_table"](state.table),
+        table_opt=state.table_opt._replace(
+            accum=fns["unpack_accum"](state.table_opt.accum)
+        ),
+    )
+
+
+def pack_sharded_on_device(
+    logical: TrainState, model, mesh: Mesh, init_accumulator_value: float = 0.1
+) -> TrainState:
+    """Inverse of ``unpack_sharded_on_device``: a LOGICAL row-sharded
+    state (e.g. a checkpoint restored in place onto the packed-aligned
+    padding — see ``packed_shard_meta``) -> lane-packed row-sharded
+    state, packed per shard on its own devices.  Multi-host safe for the
+    same reason: no host materialization of the global table."""
+    _, shard_logical, _ = packed_shard_meta(model, mesh)
+    if logical.table.shape[0] != shard_logical * mesh.shape[ROW_AXIS]:
+        raise ValueError(
+            f"pack_sharded_on_device needs the packed-aligned padded vocab "
+            f"({shard_logical * mesh.shape[ROW_AXIS]} rows), got "
+            f"{logical.table.shape[0]} — restore onto a template built from "
+            "packed_shard_meta's padded model"
+        )
+    fns = _packed_io_fns(
+        mesh, shard_logical, model.row_dim, float(init_accumulator_value)
+    )
+    return logical._replace(
+        table=fns["pack_table"](logical.table),
+        table_opt=logical.table_opt._replace(
+            accum=fns["pack_accum"](logical.table_opt.accum)
         ),
     )
 

@@ -153,23 +153,31 @@ def dist_predict(cfg: Config, log=print, mesh=None) -> str:
         data = cfg.data_parallel or None
         mesh = make_mesh(data, row)
     check_batch_divides(cfg.batch_size, mesh)
-    state = init_sharded_state(
-        model, mesh, jax.random.key(0), cfg.init_accumulator_value, cfg.adagrad_accumulator
-    )
-    state = restore_checkpoint(cfg.model_file, state)
     if cfg.table_layout == "packed":
-        # Checkpoints hold logical arrays; convert to the lane-packed
-        # sharded layout so scoring runs the packed lookup.
-        from fast_tffm_tpu.parallel import pack_logical_to_sharded
+        # Checkpoints hold logical arrays; restore into a rows-layout
+        # template on the PACKED padding and convert per shard on device
+        # (multi-host safe — no host gather; same scheme as dist_train's
+        # packed resume).
+        from fast_tffm_tpu.parallel import pack_sharded_on_device
+        from fast_tffm_tpu.parallel.train_step import packed_shard_meta
 
-        if jax.process_count() > 1:
-            raise ValueError(
-                "table_layout = packed supports single-process meshes only "
-                "for now (drop the key on multi-host runs)"
-            )
-        state = pack_logical_to_sharded(
-            state, model, mesh, cfg.init_accumulator_value
+        padded_model, _, _ = packed_shard_meta(model, mesh)
+        logical = restore_checkpoint(
+            cfg.model_file,
+            init_sharded_state(
+                padded_model, mesh, jax.random.key(0),
+                cfg.init_accumulator_value, cfg.adagrad_accumulator,
+            ),
         )
+        state = pack_sharded_on_device(
+            logical, model, mesh, cfg.init_accumulator_value
+        )
+    else:
+        state = init_sharded_state(
+            model, mesh, jax.random.key(0), cfg.init_accumulator_value,
+            cfg.adagrad_accumulator,
+        )
+        state = restore_checkpoint(cfg.model_file, state)
     return _run_predict(
         cfg,
         state,

@@ -565,14 +565,6 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
             f"{len(cfg.train_files)} train_files (they align per-file)"
         )
     maybe_initialize_distributed(cfg.coordinator_address, cfg.num_processes, cfg.process_id)
-    if cfg.table_layout == "packed" and jax.process_count() > 1:
-        # Single-process meshes shard the packed table fine; the
-        # multi-host path needs per-process logical<->packed checkpoint
-        # assembly that does not exist yet — refuse loudly.
-        raise ValueError(
-            "table_layout = packed supports single-process meshes only for "
-            "now (drop the key on multi-host runs)"
-        )
     if cfg.device_cache and jax.process_count() > 1:
         # Silent fallback to host streaming would defeat the whole point
         # of the flag (the ~300x feed gap it exists to close) — refuse
@@ -606,17 +598,24 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
     check_batch_divides(cfg.batch_size, mesh)
     if resume and cfg.table_layout == "packed":
         # Restore the LOGICAL checkpoint into a rows-layout template and
-        # convert — no throwaway packed random init.
-        from fast_tffm_tpu.parallel import pack_logical_to_sharded
+        # convert per shard ON DEVICE — no throwaway packed random init,
+        # no host gather (multi-host packed resume works: each process
+        # restores and packs only its own shards).  The template uses the
+        # PACKED padding so a same-mesh packed checkpoint restores
+        # in place; other paddings go through restore's re-pad path
+        # (single-host) or its loud multi-host shape error.
+        from fast_tffm_tpu.parallel import pack_sharded_on_device
+        from fast_tffm_tpu.parallel.train_step import packed_shard_meta
 
+        padded_model, _, _ = packed_shard_meta(model, mesh)
         logical = restore_checkpoint(
             cfg.model_file,
             init_sharded_state(
-                model, mesh, jax.random.key(0), cfg.init_accumulator_value,
+                padded_model, mesh, jax.random.key(0), cfg.init_accumulator_value,
                 cfg.adagrad_accumulator,
             ),
         )
-        state = pack_logical_to_sharded(
+        state = pack_sharded_on_device(
             logical, model, mesh, cfg.init_accumulator_value
         )
         log(f"resumed from {cfg.model_file} at step {int(state.step)}")
@@ -640,11 +639,15 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
     )
     dist_saveable = None
     if cfg.table_layout == "packed":
-        # Checkpoints hold LOGICAL [V, D] arrays (single-process mesh).
-        from fast_tffm_tpu.parallel import unpack_sharded_to_logical
+        # Checkpoints hold LOGICAL [V, D] arrays.  Unpack per shard ON
+        # DEVICE: the result is a row-sharded logical state the normal
+        # checkpoint writer handles on any process count (orbax writes
+        # each host's shards in parallel; single-process npz fetches the
+        # one process's arrays as before).
+        from fast_tffm_tpu.parallel import unpack_sharded_on_device
 
         def dist_saveable(st):
-            return unpack_sharded_to_logical(st, model, mesh)
+            return unpack_sharded_on_device(st, model, mesh)
 
     cached_data = None
     if cfg.device_cache:

@@ -311,3 +311,122 @@ def test_two_process_alltoall_overflow_fallback(tmp_path):
     np.testing.assert_allclose(
         np.asarray(restored.table), np.asarray(single.table), rtol=2e-4, atol=2e-6
     )
+
+
+WORKER_PACKED = textwrap.dedent(
+    """
+    import sys
+    pid, nproc, port, tmp = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+    jax.distributed.initialize(f"127.0.0.1:{{port}}", num_processes=nproc, process_id=pid)
+
+    import dataclasses
+    from fast_tffm_tpu.config import Config
+    from fast_tffm_tpu.prediction import dist_predict
+    from fast_tffm_tpu.training import dist_train
+
+    cfg = Config(
+        model="fm", factor_num=4, vocabulary_size=128,
+        model_file=f"{{tmp}}/model_pk.orbax", checkpoint_format="orbax",
+        train_files=(f"{{tmp}}/train.libsvm",),
+        epoch_num=1, batch_size=32, learning_rate=0.1, log_every=5,
+        row_parallel=2, table_layout="packed",
+    ).validate()
+    state = dist_train(cfg, log=lambda m: print(f"[{{pid}}] {{m}}", flush=True))
+    print(f"[{{pid}}] EPOCH1 step={{int(state.step)}}", flush=True)
+
+    # Multi-host packed RESUME: every process restores the LOGICAL
+    # orbax checkpoint in place onto its own shards and repacks them on
+    # device (pack_sharded_on_device) — the per-process assembly the old
+    # refusal said was missing.
+    state = dist_train(
+        cfg, resume=True, log=lambda m: print(f"[{{pid}}] {{m}}", flush=True)
+    )
+    print(f"[{{pid}}] DONE step={{int(state.step)}}", flush=True)
+
+    pcfg = dataclasses.replace(
+        cfg,
+        predict_files=(f"{{tmp}}/valid.libsvm",),
+        score_path=f"{{tmp}}/scores_pk.txt",
+    )
+    dist_predict(pcfg, log=lambda m: print(f"[{{pid}}] {{m}}", flush=True))
+    print(f"[{{pid}}] PREDICT DONE", flush=True)
+    """
+).format(repo=REPO)
+
+
+@pytest.mark.slow
+def test_two_process_packed_train_resume_predict(tmp_path):
+    """table_layout=packed on a REAL two-process mesh (VERDICT r3 #3):
+    train writes a LOGICAL sharded orbax checkpoint via the on-device
+    per-shard unpack, resume restores + repacks per process, dist_predict
+    serves from the packed layout — and the final table equals
+    single-process PACKED training of the same two epochs (the
+    save/restore cycle in the middle must be invisible)."""
+    _write_data(tmp_path)
+    outs = _run_workers(WORKER_PACKED, tmp_path)
+    steps_per_epoch = -(-N_ROWS // 32)
+    for i, out in enumerate(outs):
+        assert f"[{i}] EPOCH1 step={steps_per_epoch}" in out, out
+        assert f"[{i}] DONE step={2 * steps_per_epoch}" in out, out
+    assert "[0] PREDICT DONE" in outs[0] and "[1] PREDICT DONE" in outs[1]
+    assert os.path.isdir(tmp_path / "model_pk.orbax")
+
+    # The checkpoint is LOGICAL: it restores onto a plain single-device
+    # rows-layout state (possibly via the vocab re-pad path).
+    import jax
+
+    from fast_tffm_tpu.checkpoint import restore_checkpoint
+    from fast_tffm_tpu.config import Config
+    from fast_tffm_tpu.models import FMModel
+    from fast_tffm_tpu.trainer import init_state
+    from fast_tffm_tpu.training import train
+
+    model = FMModel(vocabulary_size=128, factor_num=4)
+    restored = restore_checkpoint(
+        str(tmp_path / "model_pk.orbax"), init_state(model, jax.random.key(0))
+    )
+    assert int(restored.step) == 2 * steps_per_epoch
+    assert restored.table.shape[-1] == 5  # logical [V, 1+k], not 128 lanes
+
+    # Equivalence: single-process packed training, two epochs straight
+    # through (no save/resume cycle), same data.
+    cfg = Config(
+        model="fm", factor_num=4, vocabulary_size=128,
+        model_file=str(tmp_path / "single_pk.ckpt"),
+        train_files=(str(tmp_path / "train.libsvm"),),
+        epoch_num=2, batch_size=32, learning_rate=0.1, log_every=10**9,
+        table_layout="packed",
+    ).validate()
+    single = train(cfg, log=lambda *_: None)
+    assert int(single.step) == 2 * steps_per_epoch
+    # `train` returns the PACKED state; its npz checkpoint holds the
+    # logical table — compare in logical space.
+    with np.load(tmp_path / "single_pk.ckpt") as z:
+        single_logical = z["table"]
+    np.testing.assert_allclose(
+        np.asarray(restored.table)[:128],
+        single_logical[:128],
+        rtol=2e-4, atol=2e-6,
+    )
+
+    # Scores from the packed dist_predict match single-process prediction.
+    import dataclasses
+
+    from fast_tffm_tpu.prediction import predict
+
+    pcfg = dataclasses.replace(
+        cfg,
+        model_file=str(tmp_path / "model_pk.orbax"),
+        checkpoint_format="orbax",
+        predict_files=(str(tmp_path / "valid.libsvm"),),
+        score_path=str(tmp_path / "scores_pk_single.txt"),
+    )
+    predict(pcfg, log=lambda *_: None)
+    dist = np.loadtxt(tmp_path / "scores_pk.txt")
+    one = np.loadtxt(tmp_path / "scores_pk_single.txt")
+    assert dist.shape == one.shape == (96,)
+    np.testing.assert_allclose(dist, one, atol=5e-5)

@@ -37,7 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 from functools import partial
 
-from bench import forced_sync, make_batch, zipf_ids
+from bench import make_batch, zipf_ids
 from fast_tffm_tpu.models import FMModel
 from fast_tffm_tpu.optim import AdagradState, sparse_adagrad_update
 from fast_tffm_tpu.ops.packed_table import (
@@ -66,7 +66,7 @@ def _sync(state):
     return float(jnp.sum(jax.lax.dynamic_slice_in_dim(t, 0, 2, axis=0)))
 
 
-def interleaved(step_a, state_a, step_b, state_b, batches, iters, rounds=5):
+def interleaved(step_a, state_a, step_b, state_b, batches, iters, rounds=3):
     """Median per-step seconds for A and B, timed in ALTERNATING windows
     of the same session (A B A B ...), each window closed by a value
     fetch that depends on the final table (forced_sync)."""
@@ -96,6 +96,10 @@ def main():
 
     atexit.register(lambda: print(json.dumps(res), flush=True))
 
+    def mark(name):
+        print(f"# section {name} @ {time.strftime('%H:%M:%S')}", file=sys.stderr, flush=True)
+
+    mark("1a rows_bf16")
     # ---------------- 1a. bf16 table, rows layout ----------------
     # Mini-step isolating what the original claim was about: the [V, D]
     # gather + RMW sparse-Adagrad path with the table stored bf16 vs f32
@@ -106,7 +110,10 @@ def main():
     key = jax.random.key(0)
     table_f32 = jax.random.normal(key, (vocab, d), jnp.float32) * 0.01
 
-    def mini_step(state, batch, compute=jnp.float32):
+    def mini_step(state, batch):
+        # The two arms differ ONLY in the stored table dtype (carried by
+        # the state); compute is f32 in both — the same jitted callable
+        # retraces per input dtype.
         table, acc = state
         rows = table[batch.ids].astype(jnp.float32)  # [B, N, D]
         g_rows = rows * batch.vals[..., None]  # cheap stand-in gradient
@@ -115,12 +122,11 @@ def main():
         )
         return (new_table.astype(table.dtype), opt.accum), jnp.sum(rows[0, 0])
 
-    step_f32 = jax.jit(partial(mini_step), donate_argnums=(0,))
-    step_bf16 = jax.jit(partial(mini_step), donate_argnums=(0,))
+    step_f32 = step_bf16 = jax.jit(mini_step, donate_argnums=(0,))
     batches = [make_batch(zipf_ids(rng, (B, NNZ), vocab), i) for i in range(8)]
     sa = (table_f32, jnp.full((vocab, d), 0.1, jnp.float32))
     sb = (table_f32.astype(jnp.bfloat16), jnp.full((vocab, d), 0.1, jnp.float32))
-    f32_s, bf16_s, sa, sb = interleaved(step_f32, sa, step_bf16, sb, batches, 10)
+    f32_s, bf16_s, sa, sb = interleaved(step_f32, sa, step_bf16, sb, batches, 6)
     res["rows_bf16"] = {
         "f32_ms": round(f32_s * 1e3, 2),
         "bf16_ms": round(bf16_s * 1e3, 2),
@@ -128,6 +134,7 @@ def main():
     }
     del sa, sb
 
+    mark("1b packed_bf16")
     # ---------------- 1b. bf16 table, packed layout, dense update -------
     # The packed table in bf16 halves the bytes of the wide forward
     # gather AND the dense sweep's table read/write; G and the
@@ -172,7 +179,7 @@ def main():
         sb0.step,
     )
     del sb0
-    f32_s, bf16_s, sa, sb = interleaved(step_f32, sa, step_bf16, sb, batches, 8)
+    f32_s, bf16_s, sa, sb = interleaved(step_f32, sa, step_bf16, sb, batches, 6)
     res["packed_bf16_dense"] = {
         "f32_ms": round(f32_s * 1e3, 2),
         "bf16_ms": round(bf16_s * 1e3, 2),
@@ -182,6 +189,7 @@ def main():
     }
     del sa, sb
 
+    mark("2 gather locality")
     # ---------------- 2. dedup / sorted-id locality on the wide gather --
     # Under jit the unique count is dynamic => a real dedup cannot shrink
     # the gather's static shape.  The realizable lever is LOCALITY:
@@ -230,6 +238,7 @@ def main():
         "raw_gbps": round(flat.size * LANES * 4 / raw_s / 1e9, 1),
     }
 
+    mark("4 dense copy")
     # ---------------- 4. Pallas-gather headroom input -------------------
     # (computed from the same slope): effective GB/s vs dense-copy GB/s.
     x = jnp.zeros((vp, LANES), jnp.float32)
@@ -248,6 +257,7 @@ def main():
     )
     del packed, x
 
+    mark("3 merged rmw")
     # ---------------- 3. merged table+accum interleave -------------------
     # Sorted sparse tail: split [VP,128]+[VP,128] (2 RMW gathers + 2
     # scatters) vs ONE merged [VP,256] array (1 gather + 1 scatter of
