@@ -99,6 +99,28 @@ def main():
     def mark(name):
         print(f"# section {name} @ {time.strftime('%H:%M:%S')}", file=sys.stderr, flush=True)
 
+    only = os.environ.get("PROBE_ONLY", "").split(",")
+    only = [x for x in only if x]
+
+    def want(name):
+        return not only or name in only
+
+    def flush():
+        # Incremental flush: a hung backend call can eat SIGINT/SIGTERM
+        # before atexit runs (observed: section-3 scatter hang lost every
+        # completed section's numbers) — persist after EVERY section.
+        print(json.dumps(res), flush=True)
+
+    if want("1a"):
+        run_1a(res, rng, mark, flush)
+    if want("1b"):
+        run_1b(res, rng, mark, flush)
+    if want("2") or want("4") or want("3"):
+        run_24(res, rng, mark, flush, want)
+    return
+
+
+def run_1a(res, rng, mark, flush):
     mark("1a rows_bf16")
     # ---------------- 1a. bf16 table, rows layout ----------------
     # Mini-step isolating what the original claim was about: the [V, D]
@@ -132,14 +154,19 @@ def main():
         "bf16_ms": round(bf16_s * 1e3, 2),
         "bf16_over_f32": round(bf16_s / f32_s, 3),
     }
+    flush()
     del sa, sb
 
+
+
+def run_1b(res, rng, mark, flush):
     mark("1b packed_bf16")
     # ---------------- 1b. bf16 table, packed layout, dense update -------
     # The packed table in bf16 halves the bytes of the wide forward
     # gather AND the dense sweep's table read/write; G and the
     # accumulator stay f32 (same Adagrad semantics).
     vocab = 1 << 24
+    d = 1 + K
     model = FMModel(vocabulary_size=vocab, factor_num=K, order=2)
     batches = [make_batch(zipf_ids(rng, (B, NNZ), vocab), 100 + i) for i in range(8)]
 
@@ -187,134 +214,164 @@ def main():
         "f32_ex_s": round(B / f32_s, 1),
         "bf16_ex_s": round(B / bf16_s, 1),
     }
+    flush()
     del sa, sb
 
-    mark("2 gather locality")
-    # ---------------- 2. dedup / sorted-id locality on the wide gather --
-    # Under jit the unique count is dynamic => a real dedup cannot shrink
-    # the gather's static shape.  The realizable lever is LOCALITY:
-    # gather the same M rows with ids pre-sorted (duplicates adjacent)
-    # vs raw order.  Timed as marginal slope: 1 vs 4 chained gathers.
+
+
+def run_24(res, rng, mark, flush, want):
+    # Shared setup for sections 2/4/3 (same packed array + slope helper).
+    vocab = 1 << 24
+    d = 1 + K
     p = rows_per_tile(d)
     vp = -(-vocab // p)
     packed = jax.random.normal(jax.random.key(1), (vp, LANES), jnp.float32)
     flat = zipf_ids(rng, (B * NNZ,), vocab).astype(np.int32)
-    phys_raw = jnp.asarray(flat // p)
-    phys_sorted = jnp.asarray(np.sort(flat // p))
 
-    def gather_n(table, phys, n):
-        out = jnp.zeros((phys.shape[0],), table.dtype)
-        t = table
-        for i in range(n):
-            g = t[(phys + i) % vp]  # shift breaks inter-iteration caching
-            out = out + jnp.sum(g, axis=-1)
-        return out
+    def slope_ms(fn, arrays, k_lo=2, k_hi=10, reps=3):
+        """Marginal ms per op: k applications carry-chained inside ONE
+        jit, cost from the (k_hi - k_lo) difference — single-shot
+        timings on this tunnel include a ~100 ms fetch RTT and are
+        garbage (measured; an early version of section 2 "measured" a
+        1.6 TB/s gather that way)."""
+        jfn = jax.jit(fn, static_argnums=(1,))
+        for k in (k_lo, k_hi):
+            float(jfn(arrays, k))
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            float(jfn(arrays, k_lo))
+            t_lo = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            float(jfn(arrays, k_hi))
+            t_hi = time.perf_counter() - t0
+            best = min(best, (t_hi - t_lo) / (k_hi - k_lo))
+        return best * 1e3
 
-    g1 = jax.jit(partial(gather_n, n=1))
-    g4 = jax.jit(partial(gather_n, n=4))
+    if want("2"):
+        mark("2 gather locality")
+        # ------------ 2. dedup / sorted-id locality on the wide gather --
+        # Under jit the unique count is dynamic => a real dedup cannot
+        # shrink the gather's static shape.  The realizable lever is
+        # LOCALITY: gather the same M rows with ids pre-sorted
+        # (duplicates adjacent) vs raw order.
+        phys_raw = jnp.asarray(flat // p)
+        phys_sorted = jnp.asarray(np.sort(flat // p))
 
-    def slope(phys):
-        ts = {}
-        for fn, n in ((g1, 1), (g4, 4)):
-            fn(packed, phys).block_until_ready()
-            best = float("inf")
-            for _ in range(5):
-                t0 = time.perf_counter()
-                v = fn(packed, phys)
-                float(v[0])  # value dependency
-                best = min(best, time.perf_counter() - t0)
-            ts[n] = best
-        return (ts[4] - ts[1]) / 3
+        def gather_k(arrays, k):
+            table, phys = arrays
 
-    raw_s = slope(phys_raw)
-    sorted_s = slope(phys_sorted)
-    res["gather_sorted_locality"] = {
-        "raw_ms": round(raw_s * 1e3, 2),
-        "sorted_ms": round(sorted_s * 1e3, 2),
-        "sorted_over_raw": round(sorted_s / raw_s, 3),
-        "rows": int(flat.size),
-        "unique_rows": int(np.unique(flat // p).size),
-        "payload_mb": round(flat.size * LANES * 4 / 1e6, 1),
-        "raw_gbps": round(flat.size * LANES * 4 / raw_s / 1e9, 1),
-    }
+            def body(i, acc):
+                return acc + jnp.sum(table[(phys + i) % vp])  # shift kills caching
 
-    mark("4 dense copy")
-    # ---------------- 4. Pallas-gather headroom input -------------------
-    # (computed from the same slope): effective GB/s vs dense-copy GB/s.
-    x = jnp.zeros((vp, LANES), jnp.float32)
-    cp = jax.jit(lambda a: a * 1.000001)
-    cp(x).block_until_ready()
-    best = float("inf")
-    for _ in range(5):
-        t0 = time.perf_counter()
-        y = cp(x)
-        float(y[0, 0])
-        best = min(best, time.perf_counter() - t0)
-    dense_gbps = 2 * vp * LANES * 4 / best / 1e9
-    res["dense_copy_gbps"] = round(dense_gbps, 1)
-    res["gather_headroom_x"] = round(
-        dense_gbps / res["gather_sorted_locality"]["raw_gbps"], 2
-    )
-    del packed, x
+            return jax.lax.fori_loop(0, k, body, jnp.float32(0))
 
-    mark("3 merged rmw")
-    # ---------------- 3. merged table+accum interleave -------------------
-    # Sorted sparse tail: split [VP,128]+[VP,128] (2 RMW gathers + 2
-    # scatters) vs ONE merged [VP,256] array (1 gather + 1 scatter of
-    # 256-lane rows).  Mini-kernel isolating just the RMW tail.
-    ids_b = [jnp.asarray(zipf_ids(rng, (B * NNZ,), vocab) // p) for i in range(4)]
-    m = B * NNZ
-    gsum = jax.random.normal(jax.random.key(2), (m, LANES), jnp.float32) * 1e-3
+        raw_ms = slope_ms(gather_k, (packed, phys_raw))
+        sorted_ms = slope_ms(gather_k, (packed, phys_sorted))
+        res["gather_sorted_locality"] = {
+            "raw_ms": round(raw_ms, 2),
+            "sorted_ms": round(sorted_ms, 2),
+            "sorted_over_raw": round(sorted_ms / raw_ms, 3),
+            "rows": int(flat.size),
+            "unique_rows": int(np.unique(flat // p).size),
+            "payload_mb": round(flat.size * LANES * 4 / 1e6, 1),
+            "raw_gbps": round(flat.size * LANES * 4 / (raw_ms / 1e3) / 1e9, 1),
+            "sorted_gbps": round(flat.size * LANES * 4 / (sorted_ms / 1e3) / 1e9, 1),
+        }
+        flush()
 
-    def rmw_split(state, uphys):
-        tab, acc = state
-        cur = tab[uphys]
-        a = acc[uphys]
-        a2 = a + gsum * gsum
-        new = cur - 0.01 * gsum / jnp.sqrt(a2)
-        return (tab.at[uphys].set(new), acc.at[uphys].set(a2)), new[0, 0]
+    if want("4"):
+        mark("4 dense copy")
+        # ------------ 4. Pallas-gather headroom input -------------------
+        # (same slope method): dense elementwise GB/s vs the gather's
+        # GB/s — the gap is the most a hand gather kernel could recover.
+        def sweep_k(arrays, k):
+            (x,) = arrays
 
-    def rmw_merged(merged, uphys):
-        cur = merged[uphys]  # [M, 256]
-        a2 = cur[:, LANES:] + gsum * gsum
-        new = cur[:, :LANES] - 0.01 * gsum / jnp.sqrt(a2)
-        return merged.at[uphys].set(jnp.concatenate([new, a2], -1)), new[0, 0]
+            def body(i, acc):
+                # Barrier: without it XLA folds the k multiplies into one
+                # pass over memory (measured: a NEGATIVE slope), and the
+                # "k sweeps" measure one.
+                return jax.lax.optimization_barrier(acc * 1.000001)
 
-    js = jax.jit(rmw_split, donate_argnums=(0,))
-    jm = jax.jit(rmw_merged, donate_argnums=(0,))
-    ss = (
-        jax.random.normal(jax.random.key(3), (vp, LANES), jnp.float32),
-        jnp.full((vp, LANES), 0.1, jnp.float32),
-    )
-    sm = jnp.concatenate(
-        [
+            return jnp.sum(jax.lax.fori_loop(0, k, body, x)[0])
+
+        x = jax.random.normal(jax.random.key(2), (vp, LANES), jnp.float32)
+        sweep_ms = slope_ms(sweep_k, (x,))
+        dense_gbps = 2 * vp * LANES * 4 / (sweep_ms / 1e3) / 1e9
+        res["dense_sweep_ms"] = round(sweep_ms, 2)
+        res["dense_copy_gbps"] = round(dense_gbps, 1)
+        raw_gbps = res.get("gather_sorted_locality", {}).get("raw_gbps")
+        if raw_gbps:
+            res["gather_headroom_x"] = round(dense_gbps / raw_gbps, 2)
+        flush()
+        del x
+
+    if want("3"):
+        if os.environ.get("PROBE_MERGED") != "1":
+            # Section 3 hangs this backend (a [M, 256]-lane scatter-set
+            # at M=639k wedged the device >15 min, unkillable mid-call)
+            # — opt in with PROBE_MERGED=1 after the hang is understood.
+            return
+        mark("3 merged rmw")
+        # ------------ 3. merged table+accum interleave ------------------
+        # Sorted sparse tail: split [VP,128]+[VP,128] (2 RMW gathers + 2
+        # scatters) vs ONE merged [VP,256] array (1 gather + 1 scatter
+        # of 256-lane rows).  Mini-kernel isolating just the RMW tail.
+        m = 160_000  # small: the full 639k wedged the backend (see gate)
+        ids_b = [jnp.asarray(zipf_ids(rng, (m,), vocab) // p) for i in range(4)]
+        gsum = jax.random.normal(jax.random.key(2), (m, LANES), jnp.float32) * 1e-3
+
+        def rmw_split(state, uphys):
+            tab, acc = state
+            cur = tab[uphys]
+            a = acc[uphys]
+            a2 = a + gsum * gsum
+            new = cur - 0.01 * gsum / jnp.sqrt(a2)
+            return (tab.at[uphys].set(new), acc.at[uphys].set(a2)), new[0, 0]
+
+        def rmw_merged(merged, uphys):
+            cur = merged[uphys]  # [M, 256]
+            a2 = cur[:, LANES:] + gsum * gsum
+            new = cur[:, :LANES] - 0.01 * gsum / jnp.sqrt(a2)
+            return merged.at[uphys].set(jnp.concatenate([new, a2], -1)), new[0, 0]
+
+        js = jax.jit(rmw_split, donate_argnums=(0,))
+        jm = jax.jit(rmw_merged, donate_argnums=(0,))
+        ss = (
             jax.random.normal(jax.random.key(3), (vp, LANES), jnp.float32),
             jnp.full((vp, LANES), 0.1, jnp.float32),
-        ],
-        -1,
-    )
-    ts_, tm_ = [], []
-    ss, _ = js(ss, ids_b[0])  # compile (donated input rebinds to output)
-    float(ss[0][0, 0])
-    sm, _ = jm(sm, ids_b[0])
-    float(sm[0, 0])
-    for _ in range(5):
-        t0 = time.perf_counter()
-        for i in range(4):
-            ss, v = js(ss, ids_b[i])
+        )
+        sm = jnp.concatenate(
+            [
+                jax.random.normal(jax.random.key(3), (vp, LANES), jnp.float32),
+                jnp.full((vp, LANES), 0.1, jnp.float32),
+            ],
+            -1,
+        )
+        ts_, tm_ = [], []
+        ss, _ = js(ss, ids_b[0])  # compile (donated input rebinds to output)
         float(ss[0][0, 0])
-        ts_.append((time.perf_counter() - t0) / 4)
-        t0 = time.perf_counter()
-        for i in range(4):
-            sm, v = jm(sm, ids_b[i])
+        sm, _ = jm(sm, ids_b[0])
         float(sm[0, 0])
-        tm_.append((time.perf_counter() - t0) / 4)
-    split_s, merged_s = float(np.median(ts_)), float(np.median(tm_))
-    res["merged_rmw"] = {
-        "split_ms": round(split_s * 1e3, 2),
-        "merged_ms": round(merged_s * 1e3, 2),
-        "merged_over_split": round(merged_s / split_s, 3),
-    }
+        for _ in range(5):
+            t0 = time.perf_counter()
+            for i in range(4):
+                ss, v = js(ss, ids_b[i])
+            float(ss[0][0, 0])
+            ts_.append((time.perf_counter() - t0) / 4)
+            t0 = time.perf_counter()
+            for i in range(4):
+                sm, v = jm(sm, ids_b[i])
+            float(sm[0, 0])
+            tm_.append((time.perf_counter() - t0) / 4)
+        split_s, merged_s = float(np.median(ts_)), float(np.median(tm_))
+        res["merged_rmw"] = {
+            "split_ms": round(split_s * 1e3, 2),
+            "merged_ms": round(merged_s * 1e3, 2),
+            "merged_over_split": round(merged_s / split_s, 3),
+        }
+        flush()
 
 
 if __name__ == "__main__":
