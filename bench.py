@@ -73,7 +73,10 @@ BASELINE_EXAMPLES_PER_SEC_PER_CHIP = 500_000.0
 # Trailing small rungs keep the bench emitting an honest (labeled) number
 # even when the shared chip is degraded/fragmented (sessions where 8 GiB
 # states OOM — observed) — the rung size is on the printed line either way.
-SCALE_VOCABS = (1 << 28, 251_658_240, 234_881_024, 1 << 27, 1 << 24, 1 << 20)
+# 201,326,592 (8.0 GiB state) added r4: the 234M rung now fails at bare
+# allocation (usable HBM shrank — PROBE_SCALE_r04.json), and 201M is the
+# largest size the bisect measured allocating AND stepping.
+SCALE_VOCABS = (1 << 28, 251_658_240, 234_881_024, 201_326_592, 1 << 27, 1 << 24, 1 << 20)
 SCALE_K = 8
 NNZ = 39  # Criteo field count
 BATCH = 16384

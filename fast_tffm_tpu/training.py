@@ -574,11 +574,6 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
             "device_cache = true supports single-process meshes only for "
             "now (drop the flag on multi-host runs)"
         )
-    if cfg.device_cache and cfg.table_layout == "packed":
-        raise ValueError(
-            "device_cache + table_layout=packed on dist_train is not "
-            "supported yet (use one or the other)"
-        )
     if cfg.device_cache and cfg.shuffle:
         # A shuffled gather across the mesh-sharded batch dim would move
         # rows between chips every step — exactly the per-step traffic

@@ -223,13 +223,29 @@ def test_device_cache_with_packed_layout(tmp_path, fmb_files):
     )
 
 
-def test_device_cache_dist_refuses_packed(tmp_path, fmb_files):
-    """dist_train refuses device_cache + table_layout=packed (untested
-    composition) instead of silently running one of them."""
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs the 8-device CPU mesh")
+def test_device_cache_dist_train_packed_bit_identical(tmp_path, fmb_files):
+    """device_cache + table_layout=packed on dist_train (VERDICT r3 #3's
+    last fence): the mesh-sharded resident path through the PACKED step
+    is bit-identical to the streamed packed dist run — the cached wrap is
+    layout-agnostic (it only slices the batch), so the packed state rides
+    it unchanged."""
     from fast_tffm_tpu.training import dist_train
 
-    cfg = _cfg(
-        tmp_path, fmb_files, "dcpk", device_cache=True, table_layout="packed"
+    cfg_s = _cfg(
+        tmp_path, fmb_files, "pdstream", row_parallel=4, data_parallel=2,
+        table_layout="packed",
     )
-    with pytest.raises(ValueError, match="not\\s+supported"):
-        dist_train(cfg, log=lambda *_: None)
+    st_stream = dist_train(cfg_s, log=lambda *_: None)
+    cfg_c = _cfg(
+        tmp_path, fmb_files, "pdcache", row_parallel=4, data_parallel=2,
+        device_cache=True, table_layout="packed",
+    )
+    st_cache = dist_train(cfg_c, log=lambda *_: None)
+    assert _losses(cfg_s.metrics_path) == _losses(cfg_c.metrics_path)
+    np.testing.assert_array_equal(
+        np.asarray(st_stream.table), np.asarray(st_cache.table)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(st_stream.table_opt.accum), np.asarray(st_cache.table_opt.accum)
+    )
