@@ -32,7 +32,7 @@ class Config:
     table_layout: str = "rows"  # rows ([V,D]) | packed (lane-packed [V/P,128]
     #   tile rows — fixes the partial-lane scatter cliff, DESIGN §6; composes
     #   with both accumulator granularities and both lookup collectives;
-    #   dist shards it, single-process meshes)
+    #   dist shards it, incl. multi-host)
     model_file: str = "model.ckpt"
     checkpoint_format: str = "npz"  # npz | orbax (orbax = sharded, pod-scale)
     # [Train]
@@ -59,7 +59,8 @@ class Config:
     shuffle_seed: int = 0
     device_cache: bool = False  # load the (FMB) train set to device HBM once,
     #   slice batches on-chip — zero per-step host→device bytes; dist_train
-    #   shards the resident arrays over the mesh (single-process, no shuffle)
+    #   shards the resident arrays over the mesh, per-process assembly
+    #   multi-host (no shuffle on dist)
     queue_size: int = 8  # prefetch depth
     log_every: int = 100
     save_every_epochs: int = 1

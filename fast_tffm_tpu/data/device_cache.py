@@ -77,18 +77,34 @@ def _load_host_arrays(
     max_nnz: int | None = None,
     weights=None,
     with_fields: bool = True,
+    shard_index: int = 0,
+    shard_count: int = 1,
 ):
     """Flat host staging arrays via fmb_batch_stream (shared by the local
     and mesh-sharded loaders — the sharded one uploads straight from
-    host to its mesh placement, never bouncing through one device)."""
+    host to its mesh placement, never bouncing through one device).
+
+    ``shard_count`` > 1 loads only this PROCESS's block-cyclic shard of
+    every global batch (rows [p·B/P, (p+1)·B/P), the multi-host input
+    scheme dist_train's streamed path uses): ``batch_size`` stays the
+    GLOBAL batch, the staged arrays hold batch_size/shard_count rows per
+    batch, and the emitted ``batches`` count is the global one (every
+    process stages the same number of per-batch slices).
+    """
     from fast_tffm_tpu.data.binary import fmb_batch_stream, open_fmb
 
     files = [str(f) for f in files]
     n_rows = sum(open_fmb(f).n_rows for f in files)
     if n_rows == 0:
         raise ValueError(f"device_cache: no rows in {files}")
+    if batch_size % shard_count:
+        raise ValueError(
+            f"device_cache: global batch_size {batch_size} not divisible "
+            f"by {shard_count} processes"
+        )
     batches = -(-n_rows // batch_size)  # ceil; tail pads with weight-0 rows
-    flat = batches * batch_size
+    local_bs = batch_size // shard_count
+    flat = batches * local_bs
     # Preallocate the flat host staging arrays (shapes are known upfront)
     # and fill per-batch slices — a list-then-concatenate would hold the
     # whole dataset on the host TWICE, OOMing exactly the near-HBM-sized
@@ -97,12 +113,16 @@ def _load_host_arrays(
     lo = 0
     for parsed, w in fmb_batch_stream(
         files,
-        batch_size=batch_size,
+        batch_size=local_bs,
         vocabulary_size=vocabulary_size,
         hash_feature_id=hash_feature_id,
         max_nnz=max_nnz,
         epochs=1,
         weights=weights,
+        shard_index=shard_index,
+        shard_count=shard_count,
+        shard_block=local_bs if shard_count > 1 else 1,
+        pad_to_batches=batches if shard_count > 1 else None,
     ):
         if host is None:
             width = parsed.ids.shape[1]
@@ -247,13 +267,19 @@ def load_sharded_device_dataset(
     ``dynamic_slice`` runs on the unsharded batches axis — trivially
     SPMD-partitionable — and each chip holds exactly its micro-batch slice
     of every batch, so per-chip HBM cost is total/n_devices.
-    Single-process meshes only (a multi-host resident dataset needs
-    per-process shard assembly — refused upstream).
+
+    MULTI-HOST meshes work the same way the streamed input path does:
+    each process stages only ITS rows of every global batch (block-cyclic
+    shard, the make_global_batch scheme) and contributes exactly its
+    addressable devices' slice via
+    ``jax.make_array_from_process_local_data`` — no process ever holds
+    (or transfers) another host's shard.
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from fast_tffm_tpu.parallel.mesh import DATA_AXIS, ROW_AXIS
 
+    nproc = jax.process_count()
     host, batches, n_rows = _load_host_arrays(
         files,
         batch_size=batch_size,
@@ -262,15 +288,24 @@ def load_sharded_device_dataset(
         max_nnz=max_nnz,
         weights=weights,
         with_fields=with_fields,
+        shard_index=jax.process_index() if nproc > 1 else 0,
+        shard_count=nproc,
     )
 
     def shard(a):
         # Upload straight from the host staging array to the mesh
         # placement: each chip receives only its shard, so a dataset
-        # sized for AGGREGATE mesh HBM never has to fit one device.
-        bm = a.reshape((batches, batch_size) + a.shape[1:])
+        # sized for AGGREGATE mesh HBM never has to fit one device (and
+        # multi-host, never has to fit one HOST either).
+        local_rows = a.shape[0] // batches
+        bm = np.ascontiguousarray(
+            a.reshape((batches, local_rows) + a.shape[1:])
+        )
         spec = P(None, (DATA_AXIS, ROW_AXIS), *([None] * (bm.ndim - 2)))
-        return jax.device_put(bm, NamedSharding(mesh, spec))
+        sharding = NamedSharding(mesh, spec)
+        if nproc > 1:
+            return jax.make_array_from_process_local_data(sharding, bm)
+        return jax.device_put(bm, sharding)
 
     return DeviceDataset(
         labels=shard(host["labels"]),

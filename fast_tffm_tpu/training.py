@@ -565,15 +565,6 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
             f"{len(cfg.train_files)} train_files (they align per-file)"
         )
     maybe_initialize_distributed(cfg.coordinator_address, cfg.num_processes, cfg.process_id)
-    if cfg.device_cache and jax.process_count() > 1:
-        # Silent fallback to host streaming would defeat the whole point
-        # of the flag (the ~300x feed gap it exists to close) — refuse
-        # loudly; the multi-host resident path needs per-process shard
-        # assembly and does not exist yet.
-        raise ValueError(
-            "device_cache = true supports single-process meshes only for "
-            "now (drop the flag on multi-host runs)"
-        )
     if cfg.device_cache and cfg.shuffle:
         # A shuffled gather across the mesh-sharded batch dim would move
         # rows between chips every step — exactly the per-step traffic
@@ -731,28 +722,35 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
                 f"{nproc} processes (it is the GLOBAL batch)"
             )
         local_bs = cfg.batch_size // nproc
-        total = count_lines(cfg.train_files)
-        steps_per_epoch = -(-total // cfg.batch_size)  # ceil
         pid = jax.process_index()
-        log(
-            f"input sharding: {total} rows over {nproc} processes, "
-            f"{steps_per_epoch} steps/epoch, {local_bs} rows/process/step"
-        )
 
-        def train_stream(epoch):
-            return _stream(
-                cfg,
-                cfg.train_files,
-                max_nnz,
-                epochs=1,
-                batch_size=local_bs,
-                shard_index=pid,
-                shard_count=nproc,
-                shard_block=local_bs,
-                pad_to_batches=steps_per_epoch,
-                to_batch=to_batch,
-                shuffle_epoch=epoch,
+        if cached_data is None:
+            # (device_cache keeps its resident index stream — each
+            # process already staged only its rows at load time; only
+            # the STREAMED path shards the text/FMB stream per step, and
+            # only it needs the up-front line count for the fixed
+            # steps-per-epoch padding.)
+            total = count_lines(cfg.train_files)
+            steps_per_epoch = -(-total // cfg.batch_size)  # ceil
+            log(
+                f"input sharding: {total} rows over {nproc} processes, "
+                f"{steps_per_epoch} steps/epoch, {local_bs} rows/process/step"
             )
+
+            def train_stream(epoch):
+                return _stream(
+                    cfg,
+                    cfg.train_files,
+                    max_nnz,
+                    epochs=1,
+                    batch_size=local_bs,
+                    shard_index=pid,
+                    shard_count=nproc,
+                    shard_block=local_bs,
+                    pad_to_batches=steps_per_epoch,
+                    to_batch=to_batch,
+                    shuffle_epoch=epoch,
+                )
 
         def to_batch(parsed, w):
             return make_global_batch(mesh, parsed, w, with_fields=model.uses_fields)

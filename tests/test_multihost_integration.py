@@ -430,3 +430,69 @@ def test_two_process_packed_train_resume_predict(tmp_path):
     one = np.loadtxt(tmp_path / "scores_pk_single.txt")
     assert dist.shape == one.shape == (96,)
     np.testing.assert_allclose(dist, one, atol=5e-5)
+
+
+WORKER_DEVCACHE = textwrap.dedent(
+    """
+    import sys
+    pid, nproc, port, tmp = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+    jax.distributed.initialize(f"127.0.0.1:{{port}}", num_processes=nproc, process_id=pid)
+
+    from fast_tffm_tpu.config import Config
+    from fast_tffm_tpu.training import dist_train
+
+    cfg = Config(
+        model="fm", factor_num=4, vocabulary_size=128,
+        model_file=f"{{tmp}}/model_dc.orbax", checkpoint_format="orbax",
+        train_files=(f"{{tmp}}/train.libsvm",),
+        epoch_num=2, batch_size=32, learning_rate=0.1, log_every=5,
+        row_parallel=2, device_cache=True, binary_cache=True,
+        binary_cache_wait=30,
+    ).validate()
+    state = dist_train(cfg, log=lambda m: print(f"[{{pid}}] {{m}}", flush=True))
+    print(f"[{{pid}}] DONE step={{int(state.step)}}", flush=True)
+    """
+).format(repo=REPO)
+
+
+@pytest.mark.slow
+def test_two_process_device_cache_matches_streamed(tmp_path):
+    """device_cache on a REAL two-process mesh: each process stages only
+    its block-cyclic rows of every global batch and contributes its own
+    devices' slice (make_array_from_process_local_data) — and the final
+    table equals plain single-process streamed training of the same data
+    (the resident path is bit-identical to streaming by construction,
+    and multi-host assembly must not change that)."""
+    _write_data(tmp_path)
+    outs = _run_workers(WORKER_DEVCACHE, tmp_path)
+    steps_per_epoch = -(-N_ROWS // 32)
+    for i, out in enumerate(outs):
+        assert f"[{i}] DONE step={2 * steps_per_epoch}" in out, out
+    assert "device cache:" in outs[0], outs[0]
+
+    import jax
+
+    from fast_tffm_tpu.checkpoint import restore_checkpoint
+    from fast_tffm_tpu.config import Config
+    from fast_tffm_tpu.models import FMModel
+    from fast_tffm_tpu.trainer import init_state
+    from fast_tffm_tpu.training import train
+
+    model = FMModel(vocabulary_size=128, factor_num=4)
+    restored = restore_checkpoint(
+        str(tmp_path / "model_dc.orbax"), init_state(model, jax.random.key(0))
+    )
+    cfg = Config(
+        model="fm", factor_num=4, vocabulary_size=128,
+        model_file=str(tmp_path / "single_dc.ckpt"),
+        train_files=(str(tmp_path / "train.libsvm"),),
+        epoch_num=2, batch_size=32, learning_rate=0.1, log_every=10**9,
+    ).validate()
+    single = train(cfg, log=lambda *_: None)
+    np.testing.assert_allclose(
+        np.asarray(restored.table), np.asarray(single.table), rtol=2e-4, atol=2e-6
+    )
