@@ -111,32 +111,63 @@ def time_step(step, state, batches, warmup=5, iters=30, windows=3, sync=None):
     return iters / best
 
 
+def _knee_extra(step, state_fn, rng, knee_batch, nnz, vocab, num_fields=0):
+    """Measure the same step at the KNEE batch (the dense sweep's
+    per-step cost amortizes with B — PROBE_KNEE_r04.json); returns extra
+    row keys, or an error key if the bigger shape doesn't fit/compile.
+    ``state_fn`` builds a FRESH state: the base measurement's donated
+    buffers are already consumed (measured: reusing the handle fails
+    with "Array has been deleted")."""
+    try:
+        kb = [make_batch(rng, knee_batch, nnz, vocab, num_fields) for _ in range(4)]
+        sps = time_step(step, state_fn(), kb, warmup=2, iters=10)
+        return {
+            "knee_batch": knee_batch,
+            "knee_value": round(knee_batch * sps / jax.device_count(), 1),
+        }
+    except Exception as e:
+        return {"knee_batch": knee_batch, "knee_error": str(e)[:100]}
+
+
 def bench_local(name, model, batch_size, nnz, vocab, num_fields=0, lr=0.01,
-                layout="rows"):
+                layout="rows", knee_batch=None):
     if layout == "packed":
         from fast_tffm_tpu.trainer import init_packed_state, make_packed_train_step
 
-        state = init_packed_state(model, jax.random.key(0))
+        state_fn = lambda: init_packed_state(model, jax.random.key(0))
         step = make_packed_train_step(model, lr)
     else:
-        state = init_state(model, jax.random.key(0))
+        state_fn = lambda: init_state(model, jax.random.key(0))
         step = make_train_step(model, lr)
     rng = np.random.default_rng(0)
     batches = [make_batch(rng, batch_size, nnz, vocab, num_fields) for _ in range(8)]
-    sps = time_step(step, state, batches)
-    report(name, batch_size * sps / jax.device_count())
+    sps = time_step(step, state_fn(), batches)
+    extra = (
+        _knee_extra(step, state_fn, rng, knee_batch, nnz, vocab, num_fields)
+        if knee_batch
+        else {}
+    )
+    report(name, batch_size * sps / jax.device_count(), **extra)
 
 
-def bench_sharded(name, model, batch_size, nnz, vocab, lr=0.01, layout="rows"):
+def bench_sharded(name, model, batch_size, nnz, vocab, lr=0.01, layout="rows",
+                  knee_batch=None):
     from fast_tffm_tpu.parallel import init_sharded_state, make_mesh, make_sharded_train_step
 
     mesh = make_mesh(None, jax.device_count())  # all visible chips on the row axis
-    state = init_sharded_state(model, mesh, jax.random.key(0), table_layout=layout)
+    state_fn = lambda: init_sharded_state(
+        model, mesh, jax.random.key(0), table_layout=layout
+    )
     step = make_sharded_train_step(model, lr, mesh, table_layout=layout)
     rng = np.random.default_rng(0)
     batches = [make_batch(rng, batch_size, nnz, vocab) for _ in range(8)]
-    sps = time_step(step, state, batches)
-    report(name, batch_size * sps / jax.device_count())
+    sps = time_step(step, state_fn(), batches)
+    extra = (
+        _knee_extra(step, state_fn, rng, knee_batch, nnz, vocab)
+        if knee_batch
+        else {}
+    )
+    report(name, batch_size * sps / jax.device_count(), **extra)
 
 
 def report(name, value, unit="examples/sec/chip", **extra):
@@ -229,29 +260,30 @@ def main():
     guard(bench_local,
         "cfg1p: train ex/s/chip (cfg1 + table_layout=packed)",
         FMModel(vocabulary_size=1 << 20, factor_num=8, order=2),
-        B, 39, 1 << 20, lr=0.05, layout="packed",
+        B, 39, 1 << 20, lr=0.05, layout="packed", knee_batch=65536,
     )
     guard(bench_local,
         "cfg3p: train ex/s/chip (cfg3 FFM + table_layout=packed)",
         FFMModel(vocabulary_size=1 << 20, num_fields=22, factor_num=4),
         8192, 22, 1 << 20, num_fields=22, lr=0.05, layout="packed",
+        knee_batch=32768,
     )
     guard(bench_local,
         "cfg4p: train ex/s/chip (cfg4 DeepFM bf16 + table_layout=packed)",
         DeepFMModel(
             vocabulary_size=1 << 20, num_fields=39, factor_num=8, compute_dtype="bfloat16"
         ),
-        8192, 39, 1 << 20, lr=0.02, layout="packed",
+        8192, 39, 1 << 20, lr=0.02, layout="packed", knee_batch=32768,
     )
     guard(bench_local,
         "cfg5p: train ex/s/chip (cfg5 order3 ANOVA + table_layout=packed)",
         FMModel(vocabulary_size=1 << 20, factor_num=8, order=3),
-        B, 11, 1 << 20, lr=0.05, layout="packed",
+        B, 11, 1 << 20, lr=0.05, layout="packed", knee_batch=65536,
     )
     guard(bench_sharded,
         "cfg2p: train ex/s/chip (cfg2 mesh step + table_layout=packed)",
         FMModel(vocabulary_size=1 << 24, factor_num=16, order=2),
-        B, 39, 1 << 24, lr=0.05, layout="packed",
+        B, 39, 1 << 24, lr=0.05, layout="packed", knee_batch=65536,
     )
 
     _watchdog.cancel()

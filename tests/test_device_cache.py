@@ -249,3 +249,33 @@ def test_device_cache_dist_train_packed_bit_identical(tmp_path, fmb_files):
     np.testing.assert_array_equal(
         np.asarray(st_stream.table_opt.accum), np.asarray(st_cache.table_opt.accum)
     )
+
+
+def test_load_host_arrays_process_shards_reassemble(fmb_files):
+    """The multi-host staging math, pinned WITHOUT real processes: the
+    per-process shards (_load_host_arrays with shard_count=P) must
+    concatenate — per batch, in process order — to exactly the
+    unsharded staging arrays (the make_global_batch assembly invariant
+    the resident multi-host path relies on)."""
+    from fast_tffm_tpu.data.device_cache import _load_host_arrays
+
+    kw = dict(batch_size=32, vocabulary_size=200, max_nnz=8)
+    full, batches, n_rows = _load_host_arrays(fmb_files, **kw)
+    shard0, b0, _ = _load_host_arrays(fmb_files, shard_index=0, shard_count=2, **kw)
+    shard1, b1, _ = _load_host_arrays(fmb_files, shard_index=1, shard_count=2, **kw)
+    assert b0 == b1 == batches
+    for key in ("labels", "ids", "vals", "weights"):
+        f = full[key].reshape((batches, 32) + full[key].shape[1:])
+        s0 = shard0[key].reshape((batches, 16) + shard0[key].shape[1:])
+        s1 = shard1[key].reshape((batches, 16) + shard1[key].shape[1:])
+        np.testing.assert_array_equal(np.concatenate([s0, s1], axis=1), f)
+
+
+def test_load_host_arrays_rejects_indivisible_processes(fmb_files):
+    from fast_tffm_tpu.data.device_cache import _load_host_arrays
+
+    with pytest.raises(ValueError, match="not divisible"):
+        _load_host_arrays(
+            fmb_files, batch_size=32, vocabulary_size=200, max_nnz=8,
+            shard_index=0, shard_count=3,
+        )
