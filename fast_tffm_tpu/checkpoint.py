@@ -173,9 +173,16 @@ def _load_orbax_host(path: str, like: TrainState):
     # metadata, every array placed whole on one local device: a bare
     # restore() replays the SAVED device topology and fails outright when
     # the checkpoint came from a different mesh/process count — exactly
-    # the cross-topology case this host-side path exists for.
+    # the cross-topology case this host-side path exists for.  Land on
+    # the CPU backend when one exists: this path only needs host RAM, and
+    # placing a near-HBM-sized table whole on an accelerator device would
+    # OOM device memory for no reason (ADVICE r4).
     ckptr = ocp.StandardCheckpointer()
-    dev = SingleDeviceSharding(jax.local_devices()[0])
+    try:
+        host = jax.local_devices(backend="cpu")[0]
+    except RuntimeError:
+        host = jax.local_devices()[0]
+    dev = SingleDeviceSharding(host)
     abstract = jax.tree.map(
         lambda m: jax.ShapeDtypeStruct(tuple(m.shape), m.dtype, sharding=dev),
         _orbax_metadata_item(path),

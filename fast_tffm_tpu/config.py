@@ -25,7 +25,8 @@ class Config:
     order: int = 2
     num_fields: int = 0  # required for ffm/deepfm
     hidden_dims: tuple[int, ...] = (400, 400, 400)  # deepfm MLP head
-    compute_dtype: str = "float32"  # deepfm MLP matmul precision (float32|bfloat16)
+    compute_dtype: str = "float32"  # MXU input precision: deepfm MLP matmuls
+    #   and ffm interaction einsums (float32 | bfloat16; accumulation stays f32)
     vocabulary_size: int = 1 << 20
     vocabulary_block_num: int = 1  # reference key; default row_parallel
     hash_feature_id: bool = False
@@ -48,10 +49,11 @@ class Config:
     bias_lambda: float = 0.0
     init_accumulator_value: float = 0.1
     adagrad_accumulator: str = "element"  # element (TF parity) | row (D×-smaller state)
-    packed_update: str = "auto"  # packed-layout sparse tail: auto | dense | sorted
+    packed_update: str = "auto"  # packed sparse tail: auto | dense | compact | sorted
     #   (dense = wide scatter-add into a [VP,128] grad buffer + dense Adagrad
-    #   sweep, measured 3.5× the sorted pipeline; sorted = no table-sized
-    #   temporary, the giant-vocab fallback; auto picks by size)
+    #   sweep, measured 3.5× the sorted pipeline; compact = sort-free
+    #   touched-row compaction, O(M) buffers — the giant-vocab path; sorted =
+    #   the bit-parity reference pipeline; auto picks dense/compact by size)
     thread_num: int = 0  # host-side parse workers; 0 = all cores (reference: queue threads)
     binary_cache: bool = False  # parse text once into <file>.fmb, stream that
     binary_cache_wait: float = 600.0  # multi-host: non-lead wait for lead's build (s)
@@ -133,9 +135,10 @@ class Config:
             raise ValueError(
                 f"init_accumulator_value must be > 0, got {self.init_accumulator_value}"
             )
-        if self.packed_update not in ("auto", "dense", "sorted"):
+        if self.packed_update not in ("auto", "dense", "compact", "sorted"):
             raise ValueError(
-                f"unknown packed_update {self.packed_update!r} (auto | dense | sorted)"
+                f"unknown packed_update {self.packed_update!r} "
+                "(auto | dense | compact | sorted)"
             )
         if self.packed_update != "auto" and self.table_layout != "packed":
             # Silently inert knobs corrupt A/B comparisons: a run that
@@ -153,12 +156,12 @@ class Config:
         ):
             # The sorted packed update's whole-tile-row RMW is exact only
             # with the element accumulator (zero-grad identity per LANE);
-            # the row accumulator's [VP, P] scalar slots need the dense-G
-            # sweep (which handles both granularities — the auto default).
+            # the row accumulator's [VP, P] scalar slots need a scatter-add
+            # tail (dense or compact — both handle both granularities).
             raise ValueError(
                 "table_layout = packed with adagrad_accumulator = row "
-                "requires packed_update = auto or dense (the sorted "
-                "whole-tile-row RMW needs the element accumulator)"
+                "requires packed_update = auto, dense or compact (the "
+                "sorted whole-tile-row RMW needs the element accumulator)"
             )
         return self
 
@@ -290,6 +293,7 @@ def build_model(cfg: Config):
             init_value_range=cfg.init_value_range,
             factor_lambda=cfg.factor_lambda,
             bias_lambda=cfg.bias_lambda,
+            compute_dtype=cfg.compute_dtype,
         )
     return DeepFMModel(
         vocabulary_size=cfg.vocabulary_size,

@@ -176,7 +176,7 @@ def routed_update(
 
     When ``shard_logical_rows`` is given the shards are LANE-PACKED
     ([VPs, 128] — ops/packed_table.py) and ``packed_mode`` picks the
-    packed tail ('dense' | 'sorted'); the routing itself is unchanged
+    packed tail ('dense' | 'compact' | 'sorted'); the routing is unchanged
     (deduped logical ids + summed grads ride the same all_to_all), only
     the final per-shard apply reads/writes the packed layout.
 
@@ -197,10 +197,10 @@ def routed_update(
     from fast_tffm_tpu.optim import dedup_rows
 
     packed = shard_logical_rows is not None
-    if packed and packed_mode not in ("dense", "sorted"):
+    if packed and packed_mode not in ("dense", "compact", "sorted"):
         raise ValueError(
-            f"packed routed_update needs packed_mode 'dense' or 'sorted', "
-            f"got {packed_mode!r} (pass resolve_packed_update's result)"
+            f"packed routed_update needs packed_mode 'dense', 'compact' or "
+            f"'sorted', got {packed_mode!r} (pass resolve_packed_update's result)"
         )
     D = row_grads.shape[-1]
     shard_rows = shard_logical_rows if packed else table_shard.shape[0]
@@ -229,21 +229,13 @@ def routed_update(
     guids, ggsum = dedup_rows(all_ids, all_g, num_rows_global)
 
     if packed:
-        from fast_tffm_tpu.ops.packed_table import (
-            packed_dense_adagrad_update,
-            packed_sparse_adagrad_update,
-            rows_per_tile,
-        )
+        from fast_tffm_tpu.ops.packed_table import PACKED_UPDATE_FNS, rows_per_tile
         from fast_tffm_tpu.parallel.embedding import owned_local_ids
 
         p = rows_per_tile(D)
         # Unowned and sentinel ids map past the last physical row → drop.
         local, _ = owned_local_ids(guids, shard_rows, table_shard.shape[0] * p)
-        update_fn = (
-            packed_dense_adagrad_update
-            if packed_mode == "dense"
-            else packed_sparse_adagrad_update  # packed_mode == 'sorted'
-        )
+        update_fn = PACKED_UPDATE_FNS[packed_mode]
         table_shard, accum_shard = update_fn(
             table_shard, accum_shard, local, ggsum, lr
         )

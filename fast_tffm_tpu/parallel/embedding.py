@@ -85,6 +85,17 @@ def sharded_gather(table_shard: jax.Array, ids: jax.Array) -> jax.Array:
     Returns:     [B_local, N, D] rows for this chip's ids.
     """
     shard_rows = table_shard.shape[0]
+    if lax.axis_size(ROW_AXIS) == 1:
+        # One row shard: every id is local, the gather/scatter collectives
+        # are identities and the owned masking is a full-true mask — skip
+        # them all (axis_size is static, so this is a trace-time branch;
+        # mesh>1 programs are unchanged).  Measured: the masking multiply
+        # + identity collectives cost ~40% of the mesh=1 step (VERDICT r4
+        # weak #3).  NOTE this assumes batch ids < padded vocab (the
+        # drivers guarantee it): an out-of-range id would CLAMP to the
+        # last row here (single-device gather semantics) where the
+        # mesh>1 path returns zeros for unowned ids.
+        return table_shard[ids]
     base = lax.axis_index(ROW_AXIS) * shard_rows
     # Ids are int32 and tiny next to D-wide rows; gather all ROW peers' ids,
     # serve the rows we own, and reduce-scatter each peer its answers (each
@@ -115,6 +126,13 @@ def sharded_sparse_adagrad_update(
     SURVEY.md §4.2).
     """
     D = table_shard.shape[-1]
+    if lax.axis_size(ROW_AXIS) == 1 and lax.axis_size(DATA_AXIS) == 1:
+        # 1×1 mesh: no peers to combine with — one dedup, straight to the
+        # shard apply (exactly the single-device step's structure).
+        guids, ggsum = dedup_rows(
+            ids.reshape(-1), row_grads.reshape(-1, D), num_rows_global
+        )
+        return apply_shard_adagrad(table_shard, accum_shard, guids, ggsum, lr, 0)
     uids, gsum = dedup_rows(ids.reshape(-1), row_grads.reshape(-1, D), num_rows_global)
     all_uids = lax.all_gather(uids, (DATA_AXIS, ROW_AXIS), tiled=True)  # [P*M]
     all_gsum = lax.all_gather(gsum, (DATA_AXIS, ROW_AXIS), tiled=True)  # [P*M, D]
@@ -143,6 +161,11 @@ def packed_sharded_gather(
     """sharded_gather on a lane-packed shard: [B_local, N, D] rows."""
     from fast_tffm_tpu.ops.packed_table import packed_gather
 
+    if lax.axis_size(ROW_AXIS) == 1:
+        # One row shard: skip the identity collectives and the full-true
+        # owned masking (see sharded_gather — same in-range-id assumption:
+        # OOB ids clamp here instead of zeroing).
+        return packed_gather(packed_shard, ids, d)
     all_ids = lax.all_gather(ids, ROW_AXIS, tiled=True)  # [R*B_local, N]
     local, owned = owned_local_ids(all_ids, shard_logical_rows, 0)
     rows = packed_gather(packed_shard, local, d)
@@ -172,6 +195,14 @@ def packed_sharded_update(
 
     D = row_grads.shape[-1]
     p = rows_per_tile(D)
+    if lax.axis_size(ROW_AXIS) == 1 and lax.axis_size(DATA_AXIS) == 1:
+        # 1×1 mesh: the packed update's lane-space segment-sum already
+        # handles duplicate raw ids, so the local dedup + identity
+        # collectives + owned mapping all vanish — this IS the
+        # single-device packed sorted step.
+        return packed_sparse_adagrad_update(
+            packed_shard, accum_shard, ids, row_grads, lr
+        )
     uids, gsum = dedup_rows(ids.reshape(-1), row_grads.reshape(-1, D), num_rows_global)
     all_uids = lax.all_gather(uids, (DATA_AXIS, ROW_AXIS), tiled=True)
     all_gsum = lax.all_gather(gsum, (DATA_AXIS, ROW_AXIS), tiled=True)
@@ -190,33 +221,42 @@ def packed_sharded_dense_update(
     row_grads: jax.Array,
     lr: float,
     shard_logical_rows: int,
+    mode: str = "dense",
 ):
-    """packed_sharded_update via the DENSE gradient buffer — no sorts.
+    """packed_sharded_update via scatter-ADD dedup — no sorts.
 
     The sorted path dedups locally before the all-gather only to keep
-    Adagrad's sum-once semantics through its segment pipeline; the dense
-    buffer gets those semantics from the scatter-ADD itself (duplicates
-    sum in flat order), so this path ships the RAW per-occurrence grads
-    — the all-gather payload is the same [M, D] bytes either way — and
-    each shard scatter-adds the ids it owns into its own [VPs, 128]
-    buffer (unowned ids map past the last physical row and drop).  Every
-    ROW replica sees the identical gathered arrays in the identical
-    order, so the summed G (and hence the shard) is bit-consistent
-    across replicas, and the whole update is bit-identical to the
-    single-device dense step on the same global batch (flat-order sums;
-    test-pinned on the CPU mesh).
+    Adagrad's sum-once semantics through its segment pipeline; the
+    scatter-ADD paths get those semantics from the scatter itself
+    (duplicates sum in flat order), so this path ships the RAW
+    per-occurrence grads — the all-gather payload is the same [M, D]
+    bytes either way — and each shard applies the ids it owns (unowned
+    ids map past the last physical row and drop).  ``mode`` picks the
+    tail: ``dense`` scatter-adds into a [VPs, 128] buffer + dense sweep;
+    ``compact`` compacts touched rows sort-free (giant shards — DESIGN
+    §6 round 5).  Every ROW replica sees the identical gathered arrays
+    in the identical order, so the summed G (and hence the shard) is
+    bit-consistent across replicas, and the whole update is
+    bit-identical to the single-device step of the same mode on the same
+    global batch (flat-order sums; test-pinned on the CPU mesh).
     """
-    from fast_tffm_tpu.ops.packed_table import (
-        packed_dense_adagrad_update,
-        rows_per_tile,
-    )
+    from fast_tffm_tpu.ops.packed_table import PACKED_UPDATE_FNS, rows_per_tile
 
     D = row_grads.shape[-1]
     p = rows_per_tile(D)
+    update_fn = PACKED_UPDATE_FNS[mode]
     flat_ids = ids.reshape(-1)
+    flat_g = row_grads.reshape(-1, D)
+    one_shard = lax.axis_size(ROW_AXIS) == 1
+    if one_shard and lax.axis_size(DATA_AXIS) == 1:
+        # 1×1 mesh: no combine, no owned mapping (batch ids are already
+        # in-range logical ids) — this IS the single-device packed step.
+        return update_fn(packed_shard, accum_shard, flat_ids, flat_g, lr)
     all_ids = lax.all_gather(flat_ids, (DATA_AXIS, ROW_AXIS), tiled=True)
-    all_g = lax.all_gather(
-        row_grads.reshape(-1, D), (DATA_AXIS, ROW_AXIS), tiled=True
-    )
+    all_g = lax.all_gather(flat_g, (DATA_AXIS, ROW_AXIS), tiled=True)
+    if one_shard:
+        # One row shard, several data peers: the combine is needed but
+        # every gathered id is owned — skip the identity owned mapping.
+        return update_fn(packed_shard, accum_shard, all_ids, all_g, lr)
     local, _ = owned_local_ids(all_ids, shard_logical_rows, packed_shard.shape[0] * p)
-    return packed_dense_adagrad_update(packed_shard, accum_shard, local, all_g, lr)
+    return update_fn(packed_shard, accum_shard, local, all_g, lr)

@@ -172,27 +172,40 @@ def packed_shard_meta(model, mesh: Mesh):
 
 def unpack_sharded_to_logical(state: TrainState, model, mesh: Mesh) -> TrainState:
     """Lane-packed row-sharded state -> host LOGICAL [V, D] arrays
-    (per-shard unpack; checkpoints always hold the logical layout)."""
+    (per-shard unpack; checkpoints always hold the logical layout).
+
+    The unpack itself runs in PURE NUMPY on the fetched host copy — the
+    whole point of this path (the single-process save route, ADVICE r4)
+    is to avoid device-memory transients next to the live packed state,
+    so nothing here may round-trip through jnp."""
     import numpy as np
 
-    from fast_tffm_tpu.ops.packed_table import unpack_accum_any, unpack_table
+    from fast_tffm_tpu.ops.packed_table import LANES, rows_per_tile
 
     _, shard_logical, p = packed_shard_meta(model, mesh)
     R = mesh.shape[ROW_AXIS]
     d = model.row_dim
 
+    def unp_table(a):  # numpy twin of ops.packed_table.unpack_table
+        return a[:, : p * d].reshape(a.shape[0] * p, d)[:shard_logical]
+
+    def unp_accum(a):  # numpy twin of unpack_accum_any (same trailing-dim sniff)
+        if a.shape[-1] == LANES and rows_per_tile(d) != LANES:
+            return unp_table(a)
+        q = a.shape[-1]
+        return a.reshape(a.shape[0] * q, 1)[:shard_logical]
+
     def unp(arr, unpack):
         a = np.asarray(arr)
         per = a.shape[0] // R
-        return np.concatenate([
-            np.asarray(unpack(jnp.asarray(a[r * per : (r + 1) * per]), shard_logical, d))
-            for r in range(R)
-        ])
+        return np.concatenate(
+            [unpack(a[r * per : (r + 1) * per]) for r in range(R)]
+        )
 
     return state._replace(
-        table=unp(state.table, unpack_table),
+        table=unp(state.table, unp_table),
         table_opt=state.table_opt._replace(
-            accum=unp(state.table_opt.accum, unpack_accum_any)
+            accum=unp(state.table_opt.accum, unp_accum)
         ),
     )
 
@@ -403,10 +416,10 @@ def make_sharded_train_step(
                 mode = resolve_packed_update(
                     packed_update, table.shape[0], accum.shape[-1]
                 )
-                if mode == "dense":
+                if mode in ("dense", "compact"):
                     t2, a2 = packed_sharded_dense_update(
                         table, accum, batch.ids, g_rows, learning_rate,
-                        shard_logical_rows,
+                        shard_logical_rows, mode=mode,
                     )
                 else:
                     t2, a2 = packed_sharded_update(

@@ -181,12 +181,12 @@ def packed_train_step_body(
     ``update`` picks the sparse-tail strategy (resolve_packed_update):
     ``dense`` — one wide scatter-add into a [VP, 128] gradient buffer +
     a dense Adagrad sweep (measured 3.5× the sorted path at vocab 2^24);
-    ``sorted`` — sort/segment-sum/RMW, no table-sized temporary (the
-    giant-vocab fallback); ``auto`` — dense under DENSE_G_MAX_BYTES."""
+    ``compact`` — sort-free touched-row compaction, O(M) buffers (the
+    giant-vocab path); ``sorted`` — sort/segment-sum/RMW (bit-parity
+    reference); ``auto`` — dense under DENSE_G_MAX_BYTES, else compact."""
     from fast_tffm_tpu.ops.packed_table import (
-        packed_dense_adagrad_update,
+        PACKED_UPDATE_FNS,
         packed_gather,
-        packed_sparse_adagrad_update,
         resolve_packed_update,
     )
 
@@ -200,10 +200,7 @@ def packed_train_step_body(
 
     acc = state.table_opt.accum
     mode = resolve_packed_update(update, state.table.shape[0], acc.shape[-1])
-    update_fn = (
-        packed_dense_adagrad_update if mode == "dense"
-        else packed_sparse_adagrad_update
-    )
+    update_fn = PACKED_UPDATE_FNS[mode]
     table, accum = update_fn(
         state.table, acc, batch.ids, g_rows, learning_rate
     )

@@ -625,15 +625,25 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
     )
     dist_saveable = None
     if cfg.table_layout == "packed":
-        # Checkpoints hold LOGICAL [V, D] arrays.  Unpack per shard ON
-        # DEVICE: the result is a row-sharded logical state the normal
-        # checkpoint writer handles on any process count (orbax writes
-        # each host's shards in parallel; single-process npz fetches the
-        # one process's arrays as before).
-        from fast_tffm_tpu.parallel import unpack_sharded_on_device
+        # Checkpoints hold LOGICAL [V, D] arrays.  Multi-process: unpack
+        # per shard ON DEVICE — the result is a row-sharded logical state
+        # orbax writes per host in parallel (no host gather of
+        # non-addressable shards).  Single-process: unpack through HOST
+        # RAM instead — the on-device unpack would materialize a full
+        # logical copy of table+accumulator NEXT TO the live packed state
+        # at every save, a ~2× transient HBM peak that OOMs exactly the
+        # big-table runs (ADVICE r4).
+        from fast_tffm_tpu.parallel import (
+            unpack_sharded_on_device,
+            unpack_sharded_to_logical,
+        )
 
-        def dist_saveable(st):
-            return unpack_sharded_on_device(st, model, mesh)
+        if jax.process_count() > 1:
+            def dist_saveable(st):
+                return unpack_sharded_on_device(st, model, mesh)
+        else:
+            def dist_saveable(st):
+                return unpack_sharded_to_logical(st, model, mesh)
 
     cached_data = None
     if cfg.device_cache:

@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""Decompose cfg3p (packed FFM, D=89, P=1) — where does the time go?
+
+VERDICT r4 #4: cfg3p measured 372k ex/s (0.74× the bar) and no DESIGN
+entry pins where its step time goes.  Stages, marginal-slope timed
+(probe_scale_ops methodology) at the cfg3p knee shape (B=32768, N=22
+fields, vocab 2^20, lane-packed P=1):
+
+  gather      packed wide gather [M, 128] (89/128 useful lanes)
+  fwd         FFM score (one-hot einsum T build + cross + diag)
+  fwdbwd      score + hand-offs through jax.grad
+  upd_dense / upd_sorted / upd_compact
+              the three packed sparse tails at this shape
+  step_f32 / step_bf16
+              the full jitted step, f32 vs bfloat16 interaction einsums
+              (models/ffm.py compute_dtype), interleaved A/B
+
+Writes PROBE_FFM_r05.json.
+"""
+
+import dataclasses
+import json
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import _bench_watchdog
+
+_watchdog = _bench_watchdog.arm(seconds=2700, what="probe_ffm.py")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import bench
+import bench_all
+from fast_tffm_tpu.models import FFMModel
+from fast_tffm_tpu.optim import AdagradState
+from fast_tffm_tpu.ops.packed_table import (
+    LANES,
+    packed_compact_adagrad_update,
+    packed_dense_adagrad_update,
+    packed_gather,
+    packed_rows,
+    packed_sparse_adagrad_update,
+    rows_per_tile,
+)
+from fast_tffm_tpu.trainer import (
+    TrainState,
+    batch_loss,
+    init_packed_state,
+    make_packed_train_step,
+)
+
+B = 32768
+F = 22
+K = 4
+VOCAB = 1 << 20
+
+
+def slope_ms(jfn, args, k_lo=2, k_hi=8, reps=3):
+    """Marginal ms per application.  Device arrays ride as jit ARGUMENTS —
+    a closed-over table embeds a 537 MB HLO constant and hangs the remote
+    compiler (observed; probe_scale_ops.py same note)."""
+    float(jfn(k_lo, *args))
+    float(jfn(k_hi, *args))
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        float(jfn(k_lo, *args))
+        t_lo = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        float(jfn(k_hi, *args))
+        t_hi = time.perf_counter() - t0
+        best = min(best, (t_hi - t_lo) / (k_hi - k_lo))
+    return round(best * 1e3, 3)
+
+
+def main():
+    model = FFMModel(vocabulary_size=VOCAB, num_fields=F, factor_num=K)
+    d = model.row_dim  # 89
+    p = rows_per_tile(d)  # 1
+    vp = packed_rows(VOCAB, d)
+    m = B * F
+
+    rng = np.random.default_rng(0)
+    batch = bench_all.make_batch(rng, B, F, VOCAB, num_fields=F)
+    state = init_packed_state(model, jax.random.key(0))
+    table, accum = state.table, state.table_opt.accum
+    g_rows = jnp.asarray(rng.normal(size=(B, F, d)).astype(np.float32) * 1e-3)
+
+    out = {"B": B, "F": F, "vocab": VOCAB, "d": d, "p": p, "vp": vp, "m": m}
+
+    @partial(jax.jit, static_argnums=(0,))
+    def chain_gather(k, table, ids):
+        def body(i, s):
+            rows = packed_gather(table, jnp.bitwise_xor(ids, i), d)
+            return s + rows[0, 0, 0]
+        return jax.lax.fori_loop(0, k, body, jnp.float32(0))
+
+    out["gather_ms"] = slope_ms(chain_gather, (table, batch.ids))
+    print("gather_ms", out["gather_ms"], flush=True)
+
+    rows0 = packed_gather(table, batch.ids, d)
+
+    @partial(jax.jit, static_argnums=(0,))
+    def chain_fwd(k, rows0, batch):
+        def body(i, s):
+            sc = model.score(rows0 + 0 * jnp.float32(i), {}, batch)
+            return s + sc[0]
+        return jax.lax.fori_loop(0, k, body, jnp.float32(0))
+
+    out["fwd_ms"] = slope_ms(chain_fwd, (rows0, batch))
+    print("fwd_ms", out["fwd_ms"], flush=True)
+
+    @partial(jax.jit, static_argnums=(0,))
+    def chain_fwdbwd(k, table, batch):
+        def body(i, s):
+            rows = packed_gather(table, jnp.bitwise_xor(batch.ids, i), d)
+            (_, dl), (gr, _) = jax.value_and_grad(
+                partial(batch_loss, model), argnums=(0, 1), has_aux=True
+            )(rows, {}, batch)
+            return s + gr[0, 0, 0] + dl
+        return jax.lax.fori_loop(0, k, body, jnp.float32(0))
+
+    out["fwdbwd_ms"] = slope_ms(chain_fwdbwd, (table, batch))
+    print("fwdbwd_ms", out["fwdbwd_ms"], flush=True)
+
+    for tag, fn in (
+        ("upd_dense", packed_dense_adagrad_update),
+        ("upd_compact", packed_compact_adagrad_update),
+        ("upd_sorted", packed_sparse_adagrad_update),
+    ):
+        @partial(jax.jit, static_argnums=(0,))
+        def chain_upd(k, table, accum, ids, g_rows, fn=fn):
+            def body(i, carry):
+                t, a, s = carry
+                t, a = fn(t, a, jnp.bitwise_xor(ids, i), g_rows, 0.01)
+                return t, a, s + t[0, 0]
+            t, a, s = jax.lax.fori_loop(0, k, body, (table, accum, jnp.float32(0)))
+            return s + a[0, 0]
+
+        out[f"{tag}_ms"] = slope_ms(chain_upd, (table, accum, batch.ids, g_rows))
+        print(tag, out[f"{tag}_ms"], flush=True)
+
+    # Whole-step A/B: f32 vs bf16 interaction einsums, interleaved.
+    bench.BATCH = B
+    batches = [bench_all.make_batch(rng, B, F, VOCAB, num_fields=F) for _ in range(4)]
+    s32 = init_packed_state(model, jax.random.key(1))
+    step32 = make_packed_train_step(model, 0.05, "auto")
+    mb = dataclasses.replace(model, compute_dtype="bfloat16")
+    sbf = init_packed_state(mb, jax.random.key(1))
+    stepbf = make_packed_train_step(mb, 0.05, "auto")
+
+    # bench.interleaved_measure takes ONE step with two batch sets; here
+    # the A/B is two different executables, so alternate tight windows by
+    # hand (same-session medians, the same drift defense).
+    def rate(step, st):
+        st, _ = step(st, batches[0])
+        bench.forced_sync(st)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for i in range(10):
+                st, _ = step(st, batches[i % 4])
+            bench.forced_sync(st)
+            best = min(best, time.perf_counter() - t0)
+        return st, B * 10 / best
+
+    # Alternate windows: 32, bf, 32, bf — medians, same-session.
+    r32s, rbfs = [], []
+    s32, _ = rate(step32, s32)  # warm + first window discarded into list
+    sbf, _ = rate(stepbf, sbf)
+    for _ in range(3):
+        s32, r = rate(step32, s32)
+        r32s.append(r)
+        sbf, r = rate(stepbf, sbf)
+        rbfs.append(r)
+    out["step_f32_rate"] = round(sorted(r32s)[1], 1)
+    out["step_bf16_rate"] = round(sorted(rbfs)[1], 1)
+    out["bf16_speedup_x"] = round(out["step_bf16_rate"] / out["step_f32_rate"], 3)
+
+    path = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                        "PROBE_FFM_r05.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+    print("wrote", path)
+
+
+if __name__ == "__main__":
+    main()

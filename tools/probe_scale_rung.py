@@ -10,10 +10,16 @@ allocation poisons the process — bench._probe_rung):
   alloc_el   same with the ELEMENT [V, 9] accumulator (2.2 GB more)
   step       alloc + compile + run one donated train step (the r02 regime)
   step_nodon step without donation (XLA must double-buffer the table)
+  step_b4096 donated step at BATCH=4096 (VERDICT r4 #6: smaller per-step
+             transients — isolates batch-sized temporaries from the table)
+  step_packed lane-packed table + row accumulator + the sort-free COMPACT
+             update (r5): [VP, 128] layout, O(M) transients — the scale
+             regime's intended production path
 
 Run with no args for the driver sweep over sizes around the regression;
-`python tools/probe_scale_rung.py <stage> <vocab>` runs one stage.
-Prints one JSON dict (sweep mode).
+`python tools/probe_scale_rung.py <stage> <vocab>` runs one stage.  The
+sweep records the XLA_FLAGS in effect so flag-variation retries are
+distinguishable artifacts (VERDICT r4 #6).  Prints one JSON dict.
 """
 
 import json
@@ -24,7 +30,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-STAGES = ("alloc", "alloc_el", "step", "step_nodon")
+STAGES = ("alloc", "alloc_el", "step", "step_nodon", "step_b4096", "step_packed")
 SIZES = (1 << 27, 201_326_592, 234_881_024, 251_658_240, 1 << 28)
 
 
@@ -49,14 +55,34 @@ def run_stage(stage: str, vocab: int) -> None:
                 {}, AdagradState({}), state.step,
             )
         forced_sync(state)
+    elif stage == "step_packed":
+        from fast_tffm_tpu.ops.packed_table import LANES, packed_rows, rows_per_tile
+        from fast_tffm_tpu.trainer import make_packed_train_step
+
+        rng = np.random.default_rng(0)
+        model = FMModel(vocabulary_size=vocab, factor_num=SCALE_K, order=2)
+        d = 1 + SCALE_K
+        vp = packed_rows(vocab, d)
+        table = jax.jit(
+            lambda k: jax.random.uniform(k, (vp, LANES), jnp.float32, -0.01, 0.01)
+        )(jax.random.key(0))
+        state = TrainState(
+            table, AdagradState(jnp.full((vp, rows_per_tile(d)), 0.1, jnp.float32)),
+            {}, AdagradState({}), jnp.zeros((), jnp.int32),
+        )
+        step = make_packed_train_step(model, 0.01, "compact")
+        b = make_batch(zipf_ids(rng, (BATCH, NNZ), vocab), 0)
+        state, _ = step(state, b)
+        forced_sync(state)
     else:
         rng = np.random.default_rng(0)
         model = FMModel(vocabulary_size=vocab, factor_num=SCALE_K, order=2)
-        donate = (0,) if stage == "step" else ()
+        donate = () if stage == "step_nodon" else (0,)
+        batch_size = 4096 if stage == "step_b4096" else BATCH
         step = jax.jit(
             partial(train_step_body, model, 0.01), donate_argnums=donate
         )
-        b = make_batch(zipf_ids(rng, (BATCH, NNZ), vocab), 0)
+        b = make_batch(zipf_ids(rng, (batch_size, NNZ), vocab), 0)
         state = scale_state(vocab, SCALE_K)
         state, _ = step(state, b)
         forced_sync(state)
@@ -65,7 +91,7 @@ def run_stage(stage: str, vocab: int) -> None:
 
 
 def main() -> None:
-    res = {}
+    res = {"xla_flags": os.environ.get("XLA_FLAGS", "")}
     for vocab in SIZES:
         for stage in STAGES:
             key = f"{stage}@{vocab}"

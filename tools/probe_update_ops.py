@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""Sub-op decomposition of the packed compact update at vocab 2^24.
+
+Round-5 follow-up to PROBE_SCALE_OPS: the compact update measured 98 ms
+against a 34 ms whole dense step, and the step's HLO shows XLA wrapping
+scatter in a SORT-based dedup.  This probe times, marginal-slope style:
+
+  g_build        scatter-ADD [M,128] -> [K,128] (duplicate indices; the
+                 hidden sort lives here)
+  rmw_flagged    2 wide gathers + Adagrad + 2 scatters DECLARED unique +
+                 sorted (the new production RMW)
+  rmw_plain      same with default scatter flags (the old RMW)
+  gather_k128 / gather_k256
+                 wide gather [K,128] vs [K,256]: if ~equal, the ops are
+                 DESCRIPTOR-bound (per-row latency), not byte-bound —
+                 motivates merging table+accum RMW traffic
+  upd_compact / upd_sorted / upd_dense
+                 the three full tails after the unique+sorted flags
+
+Writes PROBE_UPDATE_OPS_r05.json.
+"""
+
+import json
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import _bench_watchdog
+
+_watchdog = _bench_watchdog.arm(seconds=2700, what="probe_update_ops.py")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bench import make_batch, zipf_ids
+from fast_tffm_tpu.ops.packed_table import (
+    LANES,
+    lane_spread,
+    packed_compact_adagrad_update,
+    packed_dense_adagrad_update,
+    packed_rows,
+    packed_sparse_adagrad_update,
+    rows_per_tile,
+)
+
+BATCH = 16384
+NNZ = 39
+K_FACTORS = 8
+D = 1 + K_FACTORS
+P = rows_per_tile(D)
+VOCAB = 1 << 24
+
+
+def slope_ms(jfn, args, k_lo=2, k_hi=8, reps=3):
+    float(jfn(k_lo, *args))
+    float(jfn(k_hi, *args))
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        float(jfn(k_lo, *args))
+        t_lo = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        float(jfn(k_hi, *args))
+        t_hi = time.perf_counter() - t0
+        best = min(best, (t_hi - t_lo) / (k_hi - k_lo))
+    return round(best * 1e3, 3)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    vp = packed_rows(VOCAB, D)
+    m = BATCH * NNZ
+    k_cap = min(vp, m)
+
+    table = jax.jit(
+        lambda key: jax.random.uniform(key, (vp, LANES), jnp.float32, -0.01, 0.01)
+    )(jax.random.key(0))
+    accum = jnp.full((vp, LANES), 0.1, jnp.float32)
+    ids = jnp.asarray(zipf_ids(rng, (BATCH, NNZ), VOCAB))
+    flat = ids.reshape(-1)
+    g128 = jnp.asarray(rng.normal(size=(m, LANES)).astype(np.float32) * 1e-3)
+    g_rows = jnp.asarray(rng.normal(size=(BATCH, NNZ, D)).astype(np.float32) * 1e-3)
+    # Compacted unique ascending uphys + per-slot sums, prebuilt on host.
+    uniq = np.unique((np.asarray(flat) // P).astype(np.int32))
+    un = uniq.shape[0]
+    uphys_np = (vp + np.arange(k_cap, dtype=np.int32))
+    uphys_np[:un] = uniq
+    uphys = jnp.asarray(uphys_np)
+    Gsum = jnp.asarray(rng.normal(size=(k_cap, LANES)).astype(np.float32) * 1e-3)
+
+    out = {"vocab": VOCAB, "vp": vp, "m": m, "k_cap": k_cap, "unique_phys": int(un)}
+
+    phys = (flat // P).astype(jnp.int32)
+    slot_lane = (flat % P).astype(jnp.int32)
+
+    @partial(jax.jit, static_argnums=(0,))
+    def chain_gbuild(k, flat, g128):
+        def body(i, s):
+            ph = ((jnp.bitwise_xor(flat, i) // P)).astype(jnp.int32)
+            G = jnp.zeros((k_cap, LANES), jnp.float32).at[
+                jnp.minimum(ph, k_cap - 1)
+            ].add(g128, mode="drop")
+            return s + G[0, 0]
+        return jax.lax.fori_loop(0, k, body, jnp.float32(0))
+
+    out["g_build_ms"] = slope_ms(chain_gbuild, (flat, g128))
+    print("g_build_ms", out["g_build_ms"], flush=True)
+
+    def make_rmw(flagged):
+        kw = dict(mode="drop")
+        if flagged:
+            kw.update(unique_indices=True, indices_are_sorted=True)
+
+        @partial(jax.jit, static_argnums=(0,))
+        def chain_rmw(k, table, accum, uphys, Gsum):
+            def body(i, carry):
+                t, a, s = carry
+                safe = jnp.minimum(uphys, vp - 1)
+                cur = t[safe]
+                acc = a[safe]
+                acc2 = acc + Gsum * Gsum
+                new = cur - 0.01 * Gsum / jnp.sqrt(acc2)
+                t = t.at[uphys].set(new, **kw)
+                a = a.at[uphys].set(acc2, **kw)
+                return t, a, s + new[0, 0]
+            t, a, s = jax.lax.fori_loop(0, k, body, (table, accum, jnp.float32(0)))
+            return s + t[0, 0] + a[0, 0]
+
+        return chain_rmw
+
+    out["rmw_flagged_ms"] = slope_ms(
+        make_rmw(True), (table, accum, uphys, Gsum)
+    )
+    print("rmw_flagged_ms", out["rmw_flagged_ms"], flush=True)
+    out["rmw_plain_ms"] = slope_ms(
+        make_rmw(False), (table, accum, uphys, Gsum)
+    )
+    print("rmw_plain_ms", out["rmw_plain_ms"], flush=True)
+
+    # Descriptor-vs-byte bound: [K,128] vs [K,256] wide gathers.
+    table256 = jnp.concatenate([table, table], axis=1)
+
+    @partial(jax.jit, static_argnums=(0,))
+    def chain_gather128(k, table, uphys):
+        def body(i, s):
+            # XOR with the loop index so the gather cannot hoist out.
+            rows = table[jnp.minimum(jnp.bitwise_xor(uphys, i), vp - 1)]
+            return s + rows[0, 0]
+        return jax.lax.fori_loop(0, k, body, jnp.float32(0))
+
+    out["gather_k128_ms"] = slope_ms(chain_gather128, (table, uphys))
+    print("gather_k128_ms", out["gather_k128_ms"], flush=True)
+    out["gather_k256_ms"] = slope_ms(chain_gather128, (table256, uphys))
+    print("gather_k256_ms", out["gather_k256_ms"], flush=True)
+    del table256
+
+    # Full tails with the round-5 flags (2^24 fits the chain's double buffer).
+    for tag, fn in (
+        ("upd_compact", packed_compact_adagrad_update),
+        ("upd_sorted", packed_sparse_adagrad_update),
+        ("upd_dense", packed_dense_adagrad_update),
+    ):
+        @partial(jax.jit, static_argnums=(0,))
+        def chain_upd(k, table, accum, ids, g_rows, fn=fn):
+            def body(i, carry):
+                t, a, s = carry
+                t, a = fn(t, a, jnp.bitwise_xor(ids, i), g_rows, 0.01)
+                return t, a, s + t[0, 0]
+            t, a, s = jax.lax.fori_loop(0, k, body, (table, accum, jnp.float32(0)))
+            return s + a[0, 0]
+
+        out[f"{tag}_ms"] = slope_ms(chain_upd, (table, accum, ids, g_rows))
+        print(tag, out[f"{tag}_ms"], flush=True)
+
+    path = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                        "PROBE_UPDATE_OPS_r05.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+    print("wrote", path)
+
+
+if __name__ == "__main__":
+    main()

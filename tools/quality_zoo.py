@@ -1,0 +1,234 @@
+#!/usr/bin/env python
+"""Model-zoo convergence artifacts: held-out AUC vs a planted-oracle ceiling.
+
+VERDICT r4 #7: the committed quality rows covered order-2 FM only.  This
+tool trains each remaining BASELINE family through the REAL drivers on
+planted-model synthetic data whose generating process matches the family:
+
+  ffm     planted field-aware factors (v[id, partner_field, k]); libffm
+          input; config #3's model class
+  fm3     planted order-3 FM (linear + ANOVA_2 + ANOVA_3, the exact
+          semantics of ops/fm.py's DP); config #5's model class
+  deepfm  planted FM signal PLUS a tanh-pooled nonlinearity no plain FM
+          can represent; trains BOTH deepfm and fm on the same rows so the
+          row shows DeepFM's lift where the MLP has signal to find
+          (config #4's model class)
+
+Each row reports the best validation AUC from the driver's JSONL metrics
+next to the ORACLE ceiling (the planted model scoring the same held-out
+rows — the best ANY learner can do on Bernoulli(sigmoid(score)) labels).
+Writes QUALITY_ZOO_r05.json; bench_all.py folds the rows into BENCH_ALL.
+
+Usage: python tools/quality_zoo.py [--rows 1200000] [--epochs 6] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np  # noqa: E402
+
+from gen_synthetic import _id_normal, _zipf_ids  # noqa: E402
+
+VOCAB = 1 << 14
+K = 4
+SPREAD = 2.2  # label noise calibration (gen_synthetic rationale)
+
+
+def _draw_rows(rng, rows: int, fields: int):
+    bounds = np.linspace(0, VOCAB, fields + 1).astype(np.int64)
+    ids = np.stack(
+        [_zipf_ids(rng, rows, bounds[f], bounds[f + 1]) for f in range(fields)],
+        axis=1,
+    )
+    vals = np.round(
+        np.abs(rng.normal(0.5, 0.35, size=(rows, fields))) + 0.05, 4
+    ).astype(np.float32)
+    return ids, vals
+
+
+def planted_ffm_score(ids, vals, fields: int, seed: int = 777):
+    """bias + Σ_{a<b} <v(id_a, b), v(id_b, a)> x_a x_b, v planted per
+    (id, partner_field, k) via the stateless hash-normal."""
+    rows = ids.shape[0]
+    bias = 0.5 * _id_normal(ids, seed)
+    score = (bias * vals).sum(axis=1)
+    # fac[r, i, g, j] = v(ids[r, i])[partner g, dim j], built lazily per
+    # (g, j) salt to bound memory.
+    fac = np.zeros((rows, fields, fields, K), np.float32)
+    for g in range(fields):
+        for j in range(K):
+            fac[:, :, g, j] = 0.55 * _id_normal(ids, seed + 13 + g * K + j)
+    zx = fac * vals[..., None, None]  # [rows, i, g, k]
+    for a in range(fields):
+        for b in range(a + 1, fields):
+            score += np.einsum("rk,rk->r", zx[:, a, b], zx[:, b, a])
+    return score
+
+
+def planted_fm3_score(ids, vals, seed: int = 888):
+    """linear + ANOVA_2 + ANOVA_3 over planted v[id, k] — the exact order-3
+    semantics of ops/fm.py (elementary symmetric polynomials per factor dim)."""
+    bias = 0.5 * _id_normal(ids, seed)
+    v = np.stack(
+        [0.5 * _id_normal(ids, seed + 7 + j) for j in range(K)], axis=-1
+    )
+    z = v * vals[..., None]  # [rows, n, k]
+    s1 = z.sum(axis=1)
+    s2 = (z * z).sum(axis=1)
+    s3 = (z * z * z).sum(axis=1)
+    e2 = 0.5 * (s1 * s1 - s2)
+    e3 = (s1**3 - 3 * s1 * s2 + 2 * s3) / 6.0
+    return (bias * vals).sum(axis=1) + (e2 + e3).sum(axis=-1)
+
+
+def planted_deep_score(ids, vals, seed: int = 999):
+    """Planted FM score + a tanh-pooled term: s += Σ_j w_j tanh(3 p_j),
+    p = Σ_i u(id_i) x_i — smooth but outside the FM function class, so the
+    MLP head has genuine signal to capture."""
+    import gen_synthetic
+
+    base = gen_synthetic.planted_score(ids, vals, factor_num=K, model_seed=seed)
+    u = np.stack(
+        [0.6 * _id_normal(ids, seed + 101 + j) for j in range(K)], axis=-1
+    )
+    p = (u * vals[..., None]).sum(axis=1)  # [rows, k]
+    w = np.array([1.7, -1.3, 1.1, -0.9], np.float32)[:K]
+    return base + 1.6 * np.tanh(1.5 * p) @ w
+
+
+def _write(path, labels, ids, vals, fmt):
+    with open(path, "w") as f:
+        for r in range(ids.shape[0]):
+            if fmt == "libffm":
+                toks = " ".join(
+                    f"{fi}:{ids[r, fi]}:{vals[r, fi]:.4f}"
+                    for fi in range(ids.shape[1])
+                )
+            else:
+                toks = " ".join(
+                    f"{ids[r, fi]}:{vals[r, fi]:.4f}" for fi in range(ids.shape[1])
+                )
+            f.write(f"{labels[r]} {toks}\n")
+
+
+def _labels(rng, score):
+    s = (score - score.mean()) / (score.std() + 1e-6) * SPREAD
+    return (rng.random(s.shape[0]) < 1.0 / (1.0 + np.exp(-s))).astype(np.int64), s
+
+
+def _gen_split(tmp, tag, scorer, fields, rows, seed, fmt):
+    rng = np.random.default_rng(seed)
+    ids, vals = _draw_rows(rng, rows, fields)
+    labels, s = _labels(rng, scorer(ids, vals))
+    path = os.path.join(tmp, f"{tag}.{fmt}")
+    _write(path, labels, ids, vals, fmt)
+    return path, labels, s
+
+
+def _train(tmp, tag, train_file, test_file, *, model, fields, epochs, order=2,
+           hidden=(), lr=0.1):
+    from fast_tffm_tpu.config import Config
+    from fast_tffm_tpu.training import train
+
+    cfg = Config(
+        model=model, factor_num=K, vocabulary_size=VOCAB, order=order,
+        num_fields=fields if model in ("ffm", "deepfm") else 0,
+        hidden_dims=tuple(hidden),
+        model_file=os.path.join(tmp, f"m_{tag}.npz"),
+        train_files=(train_file,), validation_files=(test_file,),
+        epoch_num=epochs, batch_size=8192, learning_rate=lr,
+        init_accumulator_value=0.1, log_every=200, binary_cache=True,
+        metrics_path=os.path.join(tmp, f"jl_{tag}.jsonl"),
+    ).validate()
+    train(cfg, log=lambda *_: None)
+    aucs = [
+        r["validation_auc"]
+        for r in map(json.loads, open(cfg.metrics_path).read().splitlines())
+        if "validation_auc" in r
+    ]
+    return max(aucs)
+
+
+def main(argv=None) -> int:
+    from fast_tffm_tpu.metrics import auc
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=1_200_000)
+    ap.add_argument("--test-rows", type=int, default=50_000)
+    ap.add_argument("--epochs", type=int, default=6)
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny shapes for a smoke run")
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "QUALITY_ZOO_r05.json"))
+    args = ap.parse_args(argv)
+    if args.quick:
+        args.rows, args.test_rows, args.epochs = 60_000, 8_000, 2
+
+    res = {"rows": args.rows, "test_rows": args.test_rows, "epochs": args.epochs,
+           "vocab": VOCAB, "k": K, "families": {}}
+    with tempfile.TemporaryDirectory() as tmp:
+        # --- FFM (config #3): 8 fields keeps the planted pair tensor sane.
+        F = 8
+        tr, _, _ = _gen_split(tmp, "ffm_tr",
+                              lambda i, v: planted_ffm_score(i, v, F),
+                              F, args.rows, 10, "libffm")
+        te, te_labels, te_score = _gen_split(
+            tmp, "ffm_te", lambda i, v: planted_ffm_score(i, v, F),
+            F, args.test_rows, 11, "libffm")
+        learned = _train(tmp, "ffm", tr, te, model="ffm", fields=F,
+                         epochs=args.epochs)
+        res["families"]["ffm"] = {
+            "heldout_auc": round(float(learned), 5),
+            "oracle_auc": round(float(auc(te_labels, te_score)), 5),
+        }
+        print("ffm ->", res["families"]["ffm"], flush=True)
+
+        # --- order-3 FM (config #5).
+        F = 12
+        tr, _, _ = _gen_split(tmp, "fm3_tr", planted_fm3_score, F, args.rows,
+                              20, "libsvm")
+        te, te_labels, te_score = _gen_split(
+            tmp, "fm3_te", planted_fm3_score, F, args.test_rows, 21, "libsvm")
+        learned = _train(tmp, "fm3", tr, te, model="fm", fields=0,
+                         epochs=args.epochs, order=3)
+        res["families"]["fm3"] = {
+            "heldout_auc": round(float(learned), 5),
+            "oracle_auc": round(float(auc(te_labels, te_score)), 5),
+        }
+        print("fm3 ->", res["families"]["fm3"], flush=True)
+
+        # --- DeepFM (config #4) vs plain FM on nonlinear planted signal.
+        F = 12
+        tr, _, _ = _gen_split(tmp, "deep_tr", planted_deep_score, F, args.rows,
+                              30, "libsvm")
+        te, te_labels, te_score = _gen_split(
+            tmp, "deep_te", planted_deep_score, F, args.test_rows, 31, "libsvm")
+        deep = _train(tmp, "deepfm", tr, te, model="deepfm", fields=F,
+                      epochs=args.epochs, hidden=(64, 32), lr=0.05)
+        plain = _train(tmp, "fmbase", tr, te, model="fm", fields=0,
+                       epochs=args.epochs)
+        res["families"]["deepfm"] = {
+            "heldout_auc": round(float(deep), 5),
+            "fm_baseline_auc": round(float(plain), 5),
+            "oracle_auc": round(float(auc(te_labels, te_score)), 5),
+            "lift_over_fm": round(float(deep - plain), 5),
+        }
+        print("deepfm ->", res["families"]["deepfm"], flush=True)
+
+    with open(args.out, "w") as f:
+        json.dump(res, f, indent=1)
+    print("wrote", args.out)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
