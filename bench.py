@@ -11,10 +11,12 @@ the default-batch packed number, then the scale rung, and say so.
 
 Extra keys on the same line:
   scale_value         the LARGEST workable table (probed largest-first
-                      from 2^28; typically 134M+ rows, row accumulator,
-                      sorted packed tail doesn't apply — rows layout) —
-                      the single-chip analog of the 10B-row target, with
-                      its own roofline keys (scale_*)
+                      from 2^28; typically 201M rows) through the FUSED
+                      tile-row layout + capped compact tail at B=65536
+                      (round 5: 3× the r4 rows-layout rung) — the
+                      single-chip analog of the 10B-row target, with its
+                      own roofline keys (scale_*; scale_b16384_value
+                      keeps the r4-comparable batch)
   zipf_interleaved_value / uniform_ids_value
                       same executable, ids Zipf vs uniform, timed in ONE
                       interleaved window set (ordering claims need
@@ -60,7 +62,12 @@ import numpy as np  # noqa: E402
 
 from fast_tffm_tpu.models import Batch, FMModel
 from fast_tffm_tpu.optim import AdagradState
-from fast_tffm_tpu.trainer import TrainState, init_state, make_train_step
+from fast_tffm_tpu.trainer import (
+    TrainState,
+    init_state,
+    make_packed_train_step,
+    make_train_step,
+)
 
 BASELINE_EXAMPLES_PER_SEC_PER_CHIP = 500_000.0
 
@@ -143,6 +150,35 @@ def modeled_step_bytes(ids_batches, d_cols, accum_cols):
     return parts, int(sum(parts.values())), uniq
 
 
+def modeled_fused_step_bytes(ids_batches, d, vocab, cap, batch_scale=1):
+    """LOWER-BOUND HBM bytes/step for the FUSED-layout compact train step
+    (modeled_step_bytes's round-5 twin): fwd wide gather, per-occurrence
+    [M, 128] grad-row build, compacted G scatter-add, the [VPf] bitmap +
+    prefix sum, and the 2-op RMW over the capped row buffer.  Mean unique
+    PHYSICAL rows come from the actual batches.  ``batch_scale`` scales
+    the M-proportional parts when the measured batch is a multiple of the
+    modeled batches' size (the VP-proportional bitmap does not scale)."""
+    p = 128 // (d + 1)
+    vpf = -(-vocab // p)
+    m = ids_batches[0].shape[0] * ids_batches[0].shape[1] * batch_scale
+    uniq = float(np.mean([np.unique(np.asarray(b)).size for b in ids_batches]))
+    uniq_phys = float(
+        np.mean([np.unique(np.asarray(b) // p).size for b in ids_batches])
+    ) * batch_scale  # upper bound: unions overlap, but this is a floor model
+    k_rows = min(cap if cap > 0 else m, min(vpf, m), int(uniq_phys * 1.0) or m)
+    row_b = 128 * 4
+    parts = {
+        "ids_read": m * 4,
+        "fwd_gather_read": m * row_b,
+        "grad_rows_write": m * row_b,
+        "gbuild_scatter_rw": m * row_b + k_rows * row_b,
+        "bitmap_cumsum_rw": vpf * (1 + 1 + 4 + 4),  # int8 w+r, int32 w+r
+        "rmw_gather_read": k_rows * row_b,
+        "rmw_scatter_write": k_rows * row_b,
+    }
+    return parts, int(sum(parts.values())), uniq
+
+
 def scale_state(vocab, k):
     """TrainState with a [V, 1+k] table + ROW-mode accumulator, built
     in-place on device (init_state's bias/factor concat would peak at 2×
@@ -157,6 +193,45 @@ def scale_state(vocab, k):
     return TrainState(
         table=mk_table(jax.random.key(0), vocab, 1 + k),
         table_opt=AdagradState(jnp.full((vocab, 1), 0.1, jnp.float32)),
+        dense={},
+        dense_opt=AdagradState({}),
+        step=jnp.zeros((), jnp.int32),
+    )
+
+
+# Fused compact tail: cap the compacted-row buffer (exact lax.cond
+# fallback on overflow) — Zipf batches at B=65536 touch ~0.5-0.7M unique
+# physical rows, so 2^20 holds with slack while the RMW shrinks ~2.5×
+# (PROBE_UPDATE_OPS_r05; ops/packed_table.py round-5 entry).
+SCALE_CAP = 1 << 20
+SCALE_BATCH_BIG = 65536
+
+
+def fused_scale_state(vocab, k):
+    """TrainState in the FUSED tile-row layout ([VPf, 128]: D row lanes +
+    1 row-accumulator lane per slot), built in-place on device — the
+    scale-regime operating point (2-random-op RMW, ~(D+1)/D of the table
+    in total state)."""
+    from functools import partial
+
+    from fast_tffm_tpu.ops.packed_table import LANES, fused_packed_rows
+
+    d = 1 + k
+    vpf = fused_packed_rows(vocab, d)
+
+    @partial(jax.jit, static_argnums=(1,))
+    def mk_fused(key, n):
+        f = jax.random.uniform(key, (n, LANES), jnp.float32, -0.01, 0.01)
+        p = LANES // (d + 1)
+        lanes = jnp.arange(LANES)
+        is_acc = (lanes < p * (d + 1)) & (lanes % (d + 1) == d)
+        return jnp.where(
+            is_acc[None, :] | (lanes >= p * (d + 1))[None, :], 0.1, f
+        )
+
+    return TrainState(
+        table=mk_fused(jax.random.key(0), vpf),
+        table_opt=AdagradState(jnp.zeros((0, 1), jnp.float32)),
         dense={},
         dense_opt=AdagradState({}),
         step=jnp.zeros((), jnp.int32),
@@ -186,13 +261,17 @@ def forced_sync(state) -> float:
     return float(_peek_table(state.table))
 
 
-def measure(step, state, batches, iters, windows=3):
+def measure(step, state, batches, iters, windows=3, batch_size=None):
     """(final state, best-window examples/sec), VALUE-SYNCED.
 
     Timing is the marginal cost of ``iters`` extra steps between two
     forced syncs — best of ``windows`` (min time: tunnel contention only
     ever slows a window down, never speeds it up; the sync itself cannot
-    under-count, see forced_sync)."""
+    under-count, see forced_sync).  ``batch_size`` defaults to the module
+    BATCH; callers measuring a different shape pass theirs explicitly
+    (no globals() mutation — batches may be opaque index handles on the
+    device-cache path, so the size cannot be derived from them)."""
+    bsz = BATCH if batch_size is None else batch_size
     state, loss = step(state, batches[0])  # compile
     forced_sync(state)
     for i in range(1, 4):  # short warm
@@ -205,7 +284,7 @@ def measure(step, state, batches, iters, windows=3):
             state, loss = step(state, batches[i % len(batches)])
         forced_sync(state)
         best_dt = min(best_dt, time.perf_counter() - t0)
-    return state, BATCH * iters / best_dt
+    return state, bsz * iters / best_dt
 
 
 def interleaved_measure(step, state, batches_a, batches_b, iters, rounds=4, batch=None):
@@ -323,14 +402,40 @@ def _probe_rung(cand: int) -> None:
     Exits 0 on success.  Runs in its OWN process because a failed rung
     attempt leaks device buffers for the life of the process on this
     backend (measured: after a big-rung RESOURCE_EXHAUSTED even 36 MB
-    rungs OOM in-process, while a fresh process succeeds)."""
+    rungs OOM in-process, while a fresh process succeeds).  Probes the
+    FUSED step — the state the full run will actually allocate — at BOTH
+    batches: B=16384, then B=65536 (prints ``B65536_OK rate=N`` on
+    success).  The big batch matters: a rung that only steps at 16384
+    (2^28 this round — its 65536 program draws the remote compiler's
+    HTTP 500) would poison the MAIN process at the headline batch and
+    take every later bench section down with it (observed); the parent
+    picks the largest rung whose BIG batch works and records the bigger
+    alloc-only rung as scale_max_rows."""
     rng = np.random.default_rng(0)
     model = FMModel(vocabulary_size=cand, factor_num=SCALE_K, order=2)
-    step = make_train_step(model, learning_rate=0.01)
+    step = make_packed_train_step(
+        model, learning_rate=0.01, update="auto", compact_cap=SCALE_CAP
+    )
     b = make_batch(zipf_ids(rng, (BATCH, NNZ), cand), 0)
-    state = scale_state(cand, SCALE_K)
+    state = fused_scale_state(cand, SCALE_K)
     state, loss = step(state, b)
     forced_sync(state)
+    print(f"B{BATCH}_OK", flush=True)
+    try:
+        big = [
+            make_batch(zipf_ids(rng, (SCALE_BATCH_BIG, NNZ), cand), 10 + i)
+            for i in range(3)
+        ]
+        state, _ = step(state, big[0])
+        forced_sync(state)
+        t0 = time.perf_counter()
+        for i in range(4):
+            state, _ = step(state, big[(1 + i) % 3])
+        forced_sync(state)
+        rate = 4 * SCALE_BATCH_BIG / (time.perf_counter() - t0)
+        print(f"B{SCALE_BATCH_BIG}_OK rate={rate:.0f}", flush=True)
+    except Exception as e:
+        print(f"B{SCALE_BATCH_BIG}_FAIL {str(e)[:80]}", flush=True)
     raise SystemExit(0)
 
 
@@ -356,24 +461,50 @@ def _pick_rung(results) -> int | None:
         gate = "DEGRADED chip_probe timed out (480s)"
     results["chip_pregate"] = gate[:120]
     vocabs = SCALE_VOCABS if gate.startswith("HEALTHY") else SCALE_VOCABS[-1:]
+    small_only = None  # largest rung that steps at B=16384 but not 65536
     for cand in vocabs:
         try:
             r = subprocess.run(
                 [_sys.executable, os.path.abspath(__file__), "--probe-rung", str(cand)],
-                capture_output=True, text=True, timeout=600,
+                capture_output=True, text=True, timeout=900,
             )
         except subprocess.TimeoutExpired:
             # A hung tunnel is a failed rung, not a dead bench.
             results.setdefault("scale_fallbacks", []).append(
-                f"vocab={cand}: probe timed out (600s)"
+                f"vocab={cand}: probe timed out (900s)"
             )
             continue
-        if r.returncode == 0:
+        out = r.stdout or ""
+        if r.returncode == 0 and f"B{SCALE_BATCH_BIG}_OK" in out:
             return cand
+        if r.returncode == 0 and f"B{BATCH}_OK" in out:
+            # Steps, but the headline batch doesn't (compiler bound at
+            # this size) — record the CAPABILITY (with the probe's rough
+            # rate) and keep descending: running this rung in the main
+            # process would poison every later section at the big batch.
+            if small_only is None:
+                small_only = cand
+                results["scale_max_rows"] = cand
+                for line in out.splitlines():
+                    if line.startswith(f"B{SCALE_BATCH_BIG}_FAIL"):
+                        results["scale_max_rows_b65536_fail"] = line[:160]
+                results["scale_max_rows_note"] = (
+                    f"largest rung that allocates AND steps (B={BATCH}, fused "
+                    "layout); its B=65536 program fails to compile, so the "
+                    "throughput rung below is reported as scale_value"
+                )
+            results.setdefault("scale_fallbacks", []).append(
+                f"vocab={cand}: steps at B={BATCH} only (kept as scale_max_rows)"
+            )
+            continue
         results.setdefault("scale_fallbacks", []).append(
             f"vocab={cand}: {_error_line(r.stderr or r.stdout)}"
         )
-    return None
+    if small_only is not None:
+        # No rung handles the headline batch — the fallback rung runs at
+        # B=16384 only, and main() must NOT retry the big batch on it.
+        results["_rung_small_only"] = True
+    return small_only
 
 
 def _error_line(text: str) -> str:
@@ -417,14 +548,20 @@ def main():
     for cand in ladder:
         try:
             model = FMModel(vocabulary_size=cand, factor_num=SCALE_K, order=2)
-            step = make_train_step(model, learning_rate=0.01)
+            # Round 5: the rung runs the FUSED tile-row layout + capped
+            # compact tail (auto resolves dense at small rungs) — the
+            # measured scale-regime fix (PROBE_COMPACT/UPDATE_OPS_r05:
+            # 98.9k -> ~295k ex/s at 201M rows).
+            step = make_packed_train_step(
+                model, learning_rate=0.01, update="auto", compact_cap=SCALE_CAP
+            )
             # Inside the try: on a degraded shared chip even the batch
             # device_puts can RESOURCE_EXHAUST, and that must fall down
             # the ladder, not kill the bench.
             batches = [
                 make_batch(zipf_ids(rng, (BATCH, NNZ), cand), i) for i in range(16)
             ]
-            state = scale_state(cand, SCALE_K)
+            state = fused_scale_state(cand, SCALE_K)
             state, scale_rate = measure(step, state, batches, iters=20)
             vocab = cand
             break
@@ -494,14 +631,46 @@ def main():
             **results,
         }))
         return
-    results["scale_value"] = round(scale_rate / jax.device_count(), 1)
+    results["scale_b16384_value"] = round(scale_rate / jax.device_count(), 1)
     results["scale_vocab_rows"] = vocab
     results["scale_table_gib"] = round(vocab * (1 + SCALE_K) * 4 / 2**30, 2)
+    results["scale_layout"] = f"fused tile-row + compact cap {SCALE_CAP}"
+
+    # The rung's best operating point: B=65536 amortizes the per-step
+    # fixed costs (bitmap + dispatch) over 4× the examples — measured
+    # ~295k vs ~170k at B=16384 (PROBE_COMPACT_r05).  Falls back to the
+    # B=16384 number if the bigger shape doesn't fit this session.
+    scale_batch = BATCH
+    if results.pop("_rung_small_only", False):
+        # The probe already saw this rung's B=65536 program fail to
+        # compile; re-attempting it HERE would poison the main process
+        # and take every later section down (the _probe_rung rationale).
+        results["scale_value"] = results["scale_b16384_value"]
+        results["scale_batch"] = BATCH
+        results["scale_b65536_error"] = "skipped: probe saw B=65536 fail on this rung"
+    else:
+        try:
+            big = [
+                make_batch(zipf_ids(rng, (SCALE_BATCH_BIG, NNZ), vocab), 50 + i)
+                for i in range(6)
+            ]
+            state, big_rate = measure(
+                step, state, big, iters=10, batch_size=SCALE_BATCH_BIG
+            )
+            results["scale_value"] = round(big_rate / jax.device_count(), 1)
+            results["scale_batch"] = SCALE_BATCH_BIG
+            scale_rate, scale_batch = big_rate, SCALE_BATCH_BIG
+            del big
+        except Exception as e:
+            results["scale_value"] = results["scale_b16384_value"]
+            results["scale_batch"] = BATCH
+            results["scale_b65536_error"] = str(e)[:120]
 
     # --- bytes-moved roofline: make the headline physically auditable ---
-    step_us = BATCH / scale_rate * 1e6
-    parts, total_bytes, uniq = modeled_step_bytes(
-        [b.ids for b in batches], 1 + SCALE_K, 1  # row-mode accumulator
+    step_us = scale_batch / scale_rate * 1e6
+    parts, total_bytes, uniq = modeled_fused_step_bytes(
+        [b.ids for b in batches], 1 + SCALE_K, vocab, SCALE_CAP,
+        batch_scale=scale_batch // BATCH,
     )
     kind = getattr(jax.devices()[0], "device_kind", "")
     nominal = NOMINAL_HBM_GBPS.get(kind)
@@ -555,19 +724,27 @@ def main():
         results["fmb_streamed_value"] = None
         results["fmb_streamed_error"] = str(e)[:120]
 
-    # --- same shapes through the sharded SPMD step (dist_train's program) ---
+    # --- same shapes through the sharded SPMD step (dist_train's program).
+    #     The rung state is FUSED (local-only layout), so this section
+    #     frees it and builds the rows-layout state the sharded step
+    #     takes — r4's sharded_value semantics, now with the mesh=1
+    #     short-circuits in the collectives (VERDICT r4 #3). ---
+    del state
+    state = None
     try:
         from fast_tffm_tpu.parallel import make_mesh, make_sharded_train_step
 
         n = jax.device_count()
         mesh = make_mesh(1, n)
         sh_step = make_sharded_train_step(model, 0.01, mesh)
-        state, sh_rate = measure(sh_step, state, batches, iters=20)
+        sh_state = scale_state(vocab, SCALE_K)
+        sh_state, sh_rate = measure(sh_step, sh_state, batches, iters=20)
         results["sharded_value"] = round(sh_rate / n, 1)
+        del sh_state
     except Exception as e:
         results["sharded_value"] = None
         results["sharded_error"] = str(e)[:120]
-    del state, batches
+    del batches
 
     # --- device-resident dataset (device_cache = true): the epoch lives in
     #     HBM beside the table and every step slices its batch on-chip —
@@ -625,7 +802,7 @@ def main():
             resolve_packed_update,
             rows_per_tile,
         )
-        from fast_tffm_tpu.trainer import init_packed_state, make_packed_train_step
+        from fast_tffm_tpu.trainer import init_packed_state
 
         pv = min(ladder[0], 1 << 24)
         pmodel = FMModel(vocabulary_size=pv, factor_num=SCALE_K, order=2)
@@ -722,7 +899,8 @@ def main():
             f"train examples/sec/chip (2nd-order FM, k=8, nnz=39, "
             f"vocab={results['packed_vocab_rows']} rows, lane-packed table "
             f"+ dense-G Adagrad, batch 65536, Zipf(1.1) ids; "
-            f"scale rung vocab={vocab} on the line as scale_value)"
+            f"scale rung vocab={vocab} fused+capped-compact at batch "
+            f"{results.get('scale_batch', BATCH)} on the line as scale_value)"
         )
     elif results.get("packed_value") is not None:
         value = results["packed_value"]
@@ -736,7 +914,7 @@ def main():
         metric = (
             f"train examples/sec/chip (2nd-order FM, k=8, nnz=39, "
             f"vocab={vocab} rows ~{results['scale_table_gib']}GiB "
-            "table, Zipf(1.1) ids, row accumulator)"
+            "table, Zipf(1.1) ids, fused tile-row layout, capped compact tail)"
         )
     _watchdog.cancel()
     print(

@@ -251,6 +251,7 @@ def main():
     guard(bench_input)
     guard(bench_end_to_end_ab)
     guard(bench_convergence, full=args.full)
+    guard(bench_quality_zoo)
     # The lane-packed layout (table_layout = packed) across the zoo: same
     # math (test-pinned), tile-aligned physical movement — the measured
     # fix for the partial-lane scatter bound (DESIGN §6).  LAST on
@@ -596,6 +597,42 @@ def bench_convergence(full: bool = False):
                 f"this sweep's {heldout_rows}-row slice under measured_slice_this_run)"
             )
         report(name, value, unit=unit, vs_baseline=vs_base, **extra)
+
+
+def bench_quality_zoo():
+    """Fold the model-zoo convergence artifact (tools/quality_zoo.py —
+    FFM / order-3 FM / DeepFM-vs-FM held-out AUC against planted-oracle
+    ceilings) into the sweep as quality rows.  The artifact is produced
+    by its own driver run (it trains three families to convergence);
+    this section only REPORTS it, so a sweep without the artifact simply
+    omits the rows rather than re-paying the training time."""
+    import json as _json
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "QUALITY_ZOO_r05.json")
+    if not os.path.exists(path):
+        return
+    with open(path) as f:
+        zoo = _json.load(f)
+    fams = zoo.get("families", {})
+    label = {
+        "ffm": f"cfg3 quality: held-out AUC (FFM k={zoo['k']}, planted FFM "
+               f"signal, {zoo['rows']} rows)",
+        "fm3": f"cfg5 quality: held-out AUC (FM order-3 k={zoo['k']}, planted "
+               f"ANOVA-3 signal, {zoo['rows']} rows)",
+        "deepfm": f"cfg4 quality: held-out AUC (DeepFM, planted nonlinear "
+                  f"signal, {zoo['rows']} rows)",
+    }
+    for fam, rec in fams.items():
+        oracle = rec["oracle_auc"]
+        lift = round((rec["heldout_auc"] - 0.5) / max(oracle - 0.5, 1e-9), 4)
+        extra = {k: v for k, v in rec.items() if k != "heldout_auc"}
+        extra["source"] = "QUALITY_ZOO_r05.json (tools/quality_zoo.py)"
+        report(
+            label.get(fam, fam), rec["heldout_auc"],
+            unit=f"AUC (oracle ceiling {oracle:.5f})",
+            vs_baseline=lift, **extra,
+        )
 
 
 if __name__ == "__main__":

@@ -48,7 +48,13 @@ class Config:
     factor_lambda: float = 0.0
     bias_lambda: float = 0.0
     init_accumulator_value: float = 0.1
-    adagrad_accumulator: str = "element"  # element (TF parity) | row (D×-smaller state)
+    adagrad_accumulator: str = "element"  # element (TF parity) | row (D×-smaller
+    #   state) | fused (row semantics, accumulator stored inside the packed
+    #   table's tile rows — 2-random-op RMW; requires table_layout=packed)
+    packed_compact_cap: int = 0  # fused compact tail: cap the compacted-row
+    #   buffer (0 = exact min(VP, M)); overflowing batches take an exact
+    #   lax.cond fallback, so skewed (Zipf/CTR) ids get a ~3x smaller RMW
+    #   with no correctness risk (ops/packed_table.py round-5 entry)
     packed_update: str = "auto"  # packed sparse tail: auto | dense | compact | sorted
     #   (dense = wide scatter-add into a [VP,128] grad buffer + dense Adagrad
     #   sweep, measured 3.5× the sorted pipeline; compact = sort-free
@@ -117,9 +123,27 @@ class Config:
             # numpy SeedSequence rejects negatives — fail at the config,
             # not deep inside the prefetch thread.
             raise ValueError(f"shuffle_seed must be >= 0, got {self.shuffle_seed}")
-        if self.adagrad_accumulator not in ("element", "row"):
+        if self.adagrad_accumulator not in ("element", "row", "fused"):
             raise ValueError(
-                f"unknown adagrad_accumulator {self.adagrad_accumulator!r} (element | row)"
+                f"unknown adagrad_accumulator {self.adagrad_accumulator!r} "
+                "(element | row | fused)"
+            )
+        if self.packed_compact_cap < 0:
+            raise ValueError(
+                f"packed_compact_cap must be >= 0, got {self.packed_compact_cap}"
+            )
+        if self.packed_compact_cap > 0 and self.adagrad_accumulator != "fused":
+            # The cap only exists on the fused compact tail; silently inert
+            # knobs corrupt A/B comparisons (packed_update rationale above).
+            raise ValueError(
+                "packed_compact_cap > 0 requires adagrad_accumulator = fused "
+                "(it sizes the fused compact tail's row buffer)"
+            )
+        if self.adagrad_accumulator == "fused" and self.table_layout != "packed":
+            # Fused is a PHYSICAL layout choice (row accumulator stored in
+            # the table's own tile rows); it only exists packed.
+            raise ValueError(
+                "adagrad_accumulator = fused requires table_layout = packed"
             )
         if self.table_layout not in ("rows", "packed"):
             raise ValueError(
@@ -151,7 +175,7 @@ class Config:
             )
         if (
             self.table_layout == "packed"
-            and self.adagrad_accumulator == "row"
+            and self.adagrad_accumulator in ("row", "fused")
             and self.packed_update == "sorted"
         ):
             # The sorted packed update's whole-tile-row RMW is exact only
@@ -240,6 +264,9 @@ def load_config(path: str) -> Config:
         t, "adagrad_accumulator", str, cfg.adagrad_accumulator
     ).lower()
     cfg.packed_update = get(t, "packed_update", str, cfg.packed_update).lower()
+    cfg.packed_compact_cap = get(
+        t, "packed_compact_cap", int, cfg.packed_compact_cap
+    )
     cfg.thread_num = get(t, "thread_num", int, cfg.thread_num)
     cfg.binary_cache = get(t, "binary_cache", ini._convert_to_boolean, cfg.binary_cache)
     cfg.binary_cache_wait = get(t, "binary_cache_wait", float, cfg.binary_cache_wait)

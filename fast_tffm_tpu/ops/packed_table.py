@@ -64,6 +64,14 @@ __all__ = [
     "packed_sparse_adagrad_update",
     "resolve_packed_update",
     "PACKED_UPDATE_FNS",
+    "fused_rows_per_tile",
+    "fused_packed_rows",
+    "pack_fused",
+    "unpack_fused",
+    "fused_gather",
+    "fused_dense_adagrad_update",
+    "fused_compact_adagrad_update",
+    "resolve_fused_update",
 ]
 
 LANES = 128
@@ -486,4 +494,213 @@ PACKED_UPDATE_FNS = {
     "dense": packed_dense_adagrad_update,
     "compact": packed_compact_adagrad_update,
     "sorted": packed_sparse_adagrad_update,
+}
+
+
+# --- fused row-accumulator layout (round 5) -------------------------------
+#
+# WHY (PROBE_UPDATE_OPS_r05): random wide gathers/scatters on this chip are
+# DESCRIPTOR-bound — a [K, 256] gather costs the same as [K, 128] (10.5 vs
+# 10.0 ms at K=639k) — so the sparse tail's cost is the NUMBER of random
+# row ops, not their bytes.  The separate-accumulator RMW needs 4 of them
+# (gather cur, gather acc, scatter new, scatter acc2); fusing the ROW
+# accumulator scalar into each logical row's own tile-row slot (stride
+# D+1: D row lanes + 1 accumulator lane per slot, P = 128 // (D+1) slots)
+# collapses the RMW to ONE gather + ONE scatter over a single array, and
+# shrinks total optimizer+param state to ~(D+1)/D of the table (the 10B-row
+# regime's pairing).  Semantics are EXACTLY the row-granularity Adagrad
+# (optim.py row mode: accum += ||sum-G row||², one sqrt per row) — only the
+# storage address of the scalar moved.  Checkpoints stay LOGICAL ([V, D]
+# table + [V, 1] accumulator), so fused runs interchange checkpoints with
+# rows-layout and packed row-mode runs.
+
+
+def fused_rows_per_tile(d: int) -> int:
+    """Slots per 128-lane row in the fused layout: P = 128 // (D + 1)."""
+    if d + 1 > LANES:
+        raise ValueError(f"fused layout needs D + 1 <= {LANES}, got D={d}")
+    return LANES // (d + 1)
+
+
+def fused_packed_rows(vocab: int, d: int) -> int:
+    return -(-vocab // fused_rows_per_tile(d))
+
+
+def pack_fused(
+    table: jax.Array, accum: jax.Array, init_value: float
+) -> jax.Array:
+    """[V, D] table + [V, 1] row accumulator -> [VPf, 128] fused rows.
+
+    Slot s of a physical row occupies lanes [s·(D+1), s·(D+1)+D) for the
+    parameter row and lane s·(D+1)+D for its accumulator scalar.  Pad
+    slots and tail lanes carry ``init_value`` in the accumulator position
+    and 0 in row positions (the dense sweep divides by sqrt of every
+    accumulator lane, and zero-grad identity keeps pads inert)."""
+    if accum.shape[-1] != 1:
+        raise ValueError(
+            f"fused layout packs a ROW accumulator [V, 1], got {accum.shape}"
+        )
+    merged = jnp.concatenate([table, accum.astype(table.dtype)], axis=-1)
+    d1 = merged.shape[-1]
+    p = fused_rows_per_tile(table.shape[-1])  # raises the clear D+1 > 128 error
+    vp = -(-table.shape[0] // p)
+    flat = jnp.full((vp * p, d1), 0.0, table.dtype).at[:, d1 - 1].set(init_value)
+    flat = flat.at[: table.shape[0]].set(merged)
+    out = jnp.full((vp, LANES), init_value, table.dtype)
+    return out.at[:, : p * d1].set(flat.reshape(vp, p * d1))
+
+
+def unpack_fused(fused: jax.Array, vocab: int, d: int):
+    """[VPf, 128] fused -> ([V, D] table, [V, 1] accumulator)."""
+    p = fused_rows_per_tile(d)
+    d1 = d + 1
+    flat = fused[:, : p * d1].reshape(fused.shape[0] * p, d1)[:vocab]
+    return flat[:, :d], flat[:, d:]
+
+
+def fused_gather(fused: jax.Array, ids: jax.Array, d: int) -> jax.Array:
+    """rows[..., D] for logical ``ids`` from a fused table (wide gather +
+    static masked slot extraction, accumulator lanes skipped)."""
+    p = fused_rows_per_tile(d)
+    d1 = d + 1
+    phys = ids // p
+    slot = ids % p
+    rows128 = fused[phys]
+    out = jnp.zeros(ids.shape + (d,), fused.dtype)
+    for s in range(p):
+        piece = rows128[..., s * d1 : s * d1 + d]
+        out = out + jnp.where((slot == s)[..., None], piece, 0)
+    return out
+
+
+def _fused_apply(cur128, G128, lr, p: int, d: int):
+    """One row-granularity Adagrad application on fused tile rows.
+
+    cur128/G128: [*, 128] (G's accumulator lanes are zero by
+    construction).  Returns the updated [*, 128] rows.  Formulas match
+    optim.py row mode exactly: acc2 = acc + Σ g²; new = row − lr·g/√acc2."""
+    d1 = d + 1
+    used = p * d1
+    view = cur128[..., :used].reshape(cur128.shape[:-1] + (p, d1))
+    gview = G128[..., :used].reshape(G128.shape[:-1] + (p, d1))
+    grow = gview[..., :d]
+    acc2 = view[..., d] + jnp.sum(grow * grow, axis=-1)
+    new_rows = view[..., :d] - lr * grow / jnp.sqrt(acc2)[..., None]
+    new = jnp.concatenate([new_rows, acc2[..., None]], axis=-1)
+    new = new.reshape(cur128.shape[:-1] + (used,))
+    return jnp.concatenate([new, cur128[..., used:]], axis=-1)
+
+
+def fused_grad128(ids: jax.Array, row_grads: jax.Array, p: int):
+    """Per-occurrence [M, 128] tile rows with grads at fused slot offsets
+    (accumulator lanes zero), plus the physical row per occurrence."""
+    d = row_grads.shape[-1]
+    flat = ids.reshape(-1)
+    g = row_grads.reshape(flat.shape[0], d)
+    slot = (flat % p).astype(jnp.int32)
+    phys = (flat // p).astype(jnp.int32)
+    gpad = jnp.pad(g, ((0, 0), (0, 1)))  # zero accumulator lane
+    return lane_spread(gpad, slot, p, d + 1), phys
+
+
+def fused_dense_adagrad_update(
+    fused: jax.Array, ids: jax.Array, row_grads: jax.Array, lr: float
+) -> jax.Array:
+    """Fused-layout Adagrad via the dense-G sweep (small-vocab regime):
+    one wide scatter-add into [VPf, 128], one contiguous pass over the
+    fused array.  Zero-grad slots see acc2 == acc and row − 0 — the exact
+    identity, so sweeping everything is exact (pad accumulator lanes hold
+    init_value > 0, never 0)."""
+    d = row_grads.shape[-1]
+    p = fused_rows_per_tile(d)
+    vp = fused.shape[0]
+    g128, phys = fused_grad128(ids, row_grads, p)
+    G = jnp.zeros((vp, LANES), g128.dtype).at[phys].add(g128, mode="drop")
+    return _fused_apply(fused, G, lr, p, d)
+
+
+def _fused_compact_k(fused, g128, phys, csum, lr, p, d, k):
+    """The compaction + RMW for one static capacity ``k``: slots beyond
+    k-1 drop from every scatter (only reachable when #touched > k — the
+    caller's overflow cond guarantees the exact-capacity branch runs)."""
+    vp = fused.shape[0]
+    valid = phys < vp
+    slot = jnp.where(valid, csum[jnp.minimum(phys, vp - 1)] - 1, k)
+    slot = jnp.minimum(slot, k)  # overflow slots -> drop sentinel
+    G = jnp.zeros((k, LANES), g128.dtype).at[slot].add(g128, mode="drop")
+    uphys = (jnp.int32(vp) + jnp.arange(k, dtype=jnp.int32)).at[slot].set(
+        phys, mode="drop"
+    )
+    cur = fused[jnp.minimum(uphys, vp - 1)]
+    new = _fused_apply(cur, G, lr, p, d)
+    return fused.at[uphys].set(
+        new, mode="drop", unique_indices=True, indices_are_sorted=True
+    )
+
+
+def fused_compact_adagrad_update(
+    fused: jax.Array, ids: jax.Array, row_grads: jax.Array, lr: float,
+    k_cap: int = 0,
+) -> jax.Array:
+    """Fused-layout Adagrad via sort-free touched-row compaction — the
+    giant-vocab production tail: bitmap + prefix-sum compaction (as
+    packed_compact_adagrad_update), then ONE wide gather + ONE wide
+    scatter (unique + sorted indices by construction) instead of the
+    separate-accumulator path's four random row ops.
+
+    ``k_cap`` > 0 additionally CAPS the compacted buffer below the exact
+    worst case min(VP, M): the RMW then processes k_cap rows instead of M
+    (CTR ids are Zipf — measured ~170k unique physical rows per 639k
+    occurrences — so the exact cap wastes ~3× the RMW's descriptor-bound
+    row ops).  Correctness is unconditional: the touched count is known
+    from the prefix sum, and a batch that overflows the cap takes the
+    exact-capacity branch under ``lax.cond`` — never a dropped update.
+    Skew helps, uniform ids just fall back every step (the cond prices
+    one compare + both compiled branches, not wrong results).  Results
+    are numerically (not bitwise) equal to k_cap=0: XLA's scatter-add
+    associates duplicate contributions in a shape-dependent order, so a
+    smaller G buffer can sum the same addends differently (~1e-5;
+    test-pinned allclose)."""
+    d = row_grads.shape[-1]
+    p = fused_rows_per_tile(d)
+    vp = fused.shape[0]
+    g128, phys = fused_grad128(ids, row_grads, p)
+    m = phys.shape[0]
+
+    k_full = min(vp, m)
+    touched = jnp.zeros((vp,), jnp.int8).at[phys].set(1, mode="drop")
+    csum = jnp.cumsum(touched, dtype=jnp.int32)
+    if k_cap <= 0 or k_cap >= k_full:
+        return _fused_compact_k(fused, g128, phys, csum, lr, p, d, k_full)
+    n_touched = csum[-1]
+    return jax.lax.cond(
+        n_touched <= k_cap,
+        lambda f: _fused_compact_k(f, g128, phys, csum, lr, p, d, k_cap),
+        lambda f: _fused_compact_k(f, g128, phys, csum, lr, p, d, k_full),
+        fused,
+    )
+
+
+def resolve_fused_update(update: str, vp: int) -> str:
+    """'auto' | 'dense' | 'compact' -> the concrete fused-layout tail.
+
+    Same size rule as resolve_packed_update; 'sorted' has no fused
+    implementation (the compact path subsumes it — no sort to keep)."""
+    if update == "sorted":
+        raise ValueError(
+            "packed_update=sorted has no fused-layout implementation "
+            "(use auto, dense or compact with adagrad_accumulator=fused)"
+        )
+    if update not in ("auto", "dense", "compact"):
+        raise ValueError(
+            f"unknown packed update {update!r} (auto | dense | compact)"
+        )
+    if update != "auto":
+        return update
+    return "dense" if vp * LANES * 4 <= DENSE_G_MAX_BYTES else "compact"
+
+
+FUSED_UPDATE_FNS = {
+    "dense": fused_dense_adagrad_update,
+    "compact": fused_compact_adagrad_update,
 }

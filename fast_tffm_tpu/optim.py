@@ -54,12 +54,17 @@ def init_table_adagrad(
 ) -> AdagradState:
     """Accumulator for the sparse table: ``element`` ([V, D], TF parity) or
     ``row`` ([V, 1], grouped accumulator — see module docstring)."""
-    if accumulator == "row":
+    if accumulator in ("row", "fused"):
+        # "fused" has row-granularity SEMANTICS; the fused STORAGE happens
+        # at pack time (ops.packed_table.pack_fused) — logically it is the
+        # same [V, 1] accumulator.
         return AdagradState(
             jnp.full((table.shape[0], 1), init_accumulator_value, table.dtype)
         )
     if accumulator != "element":
-        raise ValueError(f"unknown adagrad accumulator {accumulator!r} (element | row)")
+        raise ValueError(
+            f"unknown adagrad accumulator {accumulator!r} (element | row | fused)"
+        )
     return init_adagrad(table, init_accumulator_value)
 
 

@@ -135,17 +135,35 @@ def make_predict_step(model):
 # --- lane-packed table variants (ops/packed_table.py; DESIGN §6) ---------
 
 
-def pack_state(state: TrainState, init_accumulator_value: float = 0.1) -> TrainState:
+def pack_state(
+    state: TrainState, init_accumulator_value: float = 0.1, fused: bool = False
+) -> TrainState:
     """Lane-pack a LOGICAL TrainState (table via pack_table; the
     accumulator via pack_accum for element granularity [V, D] or
     pack_accum_rows for row granularity [V, 1] — padding slots hold the
     init value so packed Adagrad never divides by sqrt(0)).  Shared by
     init, resume, and the packed predict driver.  Packs ONE array at a
     time, dropping each logical original before the next — the transient
-    device-memory peak is what OOMs big vocabs on a shared chip."""
-    from fast_tffm_tpu.ops.packed_table import pack_accum_any, pack_table
+    device-memory peak is what OOMs big vocabs on a shared chip.
+
+    ``fused=True`` (adagrad_accumulator = fused) stores the [V, 1] ROW
+    accumulator inside each row's own tile-row slot (stride D+1 —
+    ops.packed_table fused layout); ``table`` then holds the single fused
+    array and ``table_opt.accum`` a [0, 1] sentinel whose emptiness IS the
+    fused-state marker the step/predict/save paths dispatch on."""
+    from fast_tffm_tpu.ops.packed_table import pack_accum_any, pack_fused, pack_table
 
     d = state.table.shape[-1]
+    if fused:
+        fused_arr = pack_fused(
+            state.table, state.table_opt.accum, init_accumulator_value
+        )
+        return state._replace(
+            table=fused_arr,
+            table_opt=state.table_opt._replace(
+                accum=jnp.zeros((0, 1), state.table.dtype)
+            ),
+        )
     state = state._replace(table=pack_table(state.table))
     packed_acc = pack_accum_any(state.table_opt.accum, d, init_accumulator_value)
     return state._replace(table_opt=state.table_opt._replace(accum=packed_acc))
@@ -162,17 +180,19 @@ def init_packed_state(
     The packed layout keeps the logical init EXACTLY (pack of the same
     init_table draw), so packed and rows runs start from identical
     parameters.  ``accumulator`` follows init_state: ``element`` packs
-    [V, D] → [VP, 128]; ``row`` packs [V, 1] → [VP, P] (dense-G update
-    only — see ops.packed_table.resolve_packed_update)."""
+    [V, D] → [VP, 128]; ``row`` packs [V, 1] → [VP, P]; ``fused`` stores
+    the row accumulator inside the table's own tile rows ([VPf, 128],
+    stride D+1 — the 2-random-op RMW layout, DESIGN §6 round 5)."""
     return pack_state(
         init_state(model, key, init_accumulator_value, accumulator),
         init_accumulator_value,
+        fused=accumulator == "fused",
     )
 
 
 def packed_train_step_body(
     model, learning_rate: float, state: TrainState, batch: Batch,
-    update: str = "auto",
+    update: str = "auto", compact_cap: int = 0,
 ):
     """train_step_body on a lane-packed table: identical math, tile-row
     physical movement (the narrow-scatter cliff fix — DESIGN §6).
@@ -185,25 +205,47 @@ def packed_train_step_body(
     giant-vocab path); ``sorted`` — sort/segment-sum/RMW (bit-parity
     reference); ``auto`` — dense under DENSE_G_MAX_BYTES, else compact."""
     from fast_tffm_tpu.ops.packed_table import (
+        FUSED_UPDATE_FNS,
         PACKED_UPDATE_FNS,
+        fused_gather,
         packed_gather,
+        resolve_fused_update,
         resolve_packed_update,
     )
 
     d = model.row_dim
-    rows = packed_gather(state.table, batch.ids, d)
+    acc = state.table_opt.accum
+    fused = acc.size == 0  # pack_state's fused-state marker
+    if fused:
+        rows = fused_gather(state.table, batch.ids, d)
+    else:
+        rows = packed_gather(state.table, batch.ids, d)
 
     grad_fn = jax.value_and_grad(
         partial(batch_loss, model), argnums=(0, 1), has_aux=True
     )
     (_, data_loss), (g_rows, g_dense) = grad_fn(rows, state.dense, batch)
 
-    acc = state.table_opt.accum
-    mode = resolve_packed_update(update, state.table.shape[0], acc.shape[-1])
-    update_fn = PACKED_UPDATE_FNS[mode]
-    table, accum = update_fn(
-        state.table, acc, batch.ids, g_rows, learning_rate
-    )
+    if fused:
+        from fast_tffm_tpu.ops.packed_table import fused_compact_adagrad_update
+
+        mode = resolve_fused_update(update, state.table.shape[0])
+        if mode == "compact":
+            table = fused_compact_adagrad_update(
+                state.table, batch.ids, g_rows, learning_rate,
+                k_cap=compact_cap,
+            )
+        else:
+            table = FUSED_UPDATE_FNS[mode](
+                state.table, batch.ids, g_rows, learning_rate
+            )
+        accum = acc
+    else:
+        mode = resolve_packed_update(update, state.table.shape[0], acc.shape[-1])
+        update_fn = PACKED_UPDATE_FNS[mode]
+        table, accum = update_fn(
+            state.table, acc, batch.ids, g_rows, learning_rate
+        )
     dense, dense_opt = state.dense, state.dense_opt
     if jax.tree.leaves(state.dense):
         dense, dense_opt = dense_adagrad_update(
@@ -215,22 +257,33 @@ def packed_train_step_body(
     )
 
 
-def make_packed_train_step(model, learning_rate: float, update: str = "auto"):
+def make_packed_train_step(
+    model, learning_rate: float, update: str = "auto", compact_cap: int = 0
+):
+    """``compact_cap`` (fused compact tail only): cap the compacted-row
+    buffer below the exact worst case, with an exact-capacity lax.cond
+    fallback when a batch touches more rows (config: packed_compact_cap)."""
+
     @partial(jax.jit, donate_argnums=(0,))
     def step(state: TrainState, batch: Batch):
-        return packed_train_step_body(model, learning_rate, state, batch, update)
+        return packed_train_step_body(
+            model, learning_rate, state, batch, update, compact_cap
+        )
 
     return step
 
 
-def make_packed_predict_step(model):
-    from fast_tffm_tpu.ops.packed_table import packed_gather
+def make_packed_predict_step(model, fused: bool = False):
+    """``fused`` selects the fused-layout gather (adagrad_accumulator =
+    fused) — the state's table is then the [VPf, 128] fused array."""
+    from fast_tffm_tpu.ops.packed_table import fused_gather, packed_gather
 
     d = model.row_dim
+    gather = fused_gather if fused else packed_gather
 
     @jax.jit
     def predict(state: TrainState, batch: Batch):
-        rows = packed_gather(state.table, batch.ids, d)
+        rows = gather(state.table, batch.ids, d)
         return jax.nn.sigmoid(model.score(rows, state.dense, batch))
 
     return predict

@@ -388,7 +388,11 @@ def train(cfg: Config, *, resume: bool = False, log=print):
     packed = cfg.table_layout == "packed"
     saveable = None
     if packed:
-        from fast_tffm_tpu.ops.packed_table import unpack_accum_any, unpack_table
+        from fast_tffm_tpu.ops.packed_table import (
+            unpack_accum_any,
+            unpack_fused,
+            unpack_table,
+        )
         from fast_tffm_tpu.trainer import (
             init_packed_state,
             make_packed_predict_step,
@@ -397,11 +401,17 @@ def train(cfg: Config, *, resume: bool = False, log=print):
         )
 
         v, d = model.vocabulary_size, model.row_dim
+        fused = cfg.adagrad_accumulator == "fused"
 
         def saveable(st):
             # Checkpoints always hold the LOGICAL arrays ([V, D] table;
-            # [V, D] or [V, 1] accumulator by granularity), so packed and
-            # rows runs restore each other's models freely.
+            # [V, D] or [V, 1] accumulator by granularity), so packed,
+            # fused and rows runs restore each other's models freely.
+            if fused:
+                t, a = unpack_fused(st.table, v, d)
+                return st._replace(
+                    table=t, table_opt=st.table_opt._replace(accum=a)
+                )
             return st._replace(
                 table=unpack_table(st.table, v, d),
                 table_opt=st.table_opt._replace(
@@ -423,18 +433,21 @@ def train(cfg: Config, *, resume: bool = False, log=print):
                     cfg.adagrad_accumulator,
                 ),
             )
-            state = pack_state(logical, cfg.init_accumulator_value)
+            state = pack_state(logical, cfg.init_accumulator_value, fused=fused)
             log(f"resumed from {cfg.model_file} at step {int(state.step)} (packed)")
         else:
             state = init_packed_state(
                 model, jax.random.key(0), cfg.init_accumulator_value,
                 cfg.adagrad_accumulator,
             )
-        predict_step = make_packed_predict_step(model)
+        predict_step = make_packed_predict_step(model, fused=fused)
         step_body = lambda mdl, lr, st, b: packed_train_step_body(
-            mdl, lr, st, b, cfg.packed_update
+            mdl, lr, st, b, cfg.packed_update, cfg.packed_compact_cap
         )
-        step_fn = make_packed_train_step(model, cfg.learning_rate, cfg.packed_update)
+        step_fn = make_packed_train_step(
+            model, cfg.learning_rate, cfg.packed_update,
+            compact_cap=cfg.packed_compact_cap,
+        )
     else:
         state = init_state(
             model, jax.random.key(0), cfg.init_accumulator_value, cfg.adagrad_accumulator
@@ -565,6 +578,16 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
             f"{len(cfg.train_files)} train_files (they align per-file)"
         )
     maybe_initialize_distributed(cfg.coordinator_address, cfg.num_processes, cfg.process_id)
+    if cfg.adagrad_accumulator == "fused":
+        # The fused tile-row layout is single-device (local train) today;
+        # the sharded step's combine/apply paths read a separate
+        # accumulator array.  Row mode gives the same semantics and
+        # near-identical state size on the mesh.
+        raise ValueError(
+            "adagrad_accumulator = fused is local-train only for now; "
+            "use adagrad_accumulator = row for dist_train (same "
+            "row-granularity semantics)"
+        )
     if cfg.device_cache and cfg.shuffle:
         # A shuffled gather across the mesh-sharded batch dim would move
         # rows between chips every step — exactly the per-step traffic

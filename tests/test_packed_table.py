@@ -468,6 +468,163 @@ def test_sharded_packed_matches_sharded_rows(mesh_shape, update):
     )
 
 
+def test_fused_pack_unpack_roundtrip():
+    from fast_tffm_tpu.ops.packed_table import (
+        fused_gather,
+        fused_packed_rows,
+        fused_rows_per_tile,
+        pack_fused,
+        unpack_fused,
+    )
+
+    rng = np.random.default_rng(50)
+    for d in (4, 9, 89, 127):
+        t = jnp.asarray(rng.normal(size=(V, d)).astype(np.float32))
+        a = jnp.asarray(rng.uniform(0.05, 1.0, size=(V, 1)).astype(np.float32))
+        f = pack_fused(t, a, 0.1)
+        assert f.shape == (fused_packed_rows(V, d), 128)
+        assert fused_rows_per_tile(d) == 128 // (d + 1)
+        t2, a2 = unpack_fused(f, V, d)
+        np.testing.assert_array_equal(np.asarray(t2), np.asarray(t))
+        np.testing.assert_array_equal(np.asarray(a2), np.asarray(a))
+        ids = jnp.asarray(rng.integers(0, V, size=(7, 5)).astype(np.int32))
+        np.testing.assert_array_equal(
+            np.asarray(fused_gather(f, ids, d)), np.asarray(t[ids])
+        )
+
+
+@pytest.mark.parametrize("update", ["dense", "compact"])
+def test_fused_update_bitwise_matches_row_mode(update):
+    """The fused tile-row layout (row accumulator stored in-slot, ONE
+    gather + ONE scatter RMW) computes bit-identically to the packed
+    row-mode update of the same strategy — same formulas, different
+    storage address — including duplicate ids and drop sentinels."""
+    from fast_tffm_tpu.ops.packed_table import (
+        FUSED_UPDATE_FNS,
+        PACKED_UPDATE_FNS,
+        pack_fused,
+        unpack_accum_rows,
+        unpack_fused,
+    )
+
+    rng = np.random.default_rng(51)
+    for d in (4, 9, 89):
+        t = jnp.asarray(rng.normal(size=(V, d)).astype(np.float32))
+        a = jnp.asarray(rng.uniform(0.05, 1.0, size=(V, 1)).astype(np.float32))
+        p = rows_per_tile(d)
+        vp = packed_rows(V, d)
+        ids = jnp.asarray(np.concatenate(
+            [rng.integers(0, V, 150), [7, 7, 7], [vp * p + 2] * 3]
+        ).astype(np.int32))
+        g = jnp.asarray(rng.normal(size=(ids.shape[0], d)).astype(np.float32))
+
+        tp, ap = pack_table(t), pack_accum_rows(a, d, 0.1)
+        tr, ar = PACKED_UPDATE_FNS[update](tp, ap, ids, g, 0.1)
+        fz = pack_fused(t, a, 0.1)
+        f2 = FUSED_UPDATE_FNS[update](fz, ids, g, 0.1)
+        t_f, a_f = unpack_fused(f2, V, d)
+        np.testing.assert_array_equal(
+            np.asarray(t_f), np.asarray(unpack_table(tr, V, d))
+        )
+        np.testing.assert_array_equal(
+            np.asarray(a_f), np.asarray(unpack_accum_rows(ar, V, d))
+        )
+
+
+def test_fused_compact_cap_exact_both_branches():
+    """The capped fused compact tail matches the exact one on BOTH
+    lax.cond branches: under the cap (capped buffer in play) and
+    overflowing it (exact-capacity fallback).  Equality is allclose, not
+    bitwise: XLA's scatter-add sums duplicate contributions in a
+    shape-dependent order, so a differently-sized G buffer can associate
+    the same addends differently (measured ~1e-5 absolute)."""
+    from fast_tffm_tpu.ops.packed_table import (
+        fused_compact_adagrad_update,
+        pack_fused,
+    )
+
+    rng = np.random.default_rng(53)
+    d = 9
+    t = jnp.asarray(rng.normal(size=(V, d)).astype(np.float32))
+    a = jnp.asarray(rng.uniform(0.05, 1.0, size=(V, 1)).astype(np.float32))
+    f0 = pack_fused(t, a, 0.1)
+
+    # Few unique PHYSICAL rows (phys = id // 14 ∈ {0..7}, fits cap 8) vs
+    # many unique rows (overflows it — exact fallback branch).
+    ids_few = jnp.asarray((rng.integers(0, 8, 120) * 14).astype(np.int32))
+    ids_many = jnp.asarray(rng.permutation(V)[:150].astype(np.int32))
+    for ids in (ids_few, ids_many):
+        g = jnp.asarray(rng.normal(size=(ids.shape[0], d)).astype(np.float32))
+        exact = fused_compact_adagrad_update(f0, ids, g, 0.1)
+        capped = fused_compact_adagrad_update(f0, ids, g, 0.1, k_cap=8)
+        np.testing.assert_allclose(
+            np.asarray(capped), np.asarray(exact), rtol=1e-4, atol=1e-5
+        )
+
+
+def test_fused_training_matches_row_mode_and_driver(tmp_path):
+    """End-to-end: packed + fused accumulator trains the SAME trajectory
+    as packed + row accumulator from the same init, and the train/predict
+    drivers run it (checkpoints stay logical, interchangeable with rows)."""
+    model = FMModel(vocabulary_size=V, factor_num=8, order=2, factor_lambda=1e-4)
+    rng = np.random.default_rng(52)
+    batches = _batches(rng)
+    rs = init_packed_state(model, jax.random.key(9), accumulator="row")
+    rstep = make_packed_train_step(model, 0.05)
+    fs = init_packed_state(model, jax.random.key(9), accumulator="fused")
+    fstep = make_packed_train_step(model, 0.05)
+    for b in batches:
+        rs, rloss = rstep(rs, b)
+        fs, floss = fstep(fs, b)
+        np.testing.assert_allclose(float(floss), float(rloss), rtol=1e-6)
+    from fast_tffm_tpu.ops.packed_table import unpack_fused
+
+    t_f, a_f = unpack_fused(fs.table, V, model.row_dim)
+    np.testing.assert_array_equal(
+        np.asarray(t_f), np.asarray(unpack_table(rs.table, V, model.row_dim))
+    )
+    assert fs.table_opt.accum.size == 0
+
+    # Driver round-trip: train with fused, predict with rows layout.
+    import json
+
+    from fast_tffm_tpu.config import Config
+    from fast_tffm_tpu.prediction import predict
+    from fast_tffm_tpu.training import train
+
+    src = tmp_path / "t.libsvm"
+    with open(src, "w") as f:
+        for _ in range(96):
+            nnz = rng.integers(1, 6)
+            toks = [
+                f"{rng.integers(0, V)}:{round(float(rng.normal()), 4)}"
+                for _ in range(nnz)
+            ]
+            f.write(f"{rng.integers(0, 2)} {' '.join(toks)}\n")
+    cfg = Config(
+        model="fm", factor_num=4, vocabulary_size=V,
+        model_file=str(tmp_path / "m.npz"),
+        train_files=(str(src),), predict_files=(str(src),),
+        score_path=str(tmp_path / "s.txt"),
+        epoch_num=2, batch_size=32, learning_rate=0.1, log_every=1,
+        table_layout="packed", adagrad_accumulator="fused",
+    ).validate()
+    train(cfg, log=lambda *_: None)
+    # Resume continues from the fused checkpoint (logical [V,1] accum).
+    train(cfg, resume=True, log=lambda *_: None)
+    predict(cfg, log=lambda *_: None)
+    import dataclasses
+
+    cfg_rows = dataclasses.replace(
+        cfg, table_layout="rows", adagrad_accumulator="row",
+        score_path=str(tmp_path / "s_rows.txt"), packed_update="auto",
+    ).validate()
+    predict(cfg_rows, log=lambda *_: None)
+    s_f = [float(x) for x in open(cfg.score_path).read().split()]
+    s_r = [float(x) for x in open(cfg_rows.score_path).read().split()]
+    np.testing.assert_allclose(s_f, s_r, rtol=1e-6)
+
+
 @pytest.mark.parametrize("update", ["dense", "compact", "sorted"])
 def test_sharded_1x1_mesh_bitwise_matches_local(update):
     """On a 1×1 mesh the sharded step takes the static short-circuit paths

@@ -39,7 +39,6 @@ def _one(cfg: str, vocab: int, batch: int) -> None:
     from fast_tffm_tpu.trainer import TrainState, make_train_step, make_packed_train_step
     from fast_tffm_tpu.ops.packed_table import LANES, packed_rows, rows_per_tile
 
-    bench.BATCH = batch
     rng = np.random.default_rng(0)
     model = FMModel(vocabulary_size=vocab, factor_num=K, order=2)
     batches = [
@@ -50,6 +49,18 @@ def _one(cfg: str, vocab: int, batch: int) -> None:
     if cfg == "rows":
         step = make_train_step(model, learning_rate=0.01)
         state = bench.scale_state(vocab, K)
+    elif cfg in ("fused", "fused-dense", "fused-capped"):
+        # The ONE fused-state builder lives in bench.py — duplicating the
+        # stride-(d+1) lane init here would let the probe drift from what
+        # the bench actually measures.
+        state = bench.fused_scale_state(vocab, K)
+        step = make_packed_train_step(
+            model, learning_rate=0.01,
+            update="dense" if cfg == "fused-dense" else "compact",
+            # Zipf(1.1) at B=65536 measures ~0.5M unique physical rows;
+            # cap at 2^20 with the exact lax.cond fallback.
+            compact_cap=(1 << 20) if cfg == "fused-capped" else 0,
+        )
     else:
         update, accum = {
             "compact": ("compact", "row"),
@@ -77,7 +88,7 @@ def _one(cfg: str, vocab: int, batch: int) -> None:
         )
         step = make_packed_train_step(model, learning_rate=0.01, update=update)
 
-    state, rate = bench.measure(step, state, batches, iters=20)
+    state, rate = bench.measure(step, state, batches, iters=20, batch_size=batch)
     print(json.dumps({"cfg": cfg, "vocab": vocab, "batch": batch,
                       "rate_per_chip": round(rate / jax.device_count(), 1)}))
 

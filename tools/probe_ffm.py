@@ -147,7 +147,6 @@ def main():
         print(tag, out[f"{tag}_ms"], flush=True)
 
     # Whole-step A/B: f32 vs bf16 interaction einsums, interleaved.
-    bench.BATCH = B
     batches = [bench_all.make_batch(rng, B, F, VOCAB, num_fields=F) for _ in range(4)]
     s32 = init_packed_state(model, jax.random.key(1))
     step32 = make_packed_train_step(model, 0.05, "auto")

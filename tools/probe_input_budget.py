@@ -47,7 +47,6 @@ def tpu_stages(res: dict, rows: int) -> None:
     from fast_tffm_tpu.models import Batch, FMModel
     from fast_tffm_tpu.trainer import init_packed_state, make_packed_train_step
 
-    bench.BATCH = BATCH
     path = bench.ensure_scale_fmb(VOCAB, rows=rows)
 
     def read_all():
@@ -103,7 +102,7 @@ def tpu_stages(res: dict, rows: int) -> None:
         bench.make_batch(bench.zipf_ids(rng, (BATCH, NNZ), VOCAB), i)
         for i in range(4)
     ]
-    state, rate = bench.measure(step, state, batches, iters=10)
+    state, rate = bench.measure(step, state, batches, iters=10, batch_size=BATCH)
     res["step_rate"] = round(rate, 1)
 
     # End-to-end: stream → H2D → step, prefetch depth 8.
@@ -185,7 +184,6 @@ def input_scaling(res: dict, rows: int) -> None:
     """1-process vs 2-process sharded parse+assembly (CPU mesh, no step)."""
     import bench
 
-    bench.BATCH = BATCH
     path = bench.ensure_scale_fmb(VOCAB, rows=rows)
     out = {}
     for nproc in (1, 2):

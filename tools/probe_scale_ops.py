@@ -149,12 +149,11 @@ def probe_vocab(vocab: int) -> dict:
     # Whole step, bench-measured for the same-session anchor.
     import bench
 
-    bench.BATCH = BATCH
     state = TrainState(table=table, table_opt=AdagradState(accum), dense={},
                        dense_opt=AdagradState({}), step=jnp.zeros((), jnp.int32))
     step = make_packed_train_step(model, 0.01, "compact")
     batches = [make_batch(zipf_ids(rng, (BATCH, NNZ), vocab), i) for i in range(4)]
-    state, rate = bench.measure(step, state, batches, iters=20)
+    state, rate = bench.measure(step, state, batches, iters=20, batch_size=BATCH)
     out["step_rate_per_chip"] = round(rate / jax.device_count(), 1)
     out["step_ms"] = round(BATCH / rate * 1e3 * jax.device_count(), 2)
     del state, table, accum

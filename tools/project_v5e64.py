@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""v5e-64 / 10B-parameter projection inputs (VERDICT r4 #2).
+
+Compiles the REAL mesh-sharded train step for a 64-device mesh (virtual
+CPU devices — compilation allocates no data buffers) at the north-star
+shape (10B params ≈ 1.11B rows at D=9, row accumulator) and at BASELINE
+config #2's shape (FM k=16), extracts every cross-device collective from
+the compiled HLO, and models per-device wire bytes with standard ring
+costs (tests/test_parallel.py:hlo_ici_bytes — the same parser the ICI
+test pins).  docs/SCALE.md combines these statics with the measured
+single-chip step times into the per-step time budget.
+
+Run:  XLA_FLAGS=--xla_force_host_platform_device_count=64 \
+      JAX_PLATFORMS=cpu python tools/project_v5e64.py
+Writes PROJECT_V5E64_r05.json.
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=64"
+    ).strip()
+
+import jax
+
+# The harness may pin another platform via env/sitecustomize; jax.config
+# wins when applied before backend initialization (tests do the same).
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 64)
+import jax.numpy as jnp
+import numpy as np
+
+from fast_tffm_tpu.models import Batch, FMModel
+from fast_tffm_tpu.optim import AdagradState
+from fast_tffm_tpu.parallel import make_mesh, make_sharded_train_step
+from fast_tffm_tpu.parallel.mesh import ROW_AXIS, batch_sharding, replicated, table_sharding
+from fast_tffm_tpu.trainer import TrainState
+from tests.test_parallel import hlo_ici_bytes
+
+
+def wire_bytes(model, mesh, global_batch, nnz, lookup, accum_cols=1,
+               capacity_factor=2.0):
+    """Per-device ICI wire bytes/step for one (config, mesh, lookup),
+    from the compiled HLO — abstract lowering, no arrays materialize."""
+    from fast_tffm_tpu.parallel.train_step import _pad_model_vocab
+
+    padded = _pad_model_vocab(model, mesh)
+    v, d = padded.vocabulary_size, padded.row_dim
+    ts, bs, rep = table_sharding(mesh), batch_sharding(mesh), replicated(mesh)
+
+    def sds(shape, dtype, sh):
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=sh)
+
+    state = TrainState(
+        table=sds((v, d), jnp.float32, ts),
+        table_opt=AdagradState(sds((v, accum_cols), jnp.float32, ts)),
+        dense={},
+        dense_opt=AdagradState({}),
+        step=sds((), jnp.int32, rep),
+    )
+    batch = Batch(
+        labels=sds((global_batch,), jnp.float32, bs),
+        ids=sds((global_batch, nnz), jnp.int32, bs),
+        vals=sds((global_batch, nnz), jnp.float32, bs),
+        fields=sds((global_batch, 0), jnp.int32, bs),
+        weights=sds((global_batch,), jnp.float32, bs),
+    )
+    step = make_sharded_train_step(
+        model, 0.01, mesh, lookup=lookup, capacity_factor=capacity_factor
+    )
+    hlo = jax.jit(step).lower(state, batch).compile().as_text()
+    per_op = hlo_ici_bytes(hlo)
+    return {k: round(v) for k, v in per_op.items()}, round(sum(per_op.values()))
+
+
+def main():
+    assert jax.device_count() >= 64, jax.devices()
+    out = {"devices": 64, "note": "per-device ICI wire bytes/step from compiled "
+           "HLO (ring-cost model, tests/test_parallel.py:hlo_ici_bytes)"}
+
+    # North star: 10B params at D=9 (k=8) -> 1,111,111,168 rows (padded).
+    # Per-chip batch 65536 (the measured knee) -> global 4.19M rows/step.
+    north = FMModel(vocabulary_size=1_111_111_168, factor_num=8, order=2)
+    # BASELINE config #2: FM order-2 k=16 (D=17), same 10B-param budget
+    # -> 588,235,294 rows.
+    cfg2 = FMModel(vocabulary_size=588_235_294, factor_num=16, order=2)
+
+    per_chip_b = 65536
+    cases = []
+    for name, model, nnz in (("northstar_k8", north, 39), ("cfg2_k16", cfg2, 39)):
+        for data, row in ((1, 64), (4, 16), (8, 8)):
+            mesh = make_mesh(data, row, devices=jax.devices()[:64])
+            gb = per_chip_b * 64
+            for lookup in ("allgather", "alltoall"):
+                try:
+                    parts, total = wire_bytes(model, mesh, gb, nnz, lookup)
+                    cases.append({
+                        "config": name, "mesh": f"data{data}xrow{row}",
+                        "lookup": lookup, "global_batch": gb,
+                        "per_device_wire_bytes": total, "by_op": parts,
+                    })
+                except Exception as e:
+                    cases.append({
+                        "config": name, "mesh": f"data{data}xrow{row}",
+                        "lookup": lookup, "error": str(e)[:200],
+                    })
+                print(cases[-1], flush=True)
+    out["cases"] = cases
+    path = os.path.join(REPO, "PROJECT_V5E64_r05.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print("wrote", path)
+
+
+if __name__ == "__main__":
+    main()
