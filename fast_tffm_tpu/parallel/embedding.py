@@ -40,6 +40,8 @@ __all__ = [
     "packed_sharded_gather",
     "packed_sharded_update",
     "packed_sharded_dense_update",
+    "fused_sharded_gather",
+    "fused_sharded_update",
 ]
 
 
@@ -260,3 +262,72 @@ def packed_sharded_dense_update(
         return update_fn(packed_shard, accum_shard, all_ids, all_g, lr)
     local, _ = owned_local_ids(all_ids, shard_logical_rows, packed_shard.shape[0] * p)
     return update_fn(packed_shard, accum_shard, local, all_g, lr)
+
+
+# --- fused tile-row shard variants (ops/packed_table.py round 5) ----------
+#
+# Same collectives as the packed variants; the shard stores params + row
+# accumulator in ONE [VPf_s, 128] fused array (stride D+1 slots), so the
+# update's per-shard apply is one gather + one scatter.  Requires the
+# shard's LOGICAL row count to be a multiple of fused_rows_per_tile(D)
+# (train_step's packed_shard_meta handles the padding), so per-shard
+# fusing equals a row-block of the globally fused table and checkpoints
+# stay layout-independent.
+
+
+def fused_sharded_gather(
+    fused_shard: jax.Array, ids: jax.Array, d: int, shard_logical_rows: int
+) -> jax.Array:
+    """sharded_gather on a fused shard: [B_local, N, D] rows."""
+    from fast_tffm_tpu.ops.packed_table import fused_gather
+
+    if lax.axis_size(ROW_AXIS) == 1:
+        # One row shard: skip identity collectives + full-true masking
+        # (sharded_gather's in-range-id note applies).
+        return fused_gather(fused_shard, ids, d)
+    all_ids = lax.all_gather(ids, ROW_AXIS, tiled=True)
+    local, owned = owned_local_ids(all_ids, shard_logical_rows, 0)
+    rows = fused_gather(fused_shard, local, d)
+    rows = rows * owned[..., None].astype(rows.dtype)
+    return lax.psum_scatter(rows, ROW_AXIS, scatter_dimension=0, tiled=True)
+
+
+def fused_sharded_update(
+    fused_shard: jax.Array,
+    ids: jax.Array,
+    row_grads: jax.Array,
+    lr: float,
+    shard_logical_rows: int,
+    mode: str = "compact",
+    k_cap: int = 0,
+):
+    """packed_sharded_dense_update's fused twin: ship RAW per-occurrence
+    grads (scatter-ADD dedup — the same all_gather payload), each shard
+    applies the ids it owns through the fused tail (``mode``: dense |
+    compact; compact honors ``k_cap``).  Unowned ids map past the last
+    physical row and drop."""
+    from fast_tffm_tpu.ops.packed_table import (
+        fused_compact_adagrad_update,
+        fused_dense_adagrad_update,
+        fused_rows_per_tile,
+    )
+
+    D = row_grads.shape[-1]
+    p = fused_rows_per_tile(D)
+
+    def apply(shard, local_ids, g):
+        if mode == "compact":
+            return fused_compact_adagrad_update(shard, local_ids, g, lr, k_cap)
+        return fused_dense_adagrad_update(shard, local_ids, g, lr)
+
+    flat_ids = ids.reshape(-1)
+    flat_g = row_grads.reshape(-1, D)
+    one_shard = lax.axis_size(ROW_AXIS) == 1
+    if one_shard and lax.axis_size(DATA_AXIS) == 1:
+        return apply(fused_shard, flat_ids, flat_g)
+    all_ids = lax.all_gather(flat_ids, (DATA_AXIS, ROW_AXIS), tiled=True)
+    all_g = lax.all_gather(flat_g, (DATA_AXIS, ROW_AXIS), tiled=True)
+    if one_shard:
+        return apply(fused_shard, all_ids, all_g)
+    local, _ = owned_local_ids(all_ids, shard_logical_rows, fused_shard.shape[0] * p)
+    return apply(fused_shard, local, all_g)

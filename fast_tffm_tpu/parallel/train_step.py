@@ -135,16 +135,18 @@ def init_sharded_state(
     ([VP_shard, 128] each — ops/packed_table.py); the shard-aligned vocab
     padding makes the global packed array exactly the concatenation of the
     per-shard packings.  ``accumulator='row'`` with the packed layout
-    packs the [V, 1] accumulator as [VP_shard, P] scalar slots (dense-G
-    update only — resolve_packed_update)."""
+    packs the [V, 1] accumulator as [VP_shard, P] scalar slots;
+    ``accumulator='fused'`` stores the row accumulator inside the table's
+    own tile rows ([VPf_shard, 128], stride D+1 — the 2-random-op RMW)."""
     if table_layout == "packed":
-        from fast_tffm_tpu.ops.packed_table import rows_per_tile
         from fast_tffm_tpu.trainer import pack_state
 
-        model = _pad_model_vocab(model, mesh, pack=rows_per_tile(model.row_dim))
+        fused = accumulator == "fused"
+        model, _, _ = packed_shard_meta(model, mesh, fused=fused)
         state = pack_state(
             init_state(model, key, init_accumulator_value, accumulator),
             init_accumulator_value,
+            fused=fused,
         )
     else:
         model = _pad_model_vocab(model, mesh)
@@ -160,12 +162,13 @@ def init_sharded_state(
     )
 
 
-def packed_shard_meta(model, mesh: Mesh):
+def packed_shard_meta(model, mesh: Mesh, fused: bool = False):
     """(padded_model, shard_logical_rows, rows_per_tile) for the packed
-    sharded layout — the one place its padding arithmetic lives."""
-    from fast_tffm_tpu.ops.packed_table import rows_per_tile
+    sharded layout — the one place its padding arithmetic lives.
+    ``fused`` switches to the fused tile-row pack factor (stride D+1)."""
+    from fast_tffm_tpu.ops.packed_table import fused_rows_per_tile, rows_per_tile
 
-    p = rows_per_tile(model.row_dim)
+    p = fused_rows_per_tile(model.row_dim) if fused else rows_per_tile(model.row_dim)
     padded = _pad_model_vocab(model, mesh, pack=p)
     return padded, padded.vocabulary_size // mesh.shape[ROW_AXIS], p
 
@@ -177,14 +180,34 @@ def unpack_sharded_to_logical(state: TrainState, model, mesh: Mesh) -> TrainStat
     The unpack itself runs in PURE NUMPY on the fetched host copy — the
     whole point of this path (the single-process save route, ADVICE r4)
     is to avoid device-memory transients next to the live packed state,
-    so nothing here may round-trip through jnp."""
+    so nothing here may round-trip through jnp.  The FUSED layout is
+    recognized by its empty-accumulator sentinel (pack_state) and
+    unpacks to the logical ([V, D] table, [V, 1] accumulator) pair."""
     import numpy as np
 
     from fast_tffm_tpu.ops.packed_table import LANES, rows_per_tile
 
-    _, shard_logical, p = packed_shard_meta(model, mesh)
     R = mesh.shape[ROW_AXIS]
     d = model.row_dim
+    fused = state.table_opt.accum.size == 0
+    _, shard_logical, p = packed_shard_meta(model, mesh, fused=fused)
+
+    def shards(arr):
+        a = np.asarray(arr)
+        per = a.shape[0] // R
+        return [a[r * per : (r + 1) * per] for r in range(R)]
+
+    if fused:
+        d1 = d + 1
+        tabs, accs = [], []
+        for a in shards(state.table):  # numpy twin of unpack_fused
+            flat = a[:, : p * d1].reshape(a.shape[0] * p, d1)[:shard_logical]
+            tabs.append(flat[:, :d])
+            accs.append(flat[:, d:])
+        return state._replace(
+            table=np.concatenate(tabs),
+            table_opt=state.table_opt._replace(accum=np.concatenate(accs)),
+        )
 
     def unp_table(a):  # numpy twin of ops.packed_table.unpack_table
         return a[:, : p * d].reshape(a.shape[0] * p, d)[:shard_logical]
@@ -195,17 +218,12 @@ def unpack_sharded_to_logical(state: TrainState, model, mesh: Mesh) -> TrainStat
         q = a.shape[-1]
         return a.reshape(a.shape[0] * q, 1)[:shard_logical]
 
-    def unp(arr, unpack):
-        a = np.asarray(arr)
-        per = a.shape[0] // R
-        return np.concatenate(
-            [unpack(a[r * per : (r + 1) * per]) for r in range(R)]
-        )
-
     return state._replace(
-        table=unp(state.table, unp_table),
+        table=np.concatenate([unp_table(a) for a in shards(state.table)]),
         table_opt=state.table_opt._replace(
-            accum=unp(state.table_opt.accum, unp_accum)
+            accum=np.concatenate(
+                [unp_accum(a) for a in shards(state.table_opt.accum)]
+            )
         ),
     )
 
@@ -214,7 +232,10 @@ from functools import lru_cache
 
 
 @lru_cache(maxsize=32)
-def _packed_io_fns(mesh: Mesh, shard_logical: int, d: int, init_value: float):
+def _packed_io_fns(
+    mesh: Mesh, shard_logical: int, d: int, init_value: float,
+    fused: bool = False,
+):
     """Jitted per-shard pack/unpack transforms for one (mesh, layout)
     combination, built ONCE and cached: dist_saveable calls the unpack at
     every checkpoint save, and rebuilding shard_map around fresh lambdas
@@ -222,18 +243,34 @@ def _packed_io_fns(mesh: Mesh, shard_logical: int, d: int, init_value: float):
     the cache key pins everything the traces close over."""
     from fast_tffm_tpu.ops.packed_table import (
         pack_accum_any,
+        pack_fused,
         pack_table,
         unpack_accum_any,
+        unpack_fused,
         unpack_table,
     )
 
     spec = P(ROW_AXIS, None)
 
-    def mapped(fn):
+    def mapped(fn, n_in=1, n_out=1):
         return jax.jit(
-            shard_map(fn, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False)
+            shard_map(
+                fn, mesh=mesh,
+                in_specs=spec if n_in == 1 else (spec,) * n_in,
+                out_specs=spec if n_out == 1 else (spec,) * n_out,
+                check_vma=False,
+            )
         )
 
+    if fused:
+        return {
+            "unpack_fused": mapped(
+                lambda s: unpack_fused(s, shard_logical, d), n_out=2
+            ),
+            "pack_fused": mapped(
+                lambda t, a: pack_fused(t, a, init_value), n_in=2
+            ),
+        }
     return {
         "unpack_table": mapped(lambda s: unpack_table(s, shard_logical, d)),
         "unpack_accum": mapped(lambda s: unpack_accum_any(s, shard_logical, d)),
@@ -252,9 +289,16 @@ def unpack_sharded_on_device(state: TrainState, model, mesh: Mesh) -> TrainState
     host saves only its own unpacked shards, which is exactly the
     per-process logical<->packed checkpoint assembly multi-host packed
     runs need.  Shard-aligned padding (packed_shard_meta) makes the
-    concatenation of per-shard unpacks equal the global unpack."""
-    _, shard_logical, _ = packed_shard_meta(model, mesh)
-    fns = _packed_io_fns(mesh, shard_logical, model.row_dim, 0.0)
+    concatenation of per-shard unpacks equal the global unpack.  A FUSED
+    state (empty-accumulator sentinel) unpacks through unpack_fused."""
+    fused = state.table_opt.accum.size == 0
+    _, shard_logical, _ = packed_shard_meta(model, mesh, fused=fused)
+    fns = _packed_io_fns(mesh, shard_logical, model.row_dim, 0.0, fused=fused)
+    if fused:
+        t, a = fns["unpack_fused"](state.table)
+        return state._replace(
+            table=t, table_opt=state.table_opt._replace(accum=a)
+        )
     return state._replace(
         table=fns["unpack_table"](state.table),
         table_opt=state.table_opt._replace(
@@ -264,14 +308,17 @@ def unpack_sharded_on_device(state: TrainState, model, mesh: Mesh) -> TrainState
 
 
 def pack_sharded_on_device(
-    logical: TrainState, model, mesh: Mesh, init_accumulator_value: float = 0.1
+    logical: TrainState, model, mesh: Mesh, init_accumulator_value: float = 0.1,
+    fused: bool = False,
 ) -> TrainState:
     """Inverse of ``unpack_sharded_on_device``: a LOGICAL row-sharded
     state (e.g. a checkpoint restored in place onto the packed-aligned
     padding — see ``packed_shard_meta``) -> lane-packed row-sharded
     state, packed per shard on its own devices.  Multi-host safe for the
-    same reason: no host materialization of the global table."""
-    _, shard_logical, _ = packed_shard_meta(model, mesh)
+    same reason: no host materialization of the global table.  ``fused``
+    packs into the fused tile-row layout (the caller knows the target
+    layout from its config; the logical input looks identical either way)."""
+    _, shard_logical, _ = packed_shard_meta(model, mesh, fused=fused)
     if logical.table.shape[0] != shard_logical * mesh.shape[ROW_AXIS]:
         raise ValueError(
             f"pack_sharded_on_device needs the packed-aligned padded vocab "
@@ -280,8 +327,16 @@ def pack_sharded_on_device(
             "packed_shard_meta's padded model"
         )
     fns = _packed_io_fns(
-        mesh, shard_logical, model.row_dim, float(init_accumulator_value)
+        mesh, shard_logical, model.row_dim, float(init_accumulator_value),
+        fused=fused,
     )
+    if fused:
+        return logical._replace(
+            table=fns["pack_fused"](logical.table, logical.table_opt.accum),
+            table_opt=logical.table_opt._replace(
+                accum=jnp.zeros((0, 1), logical.table.dtype)
+            ),
+        )
     return logical._replace(
         table=fns["pack_table"](logical.table),
         table_opt=logical.table_opt._replace(
@@ -337,6 +392,7 @@ def make_sharded_train_step(
     model, learning_rate: float, mesh: Mesh, *, lookup: str = "allgather",
     capacity_factor: float = 2.0, overflow_mode: str = "abort",
     table_layout: str = "rows", packed_update: str = "auto",
+    accumulator: str = "element", compact_cap: int = 0,
 ):
     """Returns jitted SPMD ``step(state, batch) -> (state, global mean loss)``.
 
@@ -364,14 +420,24 @@ def make_sharded_train_step(
     3-tuple.
     """
     packed = table_layout == "packed"
+    fused = accumulator == "fused"
+    if fused and not packed:
+        raise ValueError("accumulator='fused' requires table_layout='packed'")
+    if fused and lookup == "alltoall":
+        # The routed serve/apply paths read the packed layout; the fused
+        # stride-(D+1) variant is not plumbed through them (yet).  Row
+        # mode gives the same semantics on the routed path.
+        raise ValueError(
+            "accumulator='fused' supports lookup='allgather' only; use "
+            "adagrad_accumulator=row with lookup=alltoall (same "
+            "row-granularity semantics)"
+        )
     if packed:
-        from fast_tffm_tpu.ops.packed_table import rows_per_tile
-
-        model = _pad_model_vocab(model, mesh, pack=rows_per_tile(model.row_dim))
+        model, shard_logical_rows, _ = packed_shard_meta(model, mesh, fused=fused)
     else:
         model = _pad_model_vocab(model, mesh)
+        shard_logical_rows = model.vocabulary_size // mesh.shape[ROW_AXIS]
     num_rows_global = model.vocabulary_size
-    shard_logical_rows = num_rows_global // mesh.shape[ROW_AXIS]
     d_row = model.row_dim
     if overflow_mode not in ("abort", "fallback"):
         raise ValueError(f"unknown overflow_mode {overflow_mode!r} (abort | fallback)")
@@ -401,6 +467,23 @@ def make_sharded_train_step(
         grad_fn = jax.value_and_grad(loss_fn, argnums=(0, 1), has_aux=True)
 
         def allgather_branch():
+            if fused:
+                from fast_tffm_tpu.ops.packed_table import resolve_fused_update
+                from fast_tffm_tpu.parallel.embedding import (
+                    fused_sharded_gather,
+                    fused_sharded_update,
+                )
+
+                rows = fused_sharded_gather(
+                    table, batch.ids, d_row, shard_logical_rows
+                )
+                (_, dl), (g_rows, g_dense) = grad_fn(rows, dense)
+                fmode = resolve_fused_update(packed_update, table.shape[0])
+                t2 = fused_sharded_update(
+                    table, batch.ids, g_rows, learning_rate,
+                    shard_logical_rows, mode=fmode, k_cap=compact_cap,
+                )
+                return t2, accum, g_dense, dl
             if packed:
                 from fast_tffm_tpu.ops.packed_table import resolve_packed_update
                 from fast_tffm_tpu.parallel.embedding import (
@@ -524,25 +607,38 @@ def make_sharded_train_step(
 def make_sharded_predict_step(
     model, mesh: Mesh, *, lookup: str = "allgather", capacity_factor: float = 2.0,
     overflow_mode: str = "abort", table_layout: str = "rows",
+    accumulator: str = "element",
 ):
     """Returns jitted SPMD ``predict(state, batch) -> sigmoid scores [B]``.
 
     ``overflow_mode='fallback'`` (alltoall only) reruns an overflowing
     batch's lookup through the allgather collective instead of NaN-ing the
-    scores — same ``lax.cond`` scheme as the train step."""
+    scores — same ``lax.cond`` scheme as the train step.
+    ``accumulator='fused'`` reads the fused tile-row table (the state a
+    fused dist_train holds mid-run; allgather lookup only)."""
     packed = table_layout == "packed"
+    fused = accumulator == "fused"
+    if fused and lookup == "alltoall":
+        raise ValueError(
+            "accumulator='fused' supports lookup='allgather' only "
+            "(make_sharded_train_step rationale)"
+        )
     if packed:
-        from fast_tffm_tpu.ops.packed_table import rows_per_tile
-
-        model = _pad_model_vocab(model, mesh, pack=rows_per_tile(model.row_dim))
+        model, shard_logical_rows, _ = packed_shard_meta(model, mesh, fused=fused)
     else:
         model = _pad_model_vocab(model, mesh)
-    shard_logical_rows = model.vocabulary_size // mesh.shape[ROW_AXIS]
+        shard_logical_rows = model.vocabulary_size // mesh.shape[ROW_AXIS]
     d_row = model.row_dim
     fallback = lookup == "alltoall" and overflow_mode == "fallback"
     packed_meta = (d_row, shard_logical_rows) if packed else None
 
     def shard_body(table, dense, batch: Batch):
+        if fused:
+            from fast_tffm_tpu.parallel.embedding import fused_sharded_gather
+
+            rows = fused_sharded_gather(table, batch.ids, d_row, shard_logical_rows)
+            scores = jax.nn.sigmoid(model.score(rows, dense, batch))
+            return lax.all_gather(scores, _BOTH, tiled=True)
         gather, cap, can_overflow = _make_gather(
             mesh, batch.ids.shape, lookup, capacity_factor, packed_meta
         )

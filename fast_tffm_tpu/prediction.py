@@ -161,7 +161,13 @@ def dist_predict(cfg: Config, log=print, mesh=None) -> str:
         from fast_tffm_tpu.parallel import pack_sharded_on_device
         from fast_tffm_tpu.parallel.train_step import packed_shard_meta
 
-        padded_model, _, _ = packed_shard_meta(model, mesh)
+        # A fused-trained checkpoint is padded with the FUSED pack factor
+        # (stride D+1), which differs from the plain packed padding —
+        # the template must match or the multi-host restore (which cannot
+        # re-pad) raises on the shape.  The predict step then reads the
+        # same layout the state was packed into.
+        fused_acc = cfg.adagrad_accumulator == "fused"
+        padded_model, _, _ = packed_shard_meta(model, mesh, fused=fused_acc)
         logical = restore_checkpoint(
             cfg.model_file,
             init_sharded_state(
@@ -170,7 +176,7 @@ def dist_predict(cfg: Config, log=print, mesh=None) -> str:
             ),
         )
         state = pack_sharded_on_device(
-            logical, model, mesh, cfg.init_accumulator_value
+            logical, model, mesh, cfg.init_accumulator_value, fused=fused_acc
         )
     else:
         state = init_sharded_state(
@@ -185,6 +191,7 @@ def dist_predict(cfg: Config, log=print, mesh=None) -> str:
             model, mesh, lookup=cfg.lookup,
             capacity_factor=cfg.lookup_capacity_factor,
             overflow_mode=cfg.lookup_overflow, table_layout=cfg.table_layout,
+            accumulator=cfg.adagrad_accumulator,
         ),
         max_nnz,
         log,

@@ -578,14 +578,12 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
             f"{len(cfg.train_files)} train_files (they align per-file)"
         )
     maybe_initialize_distributed(cfg.coordinator_address, cfg.num_processes, cfg.process_id)
-    if cfg.adagrad_accumulator == "fused":
-        # The fused tile-row layout is single-device (local train) today;
-        # the sharded step's combine/apply paths read a separate
-        # accumulator array.  Row mode gives the same semantics and
-        # near-identical state size on the mesh.
+    if cfg.adagrad_accumulator == "fused" and cfg.lookup == "alltoall":
+        # The routed serve/apply paths read the separate-accumulator
+        # packed layout; row mode gives the same semantics there.
         raise ValueError(
-            "adagrad_accumulator = fused is local-train only for now; "
-            "use adagrad_accumulator = row for dist_train (same "
+            "adagrad_accumulator = fused supports lookup = allgather only; "
+            "use adagrad_accumulator = row with lookup = alltoall (same "
             "row-granularity semantics)"
         )
     if cfg.device_cache and cfg.shuffle:
@@ -616,7 +614,8 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
         from fast_tffm_tpu.parallel import pack_sharded_on_device
         from fast_tffm_tpu.parallel.train_step import packed_shard_meta
 
-        padded_model, _, _ = packed_shard_meta(model, mesh)
+        fused_acc = cfg.adagrad_accumulator == "fused"
+        padded_model, _, _ = packed_shard_meta(model, mesh, fused=fused_acc)
         logical = restore_checkpoint(
             cfg.model_file,
             init_sharded_state(
@@ -625,7 +624,7 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
             ),
         )
         state = pack_sharded_on_device(
-            logical, model, mesh, cfg.init_accumulator_value
+            logical, model, mesh, cfg.init_accumulator_value, fused=fused_acc
         )
         log(f"resumed from {cfg.model_file} at step {int(state.step)}")
     else:
@@ -641,10 +640,13 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
         lookup=cfg.lookup, capacity_factor=cfg.lookup_capacity_factor,
         overflow_mode=cfg.lookup_overflow, table_layout=cfg.table_layout,
         packed_update=cfg.packed_update,
+        accumulator=cfg.adagrad_accumulator,
+        compact_cap=cfg.packed_compact_cap,
     )
     predict_step = make_sharded_predict_step(
         model, mesh, lookup=cfg.lookup, capacity_factor=cfg.lookup_capacity_factor,
         overflow_mode=cfg.lookup_overflow, table_layout=cfg.table_layout,
+        accumulator=cfg.adagrad_accumulator,
     )
     dist_saveable = None
     if cfg.table_layout == "packed":

@@ -714,6 +714,110 @@ def test_sharded_packed_dense_bitwise_matches_local_dense():
 
 
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs the 8-device CPU mesh")
+@pytest.mark.parametrize(
+    "mesh_shape", [(1, 8), (2, 4), (8, 1)], ids=lambda s: f"data{s[0]}xrow{s[1]}"
+)
+def test_sharded_fused_matches_sharded_row_mode(mesh_shape):
+    """The FUSED tile-row layout through the MESH-SHARDED step (round 5:
+    fused_sharded_gather/update) tracks the rows-layout row-accumulator
+    sharded step, its state unpacks to the same logical table, and the
+    fused sharded predict matches."""
+    from fast_tffm_tpu.parallel import (
+        init_sharded_state,
+        make_mesh,
+        make_sharded_predict_step,
+        make_sharded_train_step,
+        unpack_sharded_to_logical,
+    )
+
+    model = FMModel(vocabulary_size=V, factor_num=4, order=2, factor_lambda=1e-4)
+    mesh = make_mesh(*mesh_shape)
+    rng = np.random.default_rng(60)
+    batches = _batches(rng, n=3)
+
+    rs = init_sharded_state(model, mesh, jax.random.key(14), accumulator="row")
+    rstep = make_sharded_train_step(model, 0.1, mesh)
+    fs = init_sharded_state(
+        model, mesh, jax.random.key(14), accumulator="fused", table_layout="packed"
+    )
+    fstep = make_sharded_train_step(
+        model, 0.1, mesh, table_layout="packed", accumulator="fused",
+        compact_cap=32, packed_update="compact",
+    )
+    for b in batches:
+        rs, rloss = rstep(rs, b)
+        fs, floss = fstep(fs, b)
+        np.testing.assert_allclose(float(floss), float(rloss), rtol=1e-5)
+    un = unpack_sharded_to_logical(fs, model, mesh)
+    np.testing.assert_allclose(
+        np.asarray(un.table)[:V], np.asarray(rs.table)[:V], rtol=1e-5, atol=1e-7
+    )
+    np.testing.assert_allclose(
+        np.asarray(un.table_opt.accum)[:V],
+        np.asarray(rs.table_opt.accum)[:V], rtol=1e-5, atol=1e-7,
+    )
+
+    fpred = make_sharded_predict_step(
+        model, mesh, table_layout="packed", accumulator="fused"
+    )
+    rpred = make_sharded_predict_step(model, mesh)
+    np.testing.assert_allclose(
+        np.asarray(fpred(fs, batches[0])),
+        np.asarray(rpred(rs, batches[0])),
+        rtol=1e-5,
+    )
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs the 8-device CPU mesh")
+def test_dist_train_fused_driver(tmp_path):
+    """dist_train with adagrad_accumulator=fused: trains over the mesh,
+    saves the LOGICAL checkpoint, resumes, and the checkpoint matches a
+    row-accumulator dist run's trajectory."""
+    import json
+
+    from fast_tffm_tpu.config import Config
+    from fast_tffm_tpu.training import dist_train
+
+    rng = np.random.default_rng(61)
+    src = tmp_path / "t.libsvm"
+    with open(src, "w") as f:
+        for _ in range(96):
+            nnz = rng.integers(1, 6)
+            toks = [
+                f"{rng.integers(0, V)}:{round(float(rng.normal()), 4)}"
+                for _ in range(nnz)
+            ]
+            f.write(f"{rng.integers(0, 2)} {' '.join(toks)}\n")
+
+    def run(tag, **kw):
+        cfg = Config(
+            model="fm", factor_num=4, vocabulary_size=V,
+            model_file=str(tmp_path / f"m_{tag}.npz"),
+            train_files=(str(src),),
+            epoch_num=2, batch_size=32, learning_rate=0.1, log_every=1,
+            metrics_path=str(tmp_path / f"jl_{tag}.jsonl"),
+            row_parallel=4, data_parallel=2, **kw,
+        ).validate()
+        dist_train(cfg, log=lambda *_: None)
+        losses = [
+            r["loss"]
+            for r in map(json.loads, open(cfg.metrics_path).read().splitlines())
+            if "loss" in r
+        ]
+        return cfg, losses
+
+    cfg_r, l_r = run("row", adagrad_accumulator="row")
+    cfg_f, l_f = run("fused", table_layout="packed",
+                     adagrad_accumulator="fused", packed_compact_cap=64)
+    np.testing.assert_allclose(l_f, l_r, rtol=1e-5)
+    tr = np.load(cfg_r.model_file)["table"][:V]
+    tf = np.load(cfg_f.model_file)["table"][:V]
+    np.testing.assert_allclose(tf, tr, rtol=1e-5, atol=1e-7)
+    # Resume continues from the fused checkpoint without error.
+    dist_train(cfg_f, resume=True, log=lambda *_: None)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs the 8-device CPU mesh")
 def test_sharded_packed_row_accumulator_matches_rows():
     """packed + row accumulator through the MESH-SHARDED step tracks the
     rows-layout row-accumulator sharded step, and the [VPs, P] shard
