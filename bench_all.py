@@ -270,6 +270,14 @@ def main():
         knee_batch=32768,
     )
     guard(bench_local,
+        "cfg3pb: train ex/s/chip (cfg3p + bfloat16 interaction einsums, "
+        "f32 accumulate; quality row stays f32 — PROBE_FFM_r05 +14%)",
+        FFMModel(vocabulary_size=1 << 20, num_fields=22, factor_num=4,
+                 compute_dtype="bfloat16"),
+        8192, 22, 1 << 20, num_fields=22, lr=0.05, layout="packed",
+        knee_batch=32768,
+    )
+    guard(bench_local,
         "cfg4p: train ex/s/chip (cfg4 DeepFM bf16 + table_layout=packed)",
         DeepFMModel(
             vocabulary_size=1 << 20, num_fields=39, factor_num=8, compute_dtype="bfloat16"

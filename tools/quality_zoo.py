@@ -175,6 +175,8 @@ def main(argv=None) -> int:
     ap.add_argument("--epochs", type=int, default=6)
     ap.add_argument("--quick", action="store_true",
                     help="tiny shapes for a smoke run")
+    ap.add_argument("--families", default="ffm,fm3,deepfm",
+                    help="comma list: ffm,fm3,deepfm (skip the rest)")
     ap.add_argument("--out", default=os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "QUALITY_ZOO_r05.json"))
@@ -195,74 +197,78 @@ def main(argv=None) -> int:
         "fmbase": (args.epochs, 0.1),
     }
 
+    wanted = set(args.families.split(","))
     res = {"rows": args.rows, "test_rows": args.test_rows,
            "base_epochs": args.epochs,
            "vocab": VOCAB, "k": K, "families": {}}
     with tempfile.TemporaryDirectory() as tmp:
-        # --- FFM (config #3): 8 fields keeps the planted pair tensor sane.
-        F = 8
-        tr, _, _ = _gen_split(tmp, "ffm_tr",
-                              lambda i, v: planted_ffm_score(i, v, F),
-                              F, args.rows, 10, "libffm")
-        te, te_labels, te_score = _gen_split(
-            tmp, "ffm_te", lambda i, v: planted_ffm_score(i, v, F),
-            F, args.test_rows, 11, "libffm")
-        # Interaction-only signal trains slowly from the small factor init
-        # (products of two ~0.01 factors barely move early Adagrad steps);
-        # a hotter lr + more passes close most of the optimization gap,
-        # and the per-epoch max of validation AUC keeps the best point.
-        ep, lr = budget["ffm"]
-        learned = _train(tmp, "ffm", tr, te, model="ffm", fields=F,
-                         epochs=ep, lr=lr)
-        res["families"]["ffm"] = {
-            "heldout_auc": round(float(learned), 5),
-            "oracle_auc": round(float(auc(te_labels, te_score)), 5),
-            "epochs": ep, "lr": lr,
-        }
-        print("ffm ->", res["families"]["ffm"], flush=True)
+        if "ffm" in wanted:
+            # --- FFM (config #3): 8 fields keeps the planted pair tensor sane.
+            F = 8
+            tr, _, _ = _gen_split(tmp, "ffm_tr",
+                                  lambda i, v: planted_ffm_score(i, v, F),
+                                  F, args.rows, 10, "libffm")
+            te, te_labels, te_score = _gen_split(
+                tmp, "ffm_te", lambda i, v: planted_ffm_score(i, v, F),
+                F, args.test_rows, 11, "libffm")
+            # Interaction-only signal trains slowly from the small factor init
+            # (products of two ~0.01 factors barely move early Adagrad steps);
+            # a hotter lr + more passes close most of the optimization gap,
+            # and the per-epoch max of validation AUC keeps the best point.
+            ep, lr = budget["ffm"]
+            learned = _train(tmp, "ffm", tr, te, model="ffm", fields=F,
+                             epochs=ep, lr=lr)
+            res["families"]["ffm"] = {
+                "heldout_auc": round(float(learned), 5),
+                "oracle_auc": round(float(auc(te_labels, te_score)), 5),
+                "epochs": ep, "lr": lr,
+            }
+            print("ffm ->", res["families"]["ffm"], flush=True)
 
-        # --- order-3 FM (config #5).
-        F = 12
-        tr, _, _ = _gen_split(tmp, "fm3_tr", planted_fm3_score, F, args.rows,
-                              20, "libsvm")
-        te, te_labels, te_score = _gen_split(
-            tmp, "fm3_te", planted_fm3_score, F, args.test_rows, 21, "libsvm")
-        ep, lr = budget["fm3"]
-        learned = _train(tmp, "fm3", tr, te, model="fm", fields=0,
-                         epochs=ep, order=3, lr=lr)
-        res["families"]["fm3"] = {
-            "heldout_auc": round(float(learned), 5),
-            "oracle_auc": round(float(auc(te_labels, te_score)), 5),
-            "epochs": ep, "lr": lr,
-        }
-        print("fm3 ->", res["families"]["fm3"], flush=True)
+        if "fm3" in wanted:
+            # --- order-3 FM (config #5).
+            F = 12
+            tr, _, _ = _gen_split(tmp, "fm3_tr", planted_fm3_score, F, args.rows,
+                                  20, "libsvm")
+            te, te_labels, te_score = _gen_split(
+                tmp, "fm3_te", planted_fm3_score, F, args.test_rows, 21, "libsvm")
+            ep, lr = budget["fm3"]
+            learned = _train(tmp, "fm3", tr, te, model="fm", fields=0,
+                             epochs=ep, order=3, lr=lr)
+            res["families"]["fm3"] = {
+                "heldout_auc": round(float(learned), 5),
+                "oracle_auc": round(float(auc(te_labels, te_score)), 5),
+                "epochs": ep, "lr": lr,
+            }
+            print("fm3 ->", res["families"]["fm3"], flush=True)
 
-        # --- DeepFM (config #4) vs plain FM on nonlinear planted signal.
-        F = 12
-        tr, _, _ = _gen_split(tmp, "deep_tr", planted_deep_score, F, args.rows,
-                              30, "libsvm")
-        te, te_labels, te_score = _gen_split(
-            tmp, "deep_te", planted_deep_score, F, args.test_rows, 31, "libsvm")
-        # The MLP head needs more passes than the embeddings to fit the
-        # planted nonlinearity (the quick smoke shows it under-trained at
-        # equal epochs), so DeepFM gets extra epochs; the FM baseline
-        # keeps the common budget (more epochs do not help a model class
-        # that cannot represent the signal).
-        ep, lr = budget["deepfm"]
-        bep, blr = budget["fmbase"]
-        deep = _train(tmp, "deepfm", tr, te, model="deepfm", fields=F,
-                      epochs=ep, hidden=(64, 32), lr=lr)
-        plain = _train(tmp, "fmbase", tr, te, model="fm", fields=0,
-                       epochs=bep, lr=blr)
-        res["families"]["deepfm"] = {
-            "heldout_auc": round(float(deep), 5),
-            "fm_baseline_auc": round(float(plain), 5),
-            "oracle_auc": round(float(auc(te_labels, te_score)), 5),
-            "lift_over_fm": round(float(deep - plain), 5),
-            "epochs": ep, "lr": lr,
-            "fm_baseline_epochs": bep, "fm_baseline_lr": blr,
-        }
-        print("deepfm ->", res["families"]["deepfm"], flush=True)
+        if "deepfm" in wanted:
+            # --- DeepFM (config #4) vs plain FM on nonlinear planted signal.
+            F = 12
+            tr, _, _ = _gen_split(tmp, "deep_tr", planted_deep_score, F, args.rows,
+                                  30, "libsvm")
+            te, te_labels, te_score = _gen_split(
+                tmp, "deep_te", planted_deep_score, F, args.test_rows, 31, "libsvm")
+            # The MLP head needs more passes than the embeddings to fit the
+            # planted nonlinearity (the quick smoke shows it under-trained at
+            # equal epochs), so DeepFM gets extra epochs; the FM baseline
+            # keeps the common budget (more epochs do not help a model class
+            # that cannot represent the signal).
+            ep, lr = budget["deepfm"]
+            bep, blr = budget["fmbase"]
+            deep = _train(tmp, "deepfm", tr, te, model="deepfm", fields=F,
+                          epochs=ep, hidden=(64, 32), lr=lr)
+            plain = _train(tmp, "fmbase", tr, te, model="fm", fields=0,
+                           epochs=bep, lr=blr)
+            res["families"]["deepfm"] = {
+                "heldout_auc": round(float(deep), 5),
+                "fm_baseline_auc": round(float(plain), 5),
+                "oracle_auc": round(float(auc(te_labels, te_score)), 5),
+                "lift_over_fm": round(float(deep - plain), 5),
+                "epochs": ep, "lr": lr,
+                "fm_baseline_epochs": bep, "fm_baseline_lr": blr,
+            }
+            print("deepfm ->", res["families"]["deepfm"], flush=True)
 
     with open(args.out, "w") as f:
         json.dump(res, f, indent=1)
