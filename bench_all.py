@@ -615,6 +615,7 @@ def bench_quality_zoo():
     this section only REPORTS it, so a sweep without the artifact simply
     omits the rows rather than re-paying the training time."""
     import json as _json
+    import os
 
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "QUALITY_ZOO_r05.json")
