@@ -704,3 +704,17 @@ FUSED_UPDATE_FNS = {
     "dense": fused_dense_adagrad_update,
     "compact": fused_compact_adagrad_update,
 }
+
+
+def apply_fused_update(
+    fused: jax.Array, ids: jax.Array, row_grads: jax.Array, lr: float,
+    mode: str, k_cap: int = 0,
+) -> jax.Array:
+    """The ONE fused-tail dispatch (mode -> dense | compact with its cap).
+    Every fused apply site (local trainer, allgather shard update, routed
+    alltoall update) calls this, so the tails cannot silently diverge."""
+    if mode == "compact":
+        return fused_compact_adagrad_update(fused, ids, row_grads, lr, k_cap)
+    if mode != "dense":
+        raise ValueError(f"unknown fused update mode {mode!r} (dense | compact)")
+    return fused_dense_adagrad_update(fused, ids, row_grads, lr)

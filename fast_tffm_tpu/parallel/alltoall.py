@@ -105,15 +105,17 @@ def routed_gather(
     *,
     d: int | None = None,
     shard_logical_rows: int | None = None,
+    fused: bool = False,
 ) -> jnp.ndarray:
     """Assemble this chip's rows via all-to-all id routing.
 
     table_shard: [V/R, D] contiguous row shard — or, when ``d`` is given,
                  a lane-packed [VPs, 128] shard (ops/packed_table.py) of
-                 ``shard_logical_rows`` logical rows.  The routing math is
-                 identical either way (ids are LOGICAL everywhere); only
-                 the local serve step reads the packed layout, via a wide
-                 full-tile-row gather instead of a narrow one.
+                 ``shard_logical_rows`` logical rows (``fused=True``: the
+                 fused tile-row layout, accumulator lanes in-slot).  The
+                 routing math is identical either way (ids are LOGICAL
+                 everywhere); only the local serve step reads the layout,
+                 via a wide full-tile-row gather instead of a narrow one.
     ids:         [B_local, N] global row ids for THIS chip's micro-batch.
     capacity:    static per-destination slot count (see capacity_for).
     Returns:     [B_local, N, D] rows (NaN-poisoned if any destination
@@ -142,9 +144,9 @@ def routed_gather(
     ok = (local >= 0) & (local < shard_rows)  # sentinels fail
     safe = jnp.where(ok, local, 0)
     if packed:
-        from fast_tffm_tpu.ops.packed_table import packed_gather
+        from fast_tffm_tpu.ops.packed_table import fused_gather, packed_gather
 
-        served = packed_gather(table_shard, safe, d)
+        served = (fused_gather if fused else packed_gather)(table_shard, safe, d)
     else:
         served = table_shard[safe]
     served = served * ok[..., None].astype(served.dtype)
@@ -170,12 +172,16 @@ def routed_update(
     *,
     shard_logical_rows: int | None = None,
     packed_mode: str | None = None,
+    fused: bool = False,
+    compact_cap: int = 0,
 ):
     """Sparse Adagrad update via routed gradients (the all-to-all analog of
     ``embedding.sharded_sparse_adagrad_update``).
 
     When ``shard_logical_rows`` is given the shards are LANE-PACKED
-    ([VPs, 128] — ops/packed_table.py) and ``packed_mode`` picks the
+    ([VPs, 128] — ops/packed_table.py; ``fused=True``: the fused tile-row
+    layout, whose apply is table-only and returns ``accum_shard``
+    untouched) and ``packed_mode`` picks the
     packed tail ('dense' | 'compact' | 'sorted'); the routing is unchanged
     (deduped logical ids + summed grads ride the same all_to_all), only
     the final per-shard apply reads/writes the packed layout.
@@ -197,11 +203,21 @@ def routed_update(
     from fast_tffm_tpu.optim import dedup_rows
 
     packed = shard_logical_rows is not None
-    if packed and packed_mode not in ("dense", "compact", "sorted"):
+    if packed and not fused and packed_mode not in ("dense", "compact", "sorted"):
         raise ValueError(
             f"packed routed_update needs packed_mode 'dense', 'compact' or "
             f"'sorted', got {packed_mode!r} (pass resolve_packed_update's result)"
         )
+    if fused and packed_mode not in ("dense", "compact"):
+        raise ValueError(
+            f"fused routed_update needs packed_mode 'dense' or 'compact', "
+            f"got {packed_mode!r} (pass resolve_fused_update's result)"
+        )
+    if fused and shard_logical_rows is None:
+        # Without the logical shard size the routing would divide by the
+        # PHYSICAL fused row count and send ids to the wrong shards —
+        # wrong-but-finite results, so refuse loudly instead.
+        raise ValueError("fused routed_update requires shard_logical_rows")
     D = row_grads.shape[-1]
     shard_rows = shard_logical_rows if packed else table_shard.shape[0]
     base = lax.axis_index(ROW_AXIS) * shard_rows
@@ -228,7 +244,19 @@ def routed_update(
     all_g = lax.all_gather(recv_g.reshape(-1, D), DATA_AXIS, tiled=True)
     guids, ggsum = dedup_rows(all_ids, all_g, num_rows_global)
 
-    if packed:
+    if fused:
+        from fast_tffm_tpu.ops.packed_table import (
+            apply_fused_update,
+            fused_rows_per_tile,
+        )
+        from fast_tffm_tpu.parallel.embedding import owned_local_ids
+
+        p = fused_rows_per_tile(D)
+        local, _ = owned_local_ids(guids, shard_rows, table_shard.shape[0] * p)
+        table_shard = apply_fused_update(
+            table_shard, local, ggsum, lr, packed_mode, compact_cap
+        )
+    elif packed:
         from fast_tffm_tpu.ops.packed_table import PACKED_UPDATE_FNS, rows_per_tile
         from fast_tffm_tpu.parallel.embedding import owned_local_ids
 

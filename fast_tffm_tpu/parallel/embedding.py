@@ -307,8 +307,7 @@ def fused_sharded_update(
     compact; compact honors ``k_cap``).  Unowned ids map past the last
     physical row and drop."""
     from fast_tffm_tpu.ops.packed_table import (
-        fused_compact_adagrad_update,
-        fused_dense_adagrad_update,
+        apply_fused_update,
         fused_rows_per_tile,
     )
 
@@ -316,9 +315,7 @@ def fused_sharded_update(
     p = fused_rows_per_tile(D)
 
     def apply(shard, local_ids, g):
-        if mode == "compact":
-            return fused_compact_adagrad_update(shard, local_ids, g, lr, k_cap)
-        return fused_dense_adagrad_update(shard, local_ids, g, lr)
+        return apply_fused_update(shard, local_ids, g, lr, mode, k_cap)
 
     flat_ids = ids.reshape(-1)
     flat_g = row_grads.reshape(-1, D)

@@ -347,13 +347,14 @@ def pack_sharded_on_device(
 
 def _make_gather(
     mesh: Mesh, local_ids_shape, lookup: str, capacity_factor: float,
-    packed_meta=None,
+    packed_meta=None, fused: bool = False,
 ):
     """Pick the lookup collective: all-gather (default) or all-to-all routing.
 
     ``local_ids_shape`` is the PER-CHIP [B_local, N] shape (this is called
     from inside the shard_map body at trace time).  ``packed_meta`` is
-    ``(d_row, shard_logical_rows)`` when the shards are lane-packed —
+    ``(d_row, shard_logical_rows)`` when the shards are lane-packed
+    (``fused``: the fused tile-row layout) —
     routing is identical, only the local serve reads the packed layout.
     Returns ``(gather_fn, capacity, can_overflow)`` — capacity is None on
     the all-gather path and is THE single sizing both all-to-all
@@ -364,12 +365,14 @@ def _make_gather(
     dual-compile)."""
     if lookup == "allgather":
         if packed_meta is not None:
-            from fast_tffm_tpu.parallel.embedding import packed_sharded_gather
+            from fast_tffm_tpu.parallel.embedding import (
+                fused_sharded_gather,
+                packed_sharded_gather,
+            )
 
             d_row, slr = packed_meta
-            return (
-                lambda table, ids: packed_sharded_gather(table, ids, d_row, slr)
-            ), None, False
+            g = fused_sharded_gather if fused else packed_sharded_gather
+            return (lambda table, ids: g(table, ids, d_row, slr)), None, False
         return sharded_gather, None, False
     if lookup != "alltoall":
         raise ValueError(f"unknown lookup {lookup!r} (allgather | alltoall)")
@@ -382,7 +385,7 @@ def _make_gather(
         d_row, slr = packed_meta
         return (
             lambda table, ids: routed_gather(
-                table, ids, cap, d=d_row, shard_logical_rows=slr
+                table, ids, cap, d=d_row, shard_logical_rows=slr, fused=fused
             )
         ), cap, cap < m
     return (lambda table, ids: routed_gather(table, ids, cap)), cap, cap < m
@@ -423,15 +426,6 @@ def make_sharded_train_step(
     fused = accumulator == "fused"
     if fused and not packed:
         raise ValueError("accumulator='fused' requires table_layout='packed'")
-    if fused and lookup == "alltoall":
-        # The routed serve/apply paths read the packed layout; the fused
-        # stride-(D+1) variant is not plumbed through them (yet).  Row
-        # mode gives the same semantics on the routed path.
-        raise ValueError(
-            "accumulator='fused' supports lookup='allgather' only; use "
-            "adagrad_accumulator=row with lookup=alltoall (same "
-            "row-granularity semantics)"
-        )
     if packed:
         model, shard_logical_rows, _ = packed_shard_meta(model, mesh, fused=fused)
     else:
@@ -449,7 +443,8 @@ def make_sharded_train_step(
         # shape (a cached closure would pin a stale capacity across jit
         # retraces with bigger batches and spuriously overflow).
         gather, cap, can_overflow = _make_gather(
-            mesh, batch.ids.shape, lookup, capacity_factor, packed_meta
+            mesh, batch.ids.shape, lookup, capacity_factor, packed_meta,
+            fused=fused,
         )
 
         def loss_fn(rows, dense):
@@ -523,7 +518,17 @@ def make_sharded_train_step(
             def routed_branch():
                 rows = gather(table, batch.ids)
                 (_, dl), (g_rows, g_dense) = grad_fn(rows, dense)
-                if packed:
+                if fused:
+                    from fast_tffm_tpu.ops.packed_table import resolve_fused_update
+
+                    fmode = resolve_fused_update(packed_update, table.shape[0])
+                    t2, a2, overflow = routed_update(
+                        table, accum, batch.ids, g_rows, learning_rate,
+                        num_rows_global, cap,
+                        shard_logical_rows=shard_logical_rows, packed_mode=fmode,
+                        fused=True, compact_cap=compact_cap,
+                    )
+                elif packed:
                     from fast_tffm_tpu.ops.packed_table import resolve_packed_update
 
                     pmode = resolve_packed_update(
@@ -615,14 +620,9 @@ def make_sharded_predict_step(
     batch's lookup through the allgather collective instead of NaN-ing the
     scores — same ``lax.cond`` scheme as the train step.
     ``accumulator='fused'`` reads the fused tile-row table (the state a
-    fused dist_train holds mid-run; allgather lookup only)."""
+    fused dist_train holds mid-run); _make_gather routes both lookups."""
     packed = table_layout == "packed"
     fused = accumulator == "fused"
-    if fused and lookup == "alltoall":
-        raise ValueError(
-            "accumulator='fused' supports lookup='allgather' only "
-            "(make_sharded_train_step rationale)"
-        )
     if packed:
         model, shard_logical_rows, _ = packed_shard_meta(model, mesh, fused=fused)
     else:
@@ -633,14 +633,9 @@ def make_sharded_predict_step(
     packed_meta = (d_row, shard_logical_rows) if packed else None
 
     def shard_body(table, dense, batch: Batch):
-        if fused:
-            from fast_tffm_tpu.parallel.embedding import fused_sharded_gather
-
-            rows = fused_sharded_gather(table, batch.ids, d_row, shard_logical_rows)
-            scores = jax.nn.sigmoid(model.score(rows, dense, batch))
-            return lax.all_gather(scores, _BOTH, tiled=True)
         gather, cap, can_overflow = _make_gather(
-            mesh, batch.ids.shape, lookup, capacity_factor, packed_meta
+            mesh, batch.ids.shape, lookup, capacity_factor, packed_meta,
+            fused=fused,
         )
         if fallback and can_overflow:
             from fast_tffm_tpu.parallel.alltoall import routing_overflow
@@ -648,7 +643,8 @@ def make_sharded_predict_step(
             # The allgather fallback is exactly _make_gather's allgather
             # selection (packed-aware) — build it there, not by hand.
             ag_gather, _, _ = _make_gather(
-                mesh, batch.ids.shape, "allgather", capacity_factor, packed_meta
+                mesh, batch.ids.shape, "allgather", capacity_factor, packed_meta,
+                fused=fused,
             )
             rows = lax.cond(
                 routing_overflow(batch.ids, shard_logical_rows, cap),

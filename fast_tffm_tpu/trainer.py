@@ -227,18 +227,12 @@ def packed_train_step_body(
     (_, data_loss), (g_rows, g_dense) = grad_fn(rows, state.dense, batch)
 
     if fused:
-        from fast_tffm_tpu.ops.packed_table import fused_compact_adagrad_update
+        from fast_tffm_tpu.ops.packed_table import apply_fused_update
 
         mode = resolve_fused_update(update, state.table.shape[0])
-        if mode == "compact":
-            table = fused_compact_adagrad_update(
-                state.table, batch.ids, g_rows, learning_rate,
-                k_cap=compact_cap,
-            )
-        else:
-            table = FUSED_UPDATE_FNS[mode](
-                state.table, batch.ids, g_rows, learning_rate
-            )
+        table = apply_fused_update(
+            state.table, batch.ids, g_rows, learning_rate, mode, compact_cap
+        )
         accum = acc
     else:
         mode = resolve_packed_update(update, state.table.shape[0], acc.shape[-1])

@@ -578,14 +578,6 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
             f"{len(cfg.train_files)} train_files (they align per-file)"
         )
     maybe_initialize_distributed(cfg.coordinator_address, cfg.num_processes, cfg.process_id)
-    if cfg.adagrad_accumulator == "fused" and cfg.lookup == "alltoall":
-        # The routed serve/apply paths read the separate-accumulator
-        # packed layout; row mode gives the same semantics there.
-        raise ValueError(
-            "adagrad_accumulator = fused supports lookup = allgather only; "
-            "use adagrad_accumulator = row with lookup = alltoall (same "
-            "row-granularity semantics)"
-        )
     if cfg.device_cache and cfg.shuffle:
         # A shuffled gather across the mesh-sharded batch dim would move
         # rows between chips every step — exactly the per-step traffic

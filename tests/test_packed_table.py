@@ -769,6 +769,58 @@ def test_sharded_fused_matches_sharded_row_mode(mesh_shape):
 
 
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs the 8-device CPU mesh")
+def test_sharded_fused_alltoall_matches_allgather():
+    """fused + lookup=alltoall (round-5 completion of the composability
+    matrix): the routed fused step tracks the allgather fused step — and
+    hence row mode — and the routed fused predict matches."""
+    from fast_tffm_tpu.parallel import (
+        init_sharded_state,
+        make_mesh,
+        make_sharded_predict_step,
+        make_sharded_train_step,
+    )
+
+    model = FMModel(vocabulary_size=V, factor_num=4, order=2)
+    mesh = make_mesh(2, 4)
+    rng = np.random.default_rng(62)
+    batches = _batches(rng, n=3)
+
+    ag = init_sharded_state(
+        model, mesh, jax.random.key(15), accumulator="fused", table_layout="packed"
+    )
+    ag_step = make_sharded_train_step(
+        model, 0.1, mesh, table_layout="packed", accumulator="fused",
+        compact_cap=48, packed_update="compact",
+    )
+    aa = init_sharded_state(
+        model, mesh, jax.random.key(15), accumulator="fused", table_layout="packed"
+    )
+    aa_step = make_sharded_train_step(
+        model, 0.1, mesh, lookup="alltoall", table_layout="packed",
+        accumulator="fused", compact_cap=48, packed_update="compact",
+    )
+    for b in batches:
+        ag, ag_loss = ag_step(ag, b)
+        aa, aa_loss = aa_step(aa, b)
+        np.testing.assert_allclose(float(aa_loss), float(ag_loss), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(aa.table), np.asarray(ag.table), rtol=1e-5, atol=1e-7
+    )
+
+    ag_pred = make_sharded_predict_step(
+        model, mesh, table_layout="packed", accumulator="fused"
+    )
+    aa_pred = make_sharded_predict_step(
+        model, mesh, lookup="alltoall", table_layout="packed", accumulator="fused"
+    )
+    np.testing.assert_allclose(
+        np.asarray(aa_pred(aa, batches[0])),
+        np.asarray(ag_pred(ag, batches[0])),
+        rtol=1e-5,
+    )
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs the 8-device CPU mesh")
 def test_dist_train_fused_driver(tmp_path):
     """dist_train with adagrad_accumulator=fused: trains over the mesh,
     saves the LOGICAL checkpoint, resumes, and the checkpoint matches a
