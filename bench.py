@@ -157,15 +157,21 @@ def modeled_fused_step_bytes(ids_batches, d, vocab, cap, batch_scale=1):
     prefix sum, and the 2-op RMW over the capped row buffer.  Mean unique
     PHYSICAL rows come from the actual batches.  ``batch_scale`` scales
     the M-proportional parts when the measured batch is a multiple of the
-    modeled batches' size (the VP-proportional bitmap does not scale)."""
+    modeled batches' size (the VP-proportional bitmap does not scale).
+    NOTE the uniques term makes the "floor" APPROXIMATE when
+    ``batch_scale > 1``: per-batch unique counts scale sub-linearly (the
+    unions of B-batch uniques overlap), so the scaled ``uniq_phys`` is an
+    upper bound on the true unique count and the modeled RMW bytes — and
+    hence ``implied_gbps`` — can slightly overstate the floor at scaled
+    batches (ADVICE r5)."""
     p = 128 // (d + 1)
     vpf = -(-vocab // p)
     m = ids_batches[0].shape[0] * ids_batches[0].shape[1] * batch_scale
     uniq = float(np.mean([np.unique(np.asarray(b)).size for b in ids_batches]))
     uniq_phys = float(
         np.mean([np.unique(np.asarray(b) // p).size for b in ids_batches])
-    ) * batch_scale  # upper bound: unions overlap, but this is a floor model
-    k_rows = min(cap if cap > 0 else m, min(vpf, m), int(uniq_phys * 1.0) or m)
+    ) * batch_scale  # upper bound: unions overlap (see docstring note)
+    k_rows = min(cap if cap > 0 else m, min(vpf, m), int(uniq_phys) or m)
     row_b = 128 * 4
     parts = {
         "ids_read": m * 4,
@@ -781,6 +787,44 @@ def main():
         dc_state, dc_rate = measure(cached_step, dc_state, _IdxBatches(), iters=20)
         results["device_cached_value"] = round(dc_rate, 1)
         results["device_cached_mib"] = round(data.nbytes / 2**20, 1)
+        # --- steps_per_call lever: K fused steps per dispatch (lax.scan
+        #     over K resident batch slices — the tentpole of the dispatch-
+        #     overhead fix).  K=1 is the per-dispatch number just measured;
+        #     each K>1 rung re-measures the SAME step body scanned, so the
+        #     ratio isolates pure dispatch/latency amortization.  Honest
+        #     timing: only full-K index chunks (the remainder executable is
+        #     excluded from the window), same value-synced measure(). ---
+        try:
+            from fast_tffm_tpu.data.device_cache import (
+                epoch_index_chunks,
+                make_cached_scan_train_step,
+            )
+
+            ks = [
+                k
+                for k in (
+                    int(x)
+                    for x in os.environ.get("BENCH_STEPS_PER_CALL", "8").split(",")
+                    if x.strip()
+                )
+                if k > 1
+            ]
+            spc = {"1": round(dc_rate, 1)}
+            stepk, _ = make_cached_scan_train_step(model, 0.01, data)
+            for kk in ks:
+                chunks = [
+                    c for c in epoch_index_chunks(data.batches, kk) if len(c) == kk
+                ]
+                dc_state, k_rate = measure(
+                    stepk, dc_state, chunks, iters=max(4, 24 // kk),
+                    batch_size=BATCH * kk,
+                )
+                spc[str(kk)] = round(k_rate, 1)
+            results["steps_per_call_values"] = spc
+            if "8" in spc:
+                results["steps_per_call_k8_over_k1"] = round(spc["8"] / spc["1"], 3)
+        except Exception as e:
+            results["steps_per_call_error"] = str(e)[:120]
         del data, cached_step, idx, dc_state
     except Exception as e:
         results["device_cached_value"] = None

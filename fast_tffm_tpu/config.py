@@ -69,6 +69,14 @@ class Config:
     #   slice batches on-chip — zero per-step host→device bytes; dist_train
     #   shards the resident arrays over the mesh, per-process assembly
     #   multi-host (no shuffle on dist)
+    steps_per_call: int = 1  # fuse K train steps into ONE jitted dispatch
+    #   (lax.scan over K micro-batches).  1 = one dispatch per batch (the
+    #   classic loop); K>1 amortizes per-step dispatch/H2D overhead on every
+    #   path: streamed input ships [K, B, ...] superbatches (one transfer
+    #   per K steps), device_cache scans K resident batch slices with zero
+    #   host involvement in between, dist_train scans around the SPMD body.
+    #   Per-step losses keep full granularity; stop/checkpoint boundaries
+    #   become K-step-aligned (DESIGN.md "Step fusion").
     queue_size: int = 8  # prefetch depth
     log_every: int = 100
     save_every_epochs: int = 1
@@ -114,6 +122,10 @@ class Config:
         if self.lookup_overflow not in ("fallback", "abort"):
             raise ValueError(
                 f"unknown lookup_overflow {self.lookup_overflow!r} (fallback | abort)"
+            )
+        if self.steps_per_call < 1:
+            raise ValueError(
+                f"steps_per_call must be >= 1, got {self.steps_per_call}"
             )
         if self.thread_num < 0:
             raise ValueError(
@@ -273,6 +285,7 @@ def load_config(path: str) -> Config:
     cfg.shuffle = get(t, "shuffle", ini._convert_to_boolean, cfg.shuffle)
     cfg.shuffle_seed = get(t, "shuffle_seed", int, cfg.shuffle_seed)
     cfg.device_cache = get(t, "device_cache", ini._convert_to_boolean, cfg.device_cache)
+    cfg.steps_per_call = get(t, "steps_per_call", int, cfg.steps_per_call)
     cfg.queue_size = get(t, "queue_size", int, cfg.queue_size)
     cfg.log_every = get(t, "log_every", int, cfg.log_every)
     cfg.save_every_epochs = get(t, "save_every_epochs", int, cfg.save_every_epochs)

@@ -43,6 +43,8 @@ __all__ = [
     "epoch_permutation",
     "full_epoch_perm",
     "make_cached_train_step",
+    "make_cached_scan_train_step",
+    "epoch_index_chunks",
 ]
 
 
@@ -249,6 +251,63 @@ def make_cached_train_step(model, learning_rate: float, data: DeviceDataset, bod
     return step, step_shuffled
 
 
+def epoch_index_chunks(batches: int, k: int):
+    """Pre-placed device index vectors for one scan-fused epoch: [K]-long
+    chunks of the batch indices, plus one [batches % K] remainder — the
+    per-call "input" of the scanned cached step.  Placed on device ONCE
+    (the same vectors serve every epoch), so an epoch is ``ceil(batches/K)``
+    dispatches with zero host involvement in between.  At most two distinct
+    lengths exist (K and the remainder), so the scanned step compiles at
+    most twice."""
+    return [
+        jax.device_put(np.arange(lo, min(lo + k, batches), dtype=np.int32))
+        for lo in range(0, batches, k)
+    ]
+
+
+def make_cached_scan_train_step(model, learning_rate: float, data: DeviceDataset, body=None):
+    """Scan-fused twins of ``make_cached_train_step``'s steps: jitted
+    ``step(state, idxs [K]) -> (state, losses [K])`` running K consecutive
+    batch slices through ONE dispatch via ``lax.scan`` (and
+    ``step_shuffled(state, perm, idxs)`` gathering through the epoch
+    permutation).  The scan body applies the SAME ``body`` to the SAME
+    slices the per-step functions would, so K>1 is bit-identical to K
+    sequential calls (test-pinned).  K is read from ``idxs``' shape —
+    epoch_index_chunks' remainder vector reuses this function and compiles
+    its own (single) executable.  Resident arrays stay EXPLICIT jit
+    arguments (the embedded-constant cliff, DESIGN §6); the donated state
+    threads through the scan carry, so the table still updates in place.
+    """
+    B = data.batch_size
+    arrays = (data.labels, data.ids, data.vals, data.fields, data.weights)
+    body = body or train_step_body
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def _scan_step(state: TrainState, arrs, idxs):
+        def one(st, i):
+            sl = lambda a: lax.dynamic_slice_in_dim(a, i * B, B, axis=0)
+            return body(model, learning_rate, st, Batch(*map(sl, arrs)))
+
+        return lax.scan(one, state, idxs)
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def _scan_step_shuffled(state: TrainState, arrs, perm, idxs):
+        def one(st, i):
+            idx = lax.dynamic_slice_in_dim(perm, i * B, B)
+            b = Batch(*(jnp.take(a, idx, axis=0) for a in arrs))
+            return body(model, learning_rate, st, b)
+
+        return lax.scan(one, state, idxs)
+
+    def step(state, idxs):
+        return _scan_step(state, arrays, idxs)
+
+    def step_shuffled(state, perm, idxs):
+        return _scan_step_shuffled(state, arrays, perm, idxs)
+
+    return step, step_shuffled
+
+
 def load_sharded_device_dataset(
     files,
     *,
@@ -319,7 +378,10 @@ def load_sharded_device_dataset(
     )
 
 
-def make_cached_sharded_train_step(sharded_step, data: DeviceDataset):
+def make_cached_sharded_train_step(
+    sharded_step, data: DeviceDataset, steps_per_call: int = 1,
+    overflow_flagged: bool | None = None,
+):
     """Wrap a ``make_sharded_train_step`` step so each call slices batch
     ``i`` out of the mesh-sharded resident arrays on-device (sequential
     order only — a shuffled gather across the sharded batch dim would be
@@ -327,17 +389,62 @@ def make_cached_sharded_train_step(sharded_step, data: DeviceDataset):
 
     Same closure rule as the local cached step: resident arrays travel as
     explicit jit arguments (embedded-constant cliff, DESIGN §6).
+
+    ``steps_per_call`` > 1 returns the scan-fused form instead:
+    ``step(state, idxs [K]) -> (state, losses [K])`` runs K consecutive
+    resident batches through ONE dispatch, the SPMD body scanning on
+    device (epoch_index_chunks supplies the pre-placed index vectors,
+    remainder included).  An overflow-flagged sharded step (the alltoall
+    ``fallback`` 3-tuple) scans transparently: per-step losses stay [K]
+    and the per-step overflow flags SUM into one replicated int32 (the
+    driver only ever counts them, so K-granularity is not lost — the
+    count is exact).  ``overflow_flagged`` tells the scan whether the
+    wrapped step returns that 3-tuple; callers that built the step from
+    config (dist_train) pass it explicitly, and the default reads the
+    marker make_sharded_train_step sets on its return value.
     """
     from fast_tffm_tpu.models.base import Batch as _Batch
 
-    @partial(jax.jit, donate_argnums=(0,))
-    def _step(state, arrs, i):
-        sl = lambda a: lax.dynamic_slice_in_dim(a, i, 1, axis=0)[0]
-        return sharded_step(state, _Batch(*map(sl, arrs)))
-
     arrays = (data.labels, data.ids, data.vals, data.fields, data.weights)
 
-    def step(state, i):
-        return _step(state, arrays, i)
+    if steps_per_call <= 1:
 
-    return step
+        @partial(jax.jit, donate_argnums=(0,))
+        def _step(state, arrs, i):
+            sl = lambda a: lax.dynamic_slice_in_dim(a, i, 1, axis=0)[0]
+            return sharded_step(state, _Batch(*map(sl, arrs)))
+
+        def step(state, i):
+            return _step(state, arrays, i)
+
+        return step
+
+    # The scan must mirror the wrapped step's signature exactly.
+    flagged = (
+        bool(getattr(sharded_step, "overflow_flagged", False))
+        if overflow_flagged is None
+        else bool(overflow_flagged)
+    )
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def _scan_step(state, arrs, idxs):
+        def one(st, i):
+            sl = lambda a: lax.dynamic_slice_in_dim(a, i, 1, axis=0)[0]
+            out = sharded_step(st, _Batch(*map(sl, arrs)))
+            if flagged:
+                st, loss, ovf = out
+            else:
+                st, loss = out
+                ovf = jnp.zeros((), jnp.int32)
+            return st, (loss, ovf)
+
+        state, (losses, ovfs) = lax.scan(one, state, idxs)
+        return state, losses, jnp.sum(ovfs)
+
+    def step_k(state, idxs):
+        state, losses, ovf_sum = _scan_step(state, arrays, idxs)
+        if flagged:
+            return state, losses, ovf_sum
+        return state, losses
+
+    return step_k

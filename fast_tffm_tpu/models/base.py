@@ -58,6 +58,45 @@ class Batch:
             weights=jnp.asarray(w),
         )
 
+    @staticmethod
+    def stack_parsed(parsed_seq, weights_seq=None, *, with_fields: bool = True):
+        """K host ParsedBatches → ONE device superbatch [K, B, ...].
+
+        The step-fusion staging path (``steps_per_call`` > 1): the K
+        batches are stacked on the HOST first, so each field crosses the
+        host→device link once per K steps instead of once per step — the
+        transfer analog of the scan's one-dispatch-per-K.  Fields follow
+        ``from_parsed``'s skipping rule ([K, B, 0] when unused).  The
+        scanned train step (trainer.make_scanned_train_step) slices
+        micro-batch k back out on device via ``lax.scan``.
+        """
+        import numpy as np
+
+        if weights_seq is None:
+            weights_seq = [None] * len(parsed_seq)
+        k = len(parsed_seq)
+        b = parsed_seq[0].labels.shape[0]
+        return Batch(
+            labels=jnp.asarray(np.stack([p.labels for p in parsed_seq])),
+            ids=jnp.asarray(
+                np.stack([p.ids.astype(np.int32, copy=False) for p in parsed_seq])
+            ),
+            vals=jnp.asarray(np.stack([p.vals for p in parsed_seq])),
+            fields=jnp.asarray(
+                np.stack([p.fields for p in parsed_seq])
+                if with_fields
+                else np.zeros((k, b, 0), np.int32)
+            ),
+            weights=jnp.asarray(
+                np.stack(
+                    [
+                        np.ones_like(p.labels) if w is None else np.asarray(w)
+                        for p, w in zip(parsed_seq, weights_seq)
+                    ]
+                )
+            ),
+        )
+
 
 class Model(Protocol):
     vocabulary_size: int

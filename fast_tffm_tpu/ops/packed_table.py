@@ -72,6 +72,8 @@ __all__ = [
     "fused_dense_adagrad_update",
     "fused_compact_adagrad_update",
     "resolve_fused_update",
+    "apply_fused_update",
+    "FUSED_UPDATE_FNS",
 ]
 
 LANES = 128

@@ -11,6 +11,7 @@ from fast_tffm_tpu.parallel.mesh import (  # noqa: F401
 from fast_tffm_tpu.parallel.train_step import (  # noqa: F401
     init_sharded_state,
     make_global_batch,
+    make_global_superbatch,
     make_sharded_predict_step,
     make_sharded_train_step,
     pack_sharded_on_device,

@@ -39,7 +39,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
-from fast_tffm_tpu.parallel.mesh import DATA_AXIS, ROW_AXIS
+from fast_tffm_tpu.parallel.mesh import DATA_AXIS, ROW_AXIS, axis_size
 
 __all__ = ["routed_gather", "routed_update", "routing_overflow", "capacity_for"]
 
@@ -54,7 +54,7 @@ def routing_overflow(ids: jnp.ndarray, shard_rows: int, capacity: int):
     chip agree — the caller can branch on it (lax.cond) without risking
     divergent collectives.
     """
-    R = lax.axis_size(ROW_AXIS)
+    R = axis_size(ROW_AXIS)
     counts = jnp.bincount(ids.reshape(-1) // shard_rows, length=R)
     local = jnp.any(counts > capacity)
     return lax.psum(local.astype(jnp.int32), (DATA_AXIS, ROW_AXIS)) > 0
@@ -124,7 +124,7 @@ def routed_gather(
     packed = d is not None
     shard_rows = shard_logical_rows if packed else table_shard.shape[0]
     base = lax.axis_index(ROW_AXIS) * shard_rows
-    R = lax.axis_size(ROW_AXIS)
+    R = axis_size(ROW_AXIS)
     B, N = ids.shape
     M = B * N
     flat = ids.reshape(M)
@@ -221,7 +221,7 @@ def routed_update(
     D = row_grads.shape[-1]
     shard_rows = shard_logical_rows if packed else table_shard.shape[0]
     base = lax.axis_index(ROW_AXIS) * shard_rows
-    R = lax.axis_size(ROW_AXIS)
+    R = axis_size(ROW_AXIS)
     uids, gsum = dedup_rows(ids.reshape(-1), row_grads.reshape(-1, D), num_rows_global)
     # Sentinel uids (== num_rows_global) route to owner R: excluded from
     # counts (bincount length R) and dropped by the out-of-range scatter.

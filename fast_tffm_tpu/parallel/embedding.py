@@ -31,7 +31,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from fast_tffm_tpu.optim import AdagradState, dedup_rows
-from fast_tffm_tpu.parallel.mesh import DATA_AXIS, ROW_AXIS
+from fast_tffm_tpu.parallel.mesh import DATA_AXIS, ROW_AXIS, axis_size
 
 __all__ = [
     "sharded_gather",
@@ -87,17 +87,20 @@ def sharded_gather(table_shard: jax.Array, ids: jax.Array) -> jax.Array:
     Returns:     [B_local, N, D] rows for this chip's ids.
     """
     shard_rows = table_shard.shape[0]
-    if lax.axis_size(ROW_AXIS) == 1:
-        # One row shard: every id is local, the gather/scatter collectives
-        # are identities and the owned masking is a full-true mask — skip
-        # them all (axis_size is static, so this is a trace-time branch;
-        # mesh>1 programs are unchanged).  Measured: the masking multiply
-        # + identity collectives cost ~40% of the mesh=1 step (VERDICT r4
-        # weak #3).  NOTE this assumes batch ids < padded vocab (the
-        # drivers guarantee it): an out-of-range id would CLAMP to the
-        # last row here (single-device gather semantics) where the
-        # mesh>1 path returns zeros for unowned ids.
-        return table_shard[ids]
+    if axis_size(ROW_AXIS) == 1:
+        # One row shard: every id is local and the gather/scatter
+        # collectives are identities — skip them (axis_size is static, so
+        # this is a trace-time branch; mesh>1 programs are unchanged).
+        # The in-range masking is KEPT: an out-of-range id would CLAMP to
+        # the last row under single-device gather semantics where the
+        # mesh>1 path returns zeros for unowned ids — a silent mesh=1 vs
+        # mesh>1 divergence.  Clamp-with-zero enforces the same id-range
+        # invariant on both (ADVICE r5); the identity collectives, the
+        # bulk of the measured mesh=1 overhead (VERDICT r4 weak #3), stay
+        # skipped.
+        in_range = (ids >= 0) & (ids < shard_rows)
+        rows = table_shard[jnp.where(in_range, ids, 0)]
+        return rows * in_range[..., None].astype(rows.dtype)
     base = lax.axis_index(ROW_AXIS) * shard_rows
     # Ids are int32 and tiny next to D-wide rows; gather all ROW peers' ids,
     # serve the rows we own, and reduce-scatter each peer its answers (each
@@ -128,7 +131,7 @@ def sharded_sparse_adagrad_update(
     SURVEY.md §4.2).
     """
     D = table_shard.shape[-1]
-    if lax.axis_size(ROW_AXIS) == 1 and lax.axis_size(DATA_AXIS) == 1:
+    if axis_size(ROW_AXIS) == 1 and axis_size(DATA_AXIS) == 1:
         # 1×1 mesh: no peers to combine with — one dedup, straight to the
         # shard apply (exactly the single-device step's structure).
         guids, ggsum = dedup_rows(
@@ -163,11 +166,13 @@ def packed_sharded_gather(
     """sharded_gather on a lane-packed shard: [B_local, N, D] rows."""
     from fast_tffm_tpu.ops.packed_table import packed_gather
 
-    if lax.axis_size(ROW_AXIS) == 1:
-        # One row shard: skip the identity collectives and the full-true
-        # owned masking (see sharded_gather — same in-range-id assumption:
-        # OOB ids clamp here instead of zeroing).
-        return packed_gather(packed_shard, ids, d)
+    if axis_size(ROW_AXIS) == 1:
+        # One row shard: skip the identity collectives, keep the in-range
+        # clamp-with-zero (see sharded_gather — without it OOB ids clamp
+        # here where the mesh>1 path zeroes them).
+        in_range = (ids >= 0) & (ids < shard_logical_rows)
+        rows = packed_gather(packed_shard, jnp.where(in_range, ids, 0), d)
+        return rows * in_range[..., None].astype(rows.dtype)
     all_ids = lax.all_gather(ids, ROW_AXIS, tiled=True)  # [R*B_local, N]
     local, owned = owned_local_ids(all_ids, shard_logical_rows, 0)
     rows = packed_gather(packed_shard, local, d)
@@ -197,7 +202,7 @@ def packed_sharded_update(
 
     D = row_grads.shape[-1]
     p = rows_per_tile(D)
-    if lax.axis_size(ROW_AXIS) == 1 and lax.axis_size(DATA_AXIS) == 1:
+    if axis_size(ROW_AXIS) == 1 and axis_size(DATA_AXIS) == 1:
         # 1×1 mesh: the packed update's lane-space segment-sum already
         # handles duplicate raw ids, so the local dedup + identity
         # collectives + owned mapping all vanish — this IS the
@@ -249,8 +254,8 @@ def packed_sharded_dense_update(
     update_fn = PACKED_UPDATE_FNS[mode]
     flat_ids = ids.reshape(-1)
     flat_g = row_grads.reshape(-1, D)
-    one_shard = lax.axis_size(ROW_AXIS) == 1
-    if one_shard and lax.axis_size(DATA_AXIS) == 1:
+    one_shard = axis_size(ROW_AXIS) == 1
+    if one_shard and axis_size(DATA_AXIS) == 1:
         # 1×1 mesh: no combine, no owned mapping (batch ids are already
         # in-range logical ids) — this IS the single-device packed step.
         return update_fn(packed_shard, accum_shard, flat_ids, flat_g, lr)
@@ -281,10 +286,12 @@ def fused_sharded_gather(
     """sharded_gather on a fused shard: [B_local, N, D] rows."""
     from fast_tffm_tpu.ops.packed_table import fused_gather
 
-    if lax.axis_size(ROW_AXIS) == 1:
-        # One row shard: skip identity collectives + full-true masking
-        # (sharded_gather's in-range-id note applies).
-        return fused_gather(fused_shard, ids, d)
+    if axis_size(ROW_AXIS) == 1:
+        # One row shard: skip identity collectives, keep the in-range
+        # clamp-with-zero (sharded_gather's mesh=1/mesh>1 invariant).
+        in_range = (ids >= 0) & (ids < shard_logical_rows)
+        rows = fused_gather(fused_shard, jnp.where(in_range, ids, 0), d)
+        return rows * in_range[..., None].astype(rows.dtype)
     all_ids = lax.all_gather(ids, ROW_AXIS, tiled=True)
     local, owned = owned_local_ids(all_ids, shard_logical_rows, 0)
     rows = fused_gather(fused_shard, local, d)
@@ -319,8 +326,8 @@ def fused_sharded_update(
 
     flat_ids = ids.reshape(-1)
     flat_g = row_grads.reshape(-1, D)
-    one_shard = lax.axis_size(ROW_AXIS) == 1
-    if one_shard and lax.axis_size(DATA_AXIS) == 1:
+    one_shard = axis_size(ROW_AXIS) == 1
+    if one_shard and axis_size(DATA_AXIS) == 1:
         return apply(fused_shard, flat_ids, flat_g)
     all_ids = lax.all_gather(flat_ids, (DATA_AXIS, ROW_AXIS), tiled=True)
     all_g = lax.all_gather(flat_g, (DATA_AXIS, ROW_AXIS), tiled=True)

@@ -27,7 +27,24 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+try:  # JAX >= 0.6: top-level export, replication check spelled check_vma
+    from jax import shard_map as _shard_map
+
+    _SHARD_MAP_CHECK_KW = "check_vma"
+except ImportError:  # older JAX: experimental module, kwarg spelled check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _SHARD_MAP_CHECK_KW = "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+    """shard_map across the JAX compat break: one callsite spelling
+    (``check_vma``), routed to whichever kwarg the installed JAX uses."""
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        **{_SHARD_MAP_CHECK_KW: check_vma},
+    )
 
 from fast_tffm_tpu.models.base import Batch
 from fast_tffm_tpu.optim import AdagradState, dense_adagrad_update
@@ -50,6 +67,7 @@ __all__ = [
     "make_sharded_train_step",
     "make_sharded_predict_step",
     "make_global_batch",
+    "make_global_superbatch",
 ]
 
 
@@ -81,6 +99,39 @@ def make_global_batch(mesh: Mesh, parsed, w, *, with_fields: bool = True) -> Bat
         vals=mk(mat, np.ascontiguousarray(parsed.vals)),
         fields=mk(mat, fields),
         weights=mk(vec, np.ascontiguousarray(w)),
+    )
+
+
+def make_global_superbatch(mesh: Mesh, parsed_seq, w_seq, *, with_fields: bool = True) -> Batch:
+    """make_global_batch for K stacked micro-batches: each process stacks
+    ITS local chunks of K consecutive global batches into [K, B_local, ...]
+    host arrays, then contributes them as its slice of the [K, B, ...]
+    global superbatch (batch dim 1 sharded over both mesh axes, micro-step
+    dim 0 unsharded — the scanned SPMD step slices dim 0 on device).  One
+    stitch per K steps is the multi-host analog of the local path's one
+    H2D per K steps."""
+    import numpy as np
+
+    vec = NamedSharding(mesh, P(None, _BOTH))
+    mat = NamedSharding(mesh, P(None, _BOTH, None))
+    mk = jax.make_array_from_process_local_data
+    b_local = parsed_seq[0].labels.shape[0]
+    fields = (
+        np.stack([np.asarray(p.fields) for p in parsed_seq])
+        if with_fields
+        else np.zeros((len(parsed_seq), b_local, 0), np.int32)
+    )
+    return Batch(
+        labels=mk(vec, np.stack([np.asarray(p.labels) for p in parsed_seq])),
+        ids=mk(
+            mat,
+            np.stack(
+                [p.ids.astype(np.int32, copy=False) for p in parsed_seq]
+            ),
+        ),
+        vals=mk(mat, np.stack([np.asarray(p.vals) for p in parsed_seq])),
+        fields=mk(mat, fields),
+        weights=mk(vec, np.stack([np.asarray(w) for w in w_seq])),
     )
 
 
@@ -396,8 +447,22 @@ def make_sharded_train_step(
     capacity_factor: float = 2.0, overflow_mode: str = "abort",
     table_layout: str = "rows", packed_update: str = "auto",
     accumulator: str = "element", compact_cap: int = 0,
+    steps_per_call: int = 1,
 ):
     """Returns jitted SPMD ``step(state, batch) -> (state, global mean loss)``.
+
+    ``steps_per_call`` > 1 returns the scan-fused form instead:
+    ``step(state, superbatch) -> (state, losses [K])`` where every
+    ``superbatch`` field carries a leading micro-step dim ([K, B], ...;
+    make_global_superbatch builds it) and ``lax.scan`` wraps the SAME
+    shard_map body — one dispatch launches K SPMD steps, so pod runs
+    amortize per-step dispatch exactly like the local paths.  K is read
+    from the input shape (the epoch-tail remainder superbatch compiles its
+    own executable).  Under ``fallback`` the return is
+    ``(state, losses [K], overflow_steps)`` with the per-step flags SUMMED
+    into one replicated int32 (drivers only count them).  Per-step losses
+    and the final state are bit-identical to K sequential K=1 steps
+    (test-pinned).
 
     Batch arrays must have leading dim divisible by the total device count
     (the batch splits over both mesh axes).  ``lookup`` picks the embedding
@@ -594,18 +659,43 @@ def make_sharded_train_step(
         check_vma=False,
     )
 
-    @partial(jax.jit, donate_argnums=(0,))
-    def step(state: TrainState, batch: Batch):
+    def _apply(state: TrainState, batch: Batch):
         table, accum, dense, dense_acc, loss, overflowed = mapped(
             state.table, state.table_opt.accum, state.dense, state.dense_opt.accum, batch
         )
         new = TrainState(
             table, AdagradState(accum), dense, AdagradState(dense_acc), state.step + 1
         )
-        if fallback:
-            return new, loss, overflowed
-        return new, loss
+        return new, loss, overflowed
 
+    if steps_per_call <= 1:
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(state: TrainState, batch: Batch):
+            new, loss, overflowed = _apply(state, batch)
+            if fallback:
+                return new, loss, overflowed
+            return new, loss
+
+    else:
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(state: TrainState, superbatch: Batch):
+            def one(st, b):
+                new, loss, overflowed = _apply(st, b)
+                return new, (loss, overflowed)
+
+            state, (losses, ovfs) = lax.scan(one, state, superbatch)
+            if fallback:
+                return state, losses, jnp.sum(ovfs)
+            return state, losses
+
+    # The cached-dataset wrapper (make_cached_sharded_train_step) must
+    # mirror the flagged signature without re-deriving the config.
+    try:
+        step.overflow_flagged = fallback
+    except AttributeError:  # jit wrapper without settable attributes
+        pass
     return step
 
 

@@ -32,6 +32,7 @@ __all__ = [
     "init_state",
     "train_step_body",
     "make_train_step",
+    "make_scanned_train_step",
     "make_predict_step",
     "pack_state",
     "init_packed_state",
@@ -117,6 +118,37 @@ def make_train_step(model, learning_rate: float):
     @partial(jax.jit, donate_argnums=(0,))
     def step(state: TrainState, batch: Batch):
         return train_step_body(model, learning_rate, state, batch)
+
+    return step
+
+
+def make_scanned_train_step(model, learning_rate: float, body=None):
+    """Returns jitted ``step(state, superbatch) -> (state, losses [K])``
+    fusing K consecutive train steps into ONE dispatch via ``lax.scan``.
+
+    ``superbatch`` is a Batch whose every field carries a leading micro-step
+    dim ([K, B], [K, B, N], ...) — Batch.stack_parsed's output.  K is read
+    from the input shape, so one Python function serves both the main fused
+    call and the epoch-tail remainder (batches % K) — each K compiles once.
+    The scan body is ``body`` (default trainer.train_step_body; the packed
+    driver passes its packed body), i.e. the SAME function the K=1 step
+    jits, applied to the same values in the same order — per-step losses
+    and the final state are bit-identical to K sequential K=1 steps
+    (test-pinned in tests/test_steps_per_call.py).  State donation is
+    preserved: the scan carry aliases the donated input buffers, so the
+    [V, D] table still updates in place across all K micro-steps.
+    """
+    from jax import lax
+
+    body = body or train_step_body
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def step(state: TrainState, superbatch: Batch):
+        def one(st, b):
+            st, loss = body(model, learning_rate, st, b)
+            return st, loss
+
+        return lax.scan(one, state, superbatch)
 
     return step
 

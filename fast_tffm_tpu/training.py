@@ -71,6 +71,21 @@ def _check_finite(loss: float, cfg: Config) -> None:
 _TRAIN_WEIGHTS = object()  # sentinel: apply cfg.weight_files (train files only)
 
 
+def _batch_converter(uses_fields: bool):
+    """The drivers' host→device batch assembly: a single ParsedBatch
+    converts via ``Batch.from_parsed``; a LIST of K grouped batches
+    (steps_per_call > 1 streams) stacks into one [K, B, ...] superbatch.
+    One definition shared by train() and dist_train() so the stacking
+    rule cannot diverge between the local and distributed drivers."""
+
+    def to_batch(parsed, w):
+        if isinstance(parsed, list):
+            return Batch.stack_parsed(parsed, w, with_fields=uses_fields)
+        return Batch.from_parsed(parsed, w, with_fields=uses_fields)
+
+    return to_batch
+
+
 def binary_input(files) -> bool:
     """True when every file in the (cache-resolved) list is FMB — i.e. the
     stream will be memmap-backed, not parsed."""
@@ -88,6 +103,7 @@ def _stream(
     weights=_TRAIN_WEIGHTS,
     to_batch=None,
     shuffle_epoch=None,
+    steps_per_call=1,
     **shard_kw,
 ):
     """Prefetched input stream yielding ``(batch_or_None, parsed, w)``.
@@ -98,6 +114,13 @@ def _stream(
     host — the memmap producer is cheap, unlike the text parse, which
     needs the thread to itself and keeps conversion in the consumer; see
     DESIGN.md §6).  Callers convert when the first element is None.
+
+    ``steps_per_call`` > 1 groups K consecutive batches per item: ``parsed``
+    and ``w`` become LISTS of K entries (epoch tail shorter), and the
+    drivers' list-aware ``to_batch`` stacks them into one [K, B, ...]
+    superbatch — ONE H2D transfer and one fused-step dispatch per K steps.
+    The grouping (and, for FMB input, the stacking + transfer) runs inside
+    the prefetch thread, exactly like the single-batch conversion above.
     """
     if weights is _TRAIN_WEIGHTS:
         weights = cfg.weight_files if cfg.weight_files else None
@@ -175,11 +198,23 @@ def _stream(
         shuffle_seed=shuffle_seed,
         **shard_kw,
     )
+    if steps_per_call > 1:
+        from fast_tffm_tpu.utils.prefetch import chunk
+
+        def _grouped(pairs, k):
+            for items in chunk(pairs, k):
+                yield [p for p, _ in items], [w for _, w in items]
+
+        raw = _grouped(raw, steps_per_call)
     if to_batch is not None and binary_input(files):
         gen = ((to_batch(p, w), p, w) for p, w in raw)
     else:
         gen = ((None, p, w) for p, w in raw)
-    return prefetch(gen, depth=cfg.queue_size)
+    # Each queued item holds steps_per_call batches, so scale the depth
+    # down to keep the in-flight memory (device superbatches for FMB
+    # input, host staging for text) at the K=1 level — one or two
+    # superbatches in flight already keep the consumer overlapped.
+    return prefetch(gen, depth=max(1, cfg.queue_size // max(1, steps_per_call)))
 
 
 def _evaluate(
@@ -229,6 +264,16 @@ def _run_training(
     input stream, ``to_batch(parsed, w)`` the host→device batch assembly,
     and ``evaluate`` the validation pass — the multi-host path plugs in
     sharded input + global-array stitching here without forking the loop.
+
+    Step fusion (``steps_per_call`` > 1) needs no fork either: a fused
+    ``step_fn`` returns a PER-MICRO-STEP loss vector [K] instead of a
+    scalar, and the loop reads K off the loss shape — step counting,
+    throughput accounting, loss logging, and the NaN check all keep
+    per-step granularity (every micro-step loss lands in the log window's
+    mean).  The graceful-stop signal and the log cadence are only CHECKED
+    between dispatches, so stop/checkpoint boundaries and log-window edges
+    become K-step-aligned — the documented cost of fusing away the
+    per-step host round-trip.
     ``extra_metrics()`` (optional) is drained at every log point and its
     dict merged into the stdout line and the JSONL record (dist_train uses
     it to report alltoall overflow-fallback step counts).  ``saveable``
@@ -240,7 +285,7 @@ def _run_training(
     if train_stream is None:
         train_stream = lambda epoch: _stream(
             cfg, cfg.train_files, max_nnz, epochs=1, to_batch=to_batch,
-            shuffle_epoch=epoch,
+            shuffle_epoch=epoch, steps_per_call=cfg.steps_per_call,
         )
     if to_batch is None:
         to_batch = Batch.from_parsed
@@ -252,6 +297,7 @@ def _run_training(
     n_chips = jax.device_count()
     meter = Throughput()
     losses = []
+    pending_steps = 0  # micro-steps since the last log point
     start_step = step_num = int(state.step)
     # On multi-host pods every process runs this loop; only process 0 owns
     # the metrics file and profiler trace (shared filesystems would get N
@@ -299,19 +345,37 @@ def _run_training(
                 tracer.on_step()
                 with step_trace("train", step_num):
                     state, loss = step_fn(state, b)
-                step_num += 1
-                if step_num == start_step + 1:
-                    # Step 1 paid the XLA compile; a meter window that
+                # A fused call returns per-micro-step losses [K]; K=1
+                # returns the classic scalar.  The shape is static — no
+                # device sync happens here.
+                k = int(loss.shape[0]) if getattr(loss, "ndim", 0) else 1
+                first_call = step_num == start_step
+                step_num += k
+                if first_call:
+                    # Call 1 paid the XLA compile; a meter window that
                     # includes it reads as a throughput collapse.
                     jax.block_until_ready(loss)
                     meter.reset()
-                losses.append(loss)  # device value; only sync at log points
-                meter.add(examples_per_step or parsed.batch_size)
+                losses.append(loss)  # device value(s); only sync at log points
+                pending_steps += k
+                if examples_per_step is not None:
+                    meter.add(examples_per_step * k)
+                elif isinstance(parsed, list):
+                    meter.add(sum(p.batch_size for p in parsed))
+                else:
+                    meter.add(parsed.batch_size)
                 if stop_requested.is_set():
                     break
-                if len(losses) >= cfg.log_every:
+                if pending_steps >= cfg.log_every:
+                    pending_steps = 0
                     rate = meter.rate()
-                    mean_loss = np.mean([float(l) for l in losses])
+                    mean_loss = float(
+                        np.mean(
+                            np.concatenate(
+                                [np.atleast_1d(np.asarray(l)) for l in losses]
+                            )
+                        )
+                    )
                     _check_finite(mean_loss, cfg)
                     extra = extra_metrics() if extra_metrics is not None else {}
                     extra_txt = "".join(f" {k} {v}" for k, v in extra.items() if v)
@@ -336,8 +400,9 @@ def _run_training(
             if losses:
                 # Epoch boundary syncs anyway (validation / checkpoint); a
                 # poisoned state must abort BEFORE the save below replaces
-                # the last good checkpoint.
-                _check_finite(float(losses[-1]), cfg)
+                # the last good checkpoint.  The final entry may be a [K]
+                # fused-call vector — check its LAST micro-step.
+                _check_finite(float(np.asarray(losses[-1]).reshape(-1)[-1]), cfg)
             if cfg.validation_files:
                 val_auc = evaluate(cfg, predict_step, state, cfg.validation_files, max_nnz)
                 log(f"epoch {epoch} validation auc {val_auc:.5f}")
@@ -458,7 +523,14 @@ def train(cfg: Config, *, resume: bool = False, log=print):
         predict_step = make_predict_step(model)
         step_body = None
         step_fn = make_train_step(model, cfg.learning_rate)
-    to_batch = lambda parsed, w: Batch.from_parsed(parsed, w, with_fields=model.uses_fields)
+    if cfg.steps_per_call > 1 and not cfg.device_cache:
+        # Streamed step fusion: ONE dispatch (and one H2D superbatch
+        # transfer) per K steps.  The scan body is the same step body the
+        # K=1 jit uses (packed or rows) — bit-identical per-step results.
+        from fast_tffm_tpu.trainer import make_scanned_train_step
+
+        step_fn = make_scanned_train_step(model, cfg.learning_rate, body=step_body)
+    to_batch = _batch_converter(model.uses_fields)
     if cfg.device_cache:
         step_fn, train_stream, examples_per_step = _device_cached_input(
             cfg, model, max_nnz, log, body=step_body
@@ -486,8 +558,10 @@ def _device_cached_input(cfg: Config, model, max_nnz: int, log, body=None):
     fuses the batch slice (or the shuffled gather) with the model step.
     """
     from fast_tffm_tpu.data.device_cache import (
+        epoch_index_chunks,
         full_epoch_perm,
         load_device_dataset,
+        make_cached_scan_train_step,
         make_cached_train_step,
     )
 
@@ -520,19 +594,44 @@ def _device_cached_input(cfg: Config, model, max_nnz: int, log, body=None):
         f"device cache: {data.n_rows} rows resident "
         f"({data.nbytes / 2**20:.1f} MiB, {data.batches} batches/epoch)"
     )
+    perm_ref = [None]
+
+    def _maybe_draw_perm(epoch):
+        if cfg.shuffle:
+            perm_ref[0] = jax.device_put(
+                full_epoch_perm(data, cfg.shuffle_seed, epoch)
+            )
+
+    if cfg.steps_per_call > 1:
+        # Scan-fused epochs: the per-call "input" is a pre-placed [K]
+        # index vector (remainder-tail vector included), so an epoch is
+        # ceil(batches/K) dispatches with zero host involvement between
+        # the K resident-slice steps inside each one.
+        stepk, stepk_shuffled = make_cached_scan_train_step(
+            model, cfg.learning_rate, data, body=body
+        )
+        chunks = epoch_index_chunks(data.batches, cfg.steps_per_call)
+
+        def train_stream(epoch):
+            _maybe_draw_perm(epoch)
+            return ((c, None, None) for c in chunks)
+
+        def step_fn(state, idxs):
+            if perm_ref[0] is not None:
+                return stepk_shuffled(state, perm_ref[0], idxs)
+            return stepk(state, idxs)
+
+        return step_fn, train_stream, cfg.batch_size
+
     cached_step, cached_step_shuffled = make_cached_train_step(
         model, cfg.learning_rate, data, body=body
     )
     # Batch indices as pre-placed device scalars: the per-step "input" is
     # an index that is already on device — no per-step H2D at all.
     idx = [jax.device_put(np.int32(i)) for i in range(data.batches)]
-    perm_ref = [None]
 
     def train_stream(epoch):
-        if cfg.shuffle:
-            perm_ref[0] = jax.device_put(
-                full_epoch_perm(data, cfg.shuffle_seed, epoch)
-            )
+        _maybe_draw_perm(epoch)
         return ((idx[i], None, None) for i in range(data.batches))
 
     def step_fn(state, i):
@@ -634,6 +733,9 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
         packed_update=cfg.packed_update,
         accumulator=cfg.adagrad_accumulator,
         compact_cap=cfg.packed_compact_cap,
+        # With device_cache the scan lives in the cached wrapper below
+        # (it slices resident batches); the raw SPMD step stays per-batch.
+        steps_per_call=(1 if cfg.device_cache else cfg.steps_per_call),
     )
     predict_step = make_sharded_predict_step(
         model, mesh, lookup=cfg.lookup, capacity_factor=cfg.lookup_capacity_factor,
@@ -706,7 +808,12 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
             f"({cached_data.nbytes / 2**20:.1f} MiB total, "
             f"{cached_data.batches} batches/epoch)"
         )
-        step_fn = make_cached_sharded_train_step(step_fn, cached_data)
+        step_fn = make_cached_sharded_train_step(
+            step_fn, cached_data, steps_per_call=cfg.steps_per_call,
+            overflow_flagged=(
+                cfg.lookup == "alltoall" and cfg.lookup_overflow == "fallback"
+            ),
+        )
 
     extra_metrics = None
     if cfg.lookup == "alltoall" and cfg.lookup_overflow == "fallback":
@@ -730,13 +837,25 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
             return {"lookup_overflow_steps": n}
 
     train_stream = examples_per_step = evaluate = None
-    to_batch = lambda parsed, w: Batch.from_parsed(parsed, w, with_fields=model.uses_fields)
+    to_batch = _batch_converter(model.uses_fields)
     if cached_data is not None:
-        # Per-step "input" is a pre-placed device index scalar.
-        idx = [jax.device_put(np.int32(i)) for i in range(cached_data.batches)]
+        if cfg.steps_per_call > 1:
+            # Per-call "input" is a pre-placed [K] index vector (tail
+            # remainder included) — epoch_index_chunks as on the local
+            # cached path.
+            from fast_tffm_tpu.data.device_cache import epoch_index_chunks
 
-        def train_stream(epoch):
-            return ((idx[i], None, None) for i in range(cached_data.batches))
+            chunks = epoch_index_chunks(cached_data.batches, cfg.steps_per_call)
+
+            def train_stream(epoch):
+                return ((c, None, None) for c in chunks)
+
+        else:
+            # Per-step "input" is a pre-placed device index scalar.
+            idx = [jax.device_put(np.int32(i)) for i in range(cached_data.batches)]
+
+            def train_stream(epoch):
+                return ((idx[i], None, None) for i in range(cached_data.batches))
 
         examples_per_step = cfg.batch_size
     nproc = jax.process_count()
@@ -777,9 +896,16 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
                     pad_to_batches=steps_per_epoch,
                     to_batch=to_batch,
                     shuffle_epoch=epoch,
+                    steps_per_call=cfg.steps_per_call,
                 )
 
         def to_batch(parsed, w):
+            if isinstance(parsed, list):  # K local chunks -> [K, B, ...] global
+                from fast_tffm_tpu.parallel import make_global_superbatch
+
+                return make_global_superbatch(
+                    mesh, parsed, w, with_fields=model.uses_fields
+                )
             return make_global_batch(mesh, parsed, w, with_fields=model.uses_fields)
 
         examples_per_step = cfg.batch_size

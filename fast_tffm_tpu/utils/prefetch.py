@@ -16,9 +16,30 @@ import queue
 import threading
 from collections.abc import Iterable, Iterator
 
-__all__ = ["prefetch"]
+__all__ = ["prefetch", "chunk"]
 
 _SENTINEL = object()
+
+
+def chunk(it: Iterable, k: int) -> Iterator[list]:
+    """Group consecutive items into lists of length ``k`` (the final list
+    may be shorter — the epoch-tail remainder).
+
+    The step-fusion staging primitive (``steps_per_call``): composed UNDER
+    ``prefetch`` by the input streams, the grouping — and any superbatch
+    stacking mapped over it — runs inside the prefetch thread, overlapping
+    the K-batch assembly with the consumer's fused-step dispatch.
+    """
+    if k < 1:
+        raise ValueError(f"chunk size must be >= 1, got {k}")
+    buf: list = []
+    for item in it:
+        buf.append(item)
+        if len(buf) == k:
+            yield buf
+            buf = []
+    if buf:
+        yield buf
 
 
 def prefetch(it: Iterable, depth: int = 8) -> Iterator:

@@ -1,0 +1,372 @@
+"""Step fusion (`steps_per_call` > 1): K scan-fused steps per dispatch.
+
+The load-bearing property on EVERY train path: a K>1 scan-fused step is
+BIT-IDENTICAL to K sequential K=1 steps — same final TrainState, same
+per-step losses — including the epoch-tail remainder (batches % K != 0)
+and shuffle-enabled device-cached epochs.  Fusion may only change how many
+dispatches (and H2D transfers) an epoch costs, never a single bit of what
+it computes.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fast_tffm_tpu.config import Config
+from fast_tffm_tpu.data.binary import write_fmb
+from fast_tffm_tpu.models import Batch, FMModel
+from fast_tffm_tpu.trainer import (
+    init_packed_state,
+    init_state,
+    make_packed_train_step,
+    make_scanned_train_step,
+    make_train_step,
+    packed_train_step_body,
+)
+from fast_tffm_tpu.training import train
+from fast_tffm_tpu.utils.prefetch import chunk
+
+VOCAB = 200
+B, N = 16, 6
+
+
+def _batches(rng, n, vocab=VOCAB):
+    out = []
+    for _ in range(n):
+        out.append(
+            Batch(
+                labels=jnp.asarray(rng.integers(0, 2, B).astype(np.float32)),
+                ids=jnp.asarray(rng.integers(0, vocab, (B, N)).astype(np.int32)),
+                vals=jnp.asarray(
+                    np.abs(rng.normal(size=(B, N)).astype(np.float32)) + 0.1
+                ),
+                fields=jnp.zeros((B, N), jnp.int32),
+                weights=jnp.ones((B,), jnp.float32),
+            )
+        )
+    return out
+
+
+def _stack(bs):
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *bs)
+
+
+def _assert_state_equal(a, b):
+    np.testing.assert_array_equal(np.asarray(a.table), np.asarray(b.table))
+    if a.table_opt.accum.size:
+        np.testing.assert_array_equal(
+            np.asarray(a.table_opt.accum), np.asarray(b.table_opt.accum)
+        )
+    assert int(a.step) == int(b.step)
+
+
+# --- streamed path: scanned superbatch step vs sequential ----------------
+
+
+def test_scanned_step_bitwise_matches_sequential_with_tail():
+    rng = np.random.default_rng(0)
+    model = FMModel(vocabulary_size=VOCAB, factor_num=4, order=2)
+    batches = _batches(rng, 7)  # K=3 -> two full calls + a [1] remainder
+    step = make_train_step(model, 0.05)
+    kstep = make_scanned_train_step(model, 0.05)
+    s_seq = init_state(model, jax.random.key(0))
+    s_k = init_state(model, jax.random.key(0))
+    seq_losses = []
+    for b in batches:
+        s_seq, l = step(s_seq, b)
+        seq_losses.append(np.asarray(l))
+    k_losses = []
+    for group in chunk(iter(batches), 3):
+        s_k, ls = kstep(s_k, _stack(group))
+        assert ls.shape == (len(group),)  # per-micro-step granularity
+        k_losses.extend(np.asarray(ls))
+    np.testing.assert_array_equal(np.asarray(seq_losses), np.asarray(k_losses))
+    _assert_state_equal(s_seq, s_k)
+
+
+def test_scanned_packed_step_bitwise_matches_sequential():
+    """The packed layout's step body scans identically (train() passes it
+    as the scan body when table_layout = packed)."""
+    rng = np.random.default_rng(1)
+    model = FMModel(vocabulary_size=VOCAB, factor_num=4, order=2)
+    batches = _batches(rng, 5)  # K=2 -> tail of 1
+    step = make_packed_train_step(model, 0.05)
+    body = lambda mdl, lr, st, b: packed_train_step_body(mdl, lr, st, b)
+    kstep = make_scanned_train_step(model, 0.05, body=body)
+    s_seq = init_packed_state(model, jax.random.key(0))
+    s_k = init_packed_state(model, jax.random.key(0))
+    seq_losses = []
+    for b in batches:
+        s_seq, l = step(s_seq, b)
+        seq_losses.append(np.asarray(l))
+    k_losses = []
+    for group in chunk(iter(batches), 2):
+        s_k, ls = kstep(s_k, _stack(group))
+        k_losses.extend(np.asarray(ls))
+    np.testing.assert_array_equal(np.asarray(seq_losses), np.asarray(k_losses))
+    _assert_state_equal(s_seq, s_k)
+
+
+# --- device-cached path --------------------------------------------------
+
+
+def _write_text(path, rows, rng, vocab=VOCAB):
+    with open(path, "w") as f:
+        for _ in range(rows):
+            label = rng.integers(0, 2)
+            nnz = rng.integers(1, 8)
+            toks = [
+                f"{rng.integers(0, vocab)}:{round(float(rng.normal()), 4)}"
+                for _ in range(nnz)
+            ]
+            f.write(f"{label} {' '.join(toks)}\n")
+    return str(path)
+
+
+@pytest.fixture()
+def fmb_files(tmp_path):
+    rng = np.random.default_rng(42)
+    out = []
+    for name, rows in (("a", 83), ("b", 41)):  # 124 rows / B=32 -> 4 batches
+        src = _write_text(tmp_path / f"{name}.libsvm", rows, rng)
+        out.append(write_fmb(src, src + ".fmb", vocabulary_size=VOCAB))
+    return out
+
+
+def test_cached_scan_step_bitwise_matches_sequential(fmb_files):
+    from fast_tffm_tpu.data.device_cache import (
+        epoch_index_chunks,
+        load_device_dataset,
+        make_cached_scan_train_step,
+        make_cached_train_step,
+    )
+
+    model = FMModel(vocabulary_size=VOCAB, factor_num=4, order=2)
+    data = load_device_dataset(
+        fmb_files, batch_size=32, vocabulary_size=VOCAB, max_nnz=8,
+        with_fields=False,
+    )
+    assert data.batches == 4
+    step, _ = make_cached_train_step(model, 0.05, data)
+    stepk, _ = make_cached_scan_train_step(model, 0.05, data)
+    s_seq = init_state(model, jax.random.key(0))
+    s_k = init_state(model, jax.random.key(0))
+    seq_losses = []
+    for i in range(data.batches):
+        s_seq, l = step(s_seq, jax.device_put(np.int32(i)))
+        seq_losses.append(np.asarray(l))
+    chunks = epoch_index_chunks(data.batches, 3)
+    assert [len(c) for c in chunks] == [3, 1]  # tail remainder call
+    k_losses = []
+    for c in chunks:
+        s_k, ls = stepk(s_k, c)
+        k_losses.extend(np.asarray(ls))
+    np.testing.assert_array_equal(np.asarray(seq_losses), np.asarray(k_losses))
+    _assert_state_equal(s_seq, s_k)
+
+
+def test_cached_scan_shuffled_bitwise_matches_sequential(fmb_files):
+    from fast_tffm_tpu.data.device_cache import (
+        epoch_index_chunks,
+        full_epoch_perm,
+        load_device_dataset,
+        make_cached_scan_train_step,
+        make_cached_train_step,
+    )
+
+    model = FMModel(vocabulary_size=VOCAB, factor_num=4, order=2)
+    data = load_device_dataset(
+        fmb_files, batch_size=32, vocabulary_size=VOCAB, max_nnz=8,
+        with_fields=False,
+    )
+    _, step_sh = make_cached_train_step(model, 0.05, data)
+    _, stepk_sh = make_cached_scan_train_step(model, 0.05, data)
+    s_seq = init_state(model, jax.random.key(0))
+    s_k = init_state(model, jax.random.key(0))
+    for epoch in range(2):  # fresh permutation each epoch, like the driver
+        perm = jax.device_put(full_epoch_perm(data, 7, epoch))
+        for i in range(data.batches):
+            s_seq, _ = step_sh(s_seq, perm, jax.device_put(np.int32(i)))
+        for c in epoch_index_chunks(data.batches, 3):
+            s_k, _ = stepk_sh(s_k, perm, c)
+    _assert_state_equal(s_seq, s_k)
+
+
+# --- sharded SPMD path ---------------------------------------------------
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs the 8-device CPU mesh")
+@pytest.mark.parametrize("shape", [(2, 4), (1, 8)], ids=["data2xrow4", "data1xrow8"])
+def test_sharded_scanned_step_bitwise_matches_sequential(shape):
+    from fast_tffm_tpu.parallel import (
+        init_sharded_state,
+        make_mesh,
+        make_sharded_train_step,
+    )
+
+    rng = np.random.default_rng(2)
+    model = FMModel(vocabulary_size=VOCAB, factor_num=4, order=2)
+    mesh = make_mesh(*shape)
+    batches = _batches(rng, 5)  # K=2 -> tail of 1
+    step = make_sharded_train_step(model, 0.05, mesh)
+    kstep = make_sharded_train_step(model, 0.05, mesh, steps_per_call=2)
+    s_seq = init_sharded_state(model, mesh, jax.random.key(0))
+    s_k = init_sharded_state(model, mesh, jax.random.key(0))
+    seq_losses = []
+    for b in batches:
+        s_seq, l = step(s_seq, b)
+        seq_losses.append(np.asarray(l))
+    k_losses = []
+    for group in chunk(iter(batches), 2):
+        s_k, ls = kstep(s_k, _stack(group))
+        assert ls.shape == (len(group),)
+        k_losses.extend(np.asarray(ls))
+    np.testing.assert_array_equal(np.asarray(seq_losses), np.asarray(k_losses))
+    _assert_state_equal(s_seq, s_k)
+
+
+# --- driver-level parity -------------------------------------------------
+
+
+def _cfg(tmp_path, files, tag, **kw):
+    return Config(
+        model="fm",
+        factor_num=4,
+        vocabulary_size=VOCAB,
+        model_file=str(tmp_path / f"model_{tag}.ckpt"),
+        train_files=tuple(files),
+        epoch_num=2,
+        batch_size=32,
+        learning_rate=0.05,
+        log_every=2,
+        metrics_path=str(tmp_path / f"m_{tag}.jsonl"),
+        **kw,
+    ).validate()
+
+
+def _losses(path):
+    import json
+
+    return [
+        r["loss"]
+        for r in map(json.loads, open(path).read().splitlines())
+        if "loss" in r
+    ]
+
+
+def test_train_driver_steps_per_call_parity(tmp_path, fmb_files):
+    """train() with steps_per_call=2 vs 1: bit-identical final state, and —
+    because log_every=2 windows align with the K=2 call boundaries — the
+    logged per-window mean losses match record for record (per-step loss
+    granularity survives fusion)."""
+    silent = lambda *a: None
+    cfg1 = _cfg(tmp_path, fmb_files, "k1")
+    s1 = train(cfg1, log=silent)
+    cfg2 = _cfg(tmp_path, fmb_files, "k2", steps_per_call=2)
+    s2 = train(cfg2, log=silent)
+    _assert_state_equal(s1, s2)
+    assert _losses(cfg1.metrics_path) == _losses(cfg2.metrics_path)
+
+
+def test_train_driver_device_cache_steps_per_call_parity(tmp_path, fmb_files):
+    silent = lambda *a: None
+    s1 = train(_cfg(tmp_path, fmb_files, "dk1", device_cache=True), log=silent)
+    s3 = train(
+        _cfg(tmp_path, fmb_files, "dk3", device_cache=True, steps_per_call=3),
+        log=silent,
+    )
+    _assert_state_equal(s1, s3)
+
+
+def test_train_driver_packed_steps_per_call_parity(tmp_path, fmb_files):
+    """The packed layout's step body rides the same scan — streamed and
+    device-cached."""
+    silent = lambda *a: None
+    kw = dict(table_layout="packed")
+    s1 = train(_cfg(tmp_path, fmb_files, "pk1", **kw), log=silent)
+    s3 = train(_cfg(tmp_path, fmb_files, "pk3", steps_per_call=3, **kw), log=silent)
+    _assert_state_equal(s1, s3)
+    c1 = train(_cfg(tmp_path, fmb_files, "pc1", device_cache=True, **kw), log=silent)
+    c3 = train(
+        _cfg(tmp_path, fmb_files, "pc3", device_cache=True, steps_per_call=3, **kw),
+        log=silent,
+    )
+    _assert_state_equal(c1, c3)
+
+
+def test_train_driver_shuffled_cache_steps_per_call_parity(tmp_path, fmb_files):
+    silent = lambda *a: None
+    kw = dict(device_cache=True, shuffle=True, shuffle_seed=7)
+    s1 = train(_cfg(tmp_path, fmb_files, "sk1", **kw), log=silent)
+    s3 = train(_cfg(tmp_path, fmb_files, "sk3", steps_per_call=3, **kw), log=silent)
+    _assert_state_equal(s1, s3)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs the 8-device CPU mesh")
+def test_dist_train_driver_steps_per_call_parity(tmp_path, fmb_files):
+    from fast_tffm_tpu.parallel import make_mesh
+    from fast_tffm_tpu.training import dist_train
+
+    silent = lambda *a: None
+    s1 = dist_train(_cfg(tmp_path, fmb_files, "mk1"), log=silent, mesh=make_mesh(2, 4))
+    s3 = dist_train(
+        _cfg(tmp_path, fmb_files, "mk3", steps_per_call=3),
+        log=silent,
+        mesh=make_mesh(2, 4),
+    )
+    _assert_state_equal(s1, s3)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs the 8-device CPU mesh")
+def test_dist_train_cached_steps_per_call_parity(tmp_path, fmb_files):
+    from fast_tffm_tpu.parallel import make_mesh
+    from fast_tffm_tpu.training import dist_train
+
+    silent = lambda *a: None
+    s1 = dist_train(
+        _cfg(tmp_path, fmb_files, "ck1", device_cache=True),
+        log=silent,
+        mesh=make_mesh(2, 4),
+    )
+    s3 = dist_train(
+        _cfg(tmp_path, fmb_files, "ck3", device_cache=True, steps_per_call=3),
+        log=silent,
+        mesh=make_mesh(2, 4),
+    )
+    _assert_state_equal(s1, s3)
+
+
+# --- plumbing ------------------------------------------------------------
+
+
+def test_chunk_groups_with_short_tail():
+    assert list(chunk(iter(range(7)), 3)) == [[0, 1, 2], [3, 4, 5], [6]]
+    assert list(chunk(iter([]), 3)) == []
+    with pytest.raises(ValueError):
+        list(chunk(iter([1]), 0))
+
+
+def test_stack_parsed_superbatch_shapes():
+    from fast_tffm_tpu.data.libsvm import parse_lines
+
+    lines = [f"1 {i}:0.5 {i + 1}:1.0" for i in range(4)]
+    p1 = parse_lines(lines[:2], vocabulary_size=VOCAB)
+    p2 = parse_lines(lines[2:], vocabulary_size=VOCAB)
+    sb = Batch.stack_parsed([p1, p2], with_fields=False)
+    assert sb.labels.shape == (2, 2)
+    assert sb.ids.shape[:2] == (2, 2) and sb.ids.dtype == jnp.int32
+    assert sb.fields.shape == (2, 2, 0)
+    assert sb.weights.shape == (2, 2)
+    np.testing.assert_array_equal(np.asarray(sb.weights), np.ones((2, 2)))
+
+
+def test_config_steps_per_call_parse_and_validate(tmp_path):
+    from fast_tffm_tpu.config import load_config
+
+    p = tmp_path / "c.cfg"
+    p.write_text("[Train]\ntrain_files = x\nsteps_per_call = 8\n")
+    assert load_config(str(p)).steps_per_call == 8
+    with pytest.raises(ValueError):
+        Config(steps_per_call=0).validate()
