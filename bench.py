@@ -825,6 +825,11 @@ def main():
                 results["steps_per_call_k8_over_k1"] = round(spc["8"] / spc["1"], 3)
         except Exception as e:
             results["steps_per_call_error"] = str(e)[:120]
+        finally:
+            # stepk's closure captures the resident dataset arrays; left
+            # alive it would carry the whole device cache into the next
+            # (packed 2^24) rung and shrink its memory headroom.
+            stepk = chunks = None
         del data, cached_step, idx, dc_state
     except Exception as e:
         results["device_cached_value"] = None

@@ -34,6 +34,8 @@ __all__ = [
     "dist_train",
     "predict",
     "dist_predict",
+    "ServingEngine",
+    "serve_lines",
 ]
 
 
@@ -44,4 +46,5 @@ __all__ = [
 # module access keeps working.  Heavy optional deps (orbax) stay lazy
 # inside the driver modules.
 from fast_tffm_tpu.prediction import dist_predict, predict  # noqa: F401, E402
+from fast_tffm_tpu.serving import ServingEngine, serve_lines  # noqa: F401, E402
 from fast_tffm_tpu.training import dist_train, train  # noqa: F401, E402

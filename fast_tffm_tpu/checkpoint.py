@@ -31,7 +31,12 @@ import numpy as np
 from fast_tffm_tpu.optim import AdagradState
 from fast_tffm_tpu.trainer import TrainState
 
-__all__ = ["save_checkpoint", "restore_checkpoint", "latest_step"]
+__all__ = [
+    "save_checkpoint",
+    "restore_checkpoint",
+    "latest_step",
+    "checkpoint_signature",
+]
 
 
 # ---------------------------------------------------------------------------
@@ -294,6 +299,27 @@ def restore_checkpoint(path: str, like: TrainState) -> TrainState:
         ),
         step=put(step, like.step),
     )
+
+
+def checkpoint_signature(path: str) -> tuple | None:
+    """Cheap change detector for the serving hot-reload watcher:
+    (step, mtime_ns, size) of the checkpoint, or None when absent or
+    unreadable.  Step alone would miss a same-step overwrite (a trainer
+    re-saving after a rollback); mtime alone would miss nothing but says
+    nothing — together with the size they identify a write without
+    reading any array data.  npz saves are atomic (tmp + os.replace), so
+    a changed signature on npz always names a COMPLETE file; orbax
+    directories can be observed mid-write, which is why the watcher
+    treats a failed restore as retry-next-tick, not an error."""
+    path = path.rstrip("/")
+    step = latest_step(path)
+    if step is None:
+        return None
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    return (step, st.st_mtime_ns, st.st_size)
 
 
 def latest_step(path: str) -> int | None:

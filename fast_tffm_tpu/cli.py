@@ -14,7 +14,7 @@ import sys
 
 from fast_tffm_tpu.config import load_config
 
-MODES = ("train", "predict", "dist_train", "dist_predict", "convert")
+MODES = ("train", "predict", "dist_train", "dist_predict", "convert", "serve")
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -52,6 +52,14 @@ def main(argv: list[str] | None = None) -> int:
         from fast_tffm_tpu.prediction import predict
 
         predict(cfg)
+    elif args.mode == "serve":
+        # Online path: libsvm lines on stdin -> one score per line on
+        # stdout, micro-batched through the bucket-compiled engine
+        # ([Serving] config).  Logs/metrics go to stderr/metrics_path so
+        # the score stream stays clean for piping.
+        from fast_tffm_tpu.serving import serve_lines
+
+        return serve_lines(cfg, log=lambda *a: print(*a, file=sys.stderr))
     elif args.mode == "convert":
         # Pre-pack every configured data file into its FMB binary cache
         # (what `binary_cache = true` would do lazily at first stream) —

@@ -86,6 +86,22 @@ class Config:
     # [Predict]
     predict_files: tuple[str, ...] = ()
     score_path: str = "scores.txt"
+    # [Serving] — the online engine (serving/; `serve` CLI verb)
+    serve_buckets: tuple[int, ...] = (1, 8, 64, 512)  # compile-ladder batch
+    #   sizes; every flush pads to the nearest rung so steady state never
+    #   recompiles (warmed once at startup)
+    serve_max_batch: int = 0  # collector flush size; 0 = largest bucket
+    serve_flush_deadline_ms: float = 5.0  # max micro-batching wait for the
+    #   oldest pending request (latency/occupancy knob; 0 = flush instantly)
+    serve_queue_size: int = 4096  # bounded admission queue — the ONLY
+    #   elastic buffer, so overload memory is capped here
+    serve_overload: str = "block"  # queue-full policy: block (backpressure)
+    #   | reject (raise OverloadError to the submitter — shed load)
+    serve_reload_interval_s: float = 0.0  # hot checkpoint reload poll; the
+    #   watcher restores changed model_file checkpoints off the hot path
+    #   and the collector swaps them in between flushes (0 = no watcher)
+    serve_metrics_every_s: float = 10.0  # serving-metrics JSONL cadence
+    #   (written to metrics_path, tagged kind=serving; 0 = final record only)
     # [Distributed]
     data_parallel: int = 0  # 0 = all devices / row_parallel
     row_parallel: int = 0  # 0 = vocabulary_block_num
@@ -171,6 +187,34 @@ class Config:
             raise ValueError(
                 f"init_accumulator_value must be > 0, got {self.init_accumulator_value}"
             )
+        self.serve_buckets = validate_buckets(self.serve_buckets)
+        if self.serve_max_batch < 0:
+            raise ValueError(
+                f"serve_max_batch must be >= 0 (0 = largest bucket), "
+                f"got {self.serve_max_batch}"
+            )
+        if self.serve_max_batch > self.serve_buckets[-1]:
+            raise ValueError(
+                f"serve_max_batch {self.serve_max_batch} exceeds the largest "
+                f"bucket {self.serve_buckets[-1]} — a flush that size would "
+                "have no compiled shape (raise serve_buckets or lower it)"
+            )
+        if self.serve_flush_deadline_ms < 0:
+            raise ValueError(
+                f"serve_flush_deadline_ms must be >= 0, got {self.serve_flush_deadline_ms}"
+            )
+        if self.serve_queue_size < 1:
+            raise ValueError(
+                f"serve_queue_size must be >= 1, got {self.serve_queue_size}"
+            )
+        if self.serve_overload not in ("block", "reject"):
+            raise ValueError(
+                f"unknown serve_overload {self.serve_overload!r} (block | reject)"
+            )
+        if self.serve_reload_interval_s < 0 or self.serve_metrics_every_s < 0:
+            raise ValueError(
+                "serve_reload_interval_s and serve_metrics_every_s must be >= 0"
+            )
         if self.packed_update not in ("auto", "dense", "compact", "sorted"):
             raise ValueError(
                 f"unknown packed_update {self.packed_update!r} "
@@ -200,6 +244,19 @@ class Config:
                 "sorted whole-tile-row RMW needs the element accumulator)"
             )
         return self
+
+
+def validate_buckets(buckets) -> tuple[int, ...]:
+    """Normalize a serve_buckets spec: positive ints, sorted, deduped,
+    non-empty.  Lives here (not serving/) so config validation stays
+    jax-free — serving/buckets.py imports it back."""
+    try:
+        out = tuple(sorted({int(b) for b in buckets}))
+    except (TypeError, ValueError) as e:
+        raise ValueError(f"serve_buckets must be integers, got {buckets!r}") from e
+    if not out or out[0] < 1:
+        raise ValueError(f"serve_buckets must be positive and non-empty, got {buckets!r}")
+    return out
 
 
 def _split(s: str) -> tuple[str, ...]:
@@ -296,6 +353,23 @@ def load_config(path: str) -> Config:
     p = "Predict"
     cfg.predict_files = get(p, "predict_files", _split_files, cfg.predict_files)
     cfg.score_path = get(p, "score_path", str, cfg.score_path)
+
+    s = "Serving"
+    cfg.serve_buckets = get(
+        s, "buckets", lambda v: tuple(int(x) for x in _split(v)), cfg.serve_buckets
+    )
+    cfg.serve_max_batch = get(s, "max_batch", int, cfg.serve_max_batch)
+    cfg.serve_flush_deadline_ms = get(
+        s, "flush_deadline_ms", float, cfg.serve_flush_deadline_ms
+    )
+    cfg.serve_queue_size = get(s, "queue_size", int, cfg.serve_queue_size)
+    cfg.serve_overload = get(s, "overload", str, cfg.serve_overload).lower()
+    cfg.serve_reload_interval_s = get(
+        s, "reload_interval_s", float, cfg.serve_reload_interval_s
+    )
+    cfg.serve_metrics_every_s = get(
+        s, "metrics_every_s", float, cfg.serve_metrics_every_s
+    )
 
     d = "Distributed"
     cfg.data_parallel = get(d, "data_parallel", int, cfg.data_parallel)

@@ -8,6 +8,8 @@ score path; dist variant shards input across workers).
 
 from __future__ import annotations
 
+from typing import Any, Callable, NamedTuple
+
 import jax
 import numpy as np
 
@@ -17,7 +19,90 @@ from fast_tffm_tpu.models.base import Batch
 from fast_tffm_tpu.training import _stream, scan_max_nnz
 from fast_tffm_tpu.trainer import init_state, make_predict_step
 
-__all__ = ["predict", "dist_predict"]
+__all__ = [
+    "ScoreFn",
+    "load_scoring_state",
+    "make_score_fn",
+    "predict",
+    "dist_predict",
+]
+
+
+class ScoreFn(NamedTuple):
+    """A jitted scoring function plus the static facts its callers need.
+
+    ``fn(state, batch) -> sigmoid scores [B]`` is the ONE single-host
+    scoring definition: the offline predict driver streams files through
+    it and the serving engine (serving/engine.py) dispatches micro-batches
+    to it — score parity between the two paths is structural, not tested
+    into existence (though tests/test_serving.py pins it anyway).
+    """
+
+    fn: Callable  # jitted (state, Batch) -> [B] sigmoid scores
+    model: Any  # built model (uses_fields, row_dim)
+    max_nnz: int  # static feature width every batch must carry
+
+    def __call__(self, state, batch: Batch):
+        return self.fn(state, batch)
+
+    @property
+    def uses_fields(self) -> bool:
+        return self.model.uses_fields
+
+    def cache_size(self) -> int | None:
+        """Compiled-program count (one per distinct batch shape) — how the
+        serving bucket ladder pins "zero steady-state recompiles"; None
+        when the JAX runtime doesn't expose the jit cache."""
+        f = getattr(self.fn, "_cache_size", None)
+        try:
+            return int(f()) if f is not None else None
+        except Exception:
+            return None
+
+
+def load_scoring_state(cfg: Config, log=print):
+    """Build the model and restore ``cfg.model_file`` into the configured
+    single-host inference layout: checkpoints hold LOGICAL arrays, so a
+    packed config lane-packs after the restore (plain packed, never the
+    fused RMW layout — scoring only gathers, and the plain gather serves
+    any checkpoint regardless of the accumulator it was trained with).
+
+    The one definition of "load a model for inference", shared by
+    ``predict()`` and the serving engine's startup AND hot reload — a
+    reload can never restore into a different layout than startup did.
+    """
+    model = build_model(cfg)
+    state = init_state(
+        model, jax.random.key(0), cfg.init_accumulator_value, cfg.adagrad_accumulator
+    )
+    state = restore_checkpoint(cfg.model_file, state)
+    log(f"restored {cfg.model_file} at step {int(state.step)}")
+    if cfg.table_layout == "packed":
+        from fast_tffm_tpu.trainer import pack_state
+
+        state = pack_state(state, cfg.init_accumulator_value)
+    return model, state
+
+
+def make_score_fn(cfg: Config, state, max_nnz: int, model=None) -> ScoreFn:
+    """The single-host scoring step for ``state``'s layout.
+
+    ``cfg.table_layout`` picks rows vs packed; ``state`` itself supplies
+    the fused evidence (pack_state's empty-accum marker), so a live
+    fused-packed trainer state scores through the fused gather without
+    any extra flag.  ``model`` avoids a rebuild when the caller already
+    has one; a rebuilt model is identical (pure function of cfg).
+    """
+    if model is None:
+        model = build_model(cfg)
+    if cfg.table_layout == "packed":
+        from fast_tffm_tpu.trainer import make_packed_predict_step
+
+        fused = state.table_opt.accum.size == 0
+        fn = make_packed_predict_step(model, fused=fused)
+    else:
+        fn = make_predict_step(model)
+    return ScoreFn(fn=fn, model=model, max_nnz=int(max_nnz))
 
 
 def _run_predict(
@@ -115,23 +200,10 @@ def _run_predict(
 
 def predict(cfg: Config, log=print) -> str:
     """Single-device prediction — the reference's `predict` mode."""
-    model = build_model(cfg)
-    max_nnz = scan_max_nnz(cfg)
-    state = init_state(
-        model, jax.random.key(0), cfg.init_accumulator_value, cfg.adagrad_accumulator
-    )
-    state = restore_checkpoint(cfg.model_file, state)
-    if cfg.table_layout == "packed":
-        # Checkpoints hold logical arrays; pack for the lane-packed
-        # scoring path (ops/packed_table.py).
-        from fast_tffm_tpu.trainer import make_packed_predict_step, pack_state
-
-        state = pack_state(state, cfg.init_accumulator_value)
-        step = make_packed_predict_step(model)
-    else:
-        step = make_predict_step(model)
+    model, state = load_scoring_state(cfg, log)
+    score = make_score_fn(cfg, state, scan_max_nnz(cfg), model=model)
     return _run_predict(
-        cfg, state, step, max_nnz, log, with_fields=model.uses_fields
+        cfg, state, score.fn, score.max_nnz, log, with_fields=score.uses_fields
     )
 
 

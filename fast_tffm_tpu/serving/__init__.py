@@ -1,0 +1,45 @@
+"""Online serving subsystem: micro-batched, bucket-compiled inference.
+
+The offline drivers (prediction.py) stream whole files; this package is
+the low-latency ONLINE path the ROADMAP north star ("serves heavy traffic
+from millions of users") asks for.  In-process, no network layer — a
+transport (gRPC/HTTP) would wrap ``ServingEngine.submit_line`` without
+touching anything here.
+
+Pieces (DESIGN.md "Serving"):
+
+  * ``BucketLadder`` (buckets.py) — predict functions pre-compiled at a
+    ladder of batch sizes; requests pad up to the nearest bucket so no
+    request ever triggers a fresh XLA compile in steady state;
+  * ``ServingEngine`` (engine.py) — micro-batching collector (flush on
+    ``serve_max_batch`` or the ``serve_flush_deadline_ms`` timer),
+    bounded admission queue (block | reject), hot checkpoint reload with
+    atomic swap between flushes;
+  * ``ServingMetrics`` (metrics.py) — queue/compute latency histograms
+    (p50/p95/p99), batch occupancy, reload counters, exported through the
+    existing utils.tracing.MetricsLogger JSONL path.
+
+``tools/loadgen.py`` drives the engine open-loop (Poisson) or closed-loop
+and emits a BENCH_SERVE JSON, the serving analog of bench.py's train
+BENCH files.
+"""
+
+from fast_tffm_tpu.serving.buckets import BucketLadder, validate_buckets
+from fast_tffm_tpu.serving.engine import (
+    EngineClosed,
+    OverloadError,
+    ServingEngine,
+    serve_lines,
+)
+from fast_tffm_tpu.serving.metrics import LatencyHistogram, ServingMetrics
+
+__all__ = [
+    "BucketLadder",
+    "EngineClosed",
+    "LatencyHistogram",
+    "OverloadError",
+    "ServingEngine",
+    "ServingMetrics",
+    "serve_lines",
+    "validate_buckets",
+]

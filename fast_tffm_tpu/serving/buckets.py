@@ -1,0 +1,117 @@
+"""Bucketed compile ladder: pre-jitted predict shapes, pad-to-bucket.
+
+XLA compiles one program per input shape.  An online engine that
+dispatched every micro-batch at its natural size would compile on the
+hot path whenever a new size showed up — tens of ms to seconds of
+latency cliff, at p99, exactly where it hurts.  The ladder fixes the
+shape vocabulary up front: a small ascending set of batch sizes
+(default 1/8/64/512), every flush padded up to the nearest bucket, every
+bucket compiled ONCE at startup by an explicit warmup pass.  Steady
+state then never sees a compile — pinned by ``compile_count()`` staying
+flat (tests/test_serving.py, tools/loadgen.py).
+
+Padding rows are all-zero with weight 0: the score function evaluates
+them (sigmoid(0) rows that cost a few flops) and the engine slices them
+off before resolving futures — the same neutral-padding contract the
+offline drivers use for short tail batches.
+"""
+
+from __future__ import annotations
+
+import bisect
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fast_tffm_tpu.config import validate_buckets
+from fast_tffm_tpu.models.base import Batch
+
+__all__ = ["BucketLadder", "validate_buckets"]
+
+
+class BucketLadder:
+    """Routes n-row flushes to the smallest compiled bucket >= n.
+
+    ``score`` is a prediction.ScoreFn; the ladder owns no state — the
+    engine passes the CURRENT serving state at every call, which is what
+    lets hot reload swap states without touching compiled programs (the
+    programs are shape-keyed, not weight-keyed).
+    """
+
+    def __init__(self, score, buckets):
+        self._score = score
+        self.buckets = validate_buckets(buckets)
+        self.max_nnz = score.max_nnz
+        self.uses_fields = score.uses_fields
+        self.warmed = False
+
+    @property
+    def max_batch(self) -> int:
+        return self.buckets[-1]
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket >= n.  Callers cap flushes at ``max_batch``, so
+        an overflow here is an engine bug, not an input condition."""
+        if n < 1 or n > self.buckets[-1]:
+            raise ValueError(f"flush of {n} rows outside buckets {self.buckets}")
+        return self.buckets[bisect.bisect_left(self.buckets, n)]
+
+    def _empty(self, bucket: int) -> tuple[np.ndarray, ...]:
+        w = self.max_nnz
+        fw = w if self.uses_fields else 0
+        return (
+            np.zeros((bucket,), np.float32),  # labels (unused by scoring)
+            np.zeros((bucket, w), np.int32),  # ids
+            np.zeros((bucket, w), np.float32),  # vals
+            np.zeros((bucket, fw), np.int32),  # fields
+            np.zeros((bucket,), np.float32),  # weights (0 = padding row)
+        )
+
+    def _batch(self, bucket: int, rows=()) -> Batch:
+        """The ONE definition of a dispatched batch's shape: ``rows``
+        placed over an all-padding base.  warmup() and assemble() both
+        build through here, so a warmed shape can never diverge from a
+        flushed shape (which would defeat the compile ladder)."""
+        labels, ids, vals, fields, weights = self._empty(bucket)
+        for i, (rid, rval, rfld) in enumerate(rows):
+            ids[i] = rid
+            vals[i] = rval
+            if self.uses_fields:
+                fields[i] = rfld
+        weights[: len(rows)] = 1.0
+        return Batch(
+            labels=jnp.asarray(labels),
+            ids=jnp.asarray(ids),
+            vals=jnp.asarray(vals),
+            fields=jnp.asarray(fields),
+            weights=jnp.asarray(weights),
+        )
+
+    def assemble(self, rows) -> tuple[Batch, int]:
+        """Stack parsed request rows [(ids, vals, fields), ...] into one
+        device Batch padded up to the nearest bucket.  Each row is already
+        width-``max_nnz`` (submit-time parsing fixed it), so assembly is
+        pure row placement — no per-flush width decisions that could
+        produce an unladdered shape."""
+        bucket = self.bucket_for(len(rows))
+        return self._batch(bucket, rows), bucket
+
+    def warmup(self, state) -> int:
+        """Compile every bucket ONCE, before traffic: score an all-padding
+        batch per rung and block until the results (hence the programs)
+        are ready.  Returns the compiled-program count afterwards (None
+        becomes -1 when the runtime hides the jit cache)."""
+        for bucket in self.buckets:
+            jax.block_until_ready(self._score(state, self._batch(bucket)))
+        self.warmed = True
+        n = self.compile_count()
+        return -1 if n is None else n
+
+    def compile_count(self) -> int | None:
+        """Programs compiled so far for the scoring function — flat after
+        warmup is the no-steady-state-recompiles invariant."""
+        return self._score.cache_size()
+
+    def score(self, state, batch: Batch):
+        return self._score(state, batch)

@@ -1,0 +1,544 @@
+"""The in-process serving engine: admission → micro-batch → bucket → score.
+
+Request lifecycle:
+
+  1. ``submit_line`` / ``submit`` parses the request to the static
+     ``max_nnz`` width and enqueues it on the BOUNDED admission queue.
+     Overload policy (``serve_overload``): ``block`` applies
+     backpressure to the caller; ``reject`` raises OverloadError
+     immediately — the queue is the only elastic buffer, so memory under
+     overload is capped at ``serve_queue_size`` requests either way.
+  2. The collector thread gathers requests and flushes when
+     ``serve_max_batch`` fills OR ``serve_flush_deadline_ms`` expires
+     for the oldest pending request — whichever first.  The deadline is
+     the latency/occupancy knob: 0 serves every request the moment it is
+     seen (occupancy→1/bucket), large values fill buckets (throughput).
+  3. A flush pads up to the nearest compile-ladder bucket
+     (buckets.BucketLadder — no steady-state XLA compiles), scores,
+     slices the padding off, and resolves per-request futures.
+  4. A watcher thread polls ``model_file``; a changed checkpoint is
+     restored OFF the hot path into a fresh state and staged; the
+     collector swaps it in ATOMICALLY between flushes — no flush ever
+     sees half-old half-new weights, and a torn/partial checkpoint write
+     fails the stage (counted, retried next tick) without touching the
+     serving state.
+
+Single-device by design: one process, one chip (or CPU), the deployment
+unit a load balancer replicates.  The mesh-sharded offline path
+(dist_predict) stays the batch tool for backfills.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from fast_tffm_tpu.checkpoint import checkpoint_signature
+from fast_tffm_tpu.config import Config
+from fast_tffm_tpu.data.libsvm import parse_lines
+from fast_tffm_tpu.serving.buckets import BucketLadder
+from fast_tffm_tpu.serving.metrics import ServingMetrics
+from fast_tffm_tpu.utils.tracing import MetricsLogger
+
+__all__ = ["ServingEngine", "OverloadError", "EngineClosed", "serve_lines"]
+
+
+class OverloadError(RuntimeError):
+    """Admission queue full under serve_overload = reject."""
+
+
+class EngineClosed(RuntimeError):
+    """Request submitted to (or unresolved inside) a closed engine."""
+
+
+_CLOSE = object()  # collector shutdown sentinel
+
+
+@dataclass
+class _Request:
+    row: tuple  # (ids [max_nnz] i32, vals [max_nnz] f32, fields [max_nnz] i32)
+    future: Future = field(default_factory=Future)
+    t_submit: float = field(default_factory=time.perf_counter)
+
+
+class ServingEngine:
+    """See module docstring.  Construct with a validated Config whose
+    ``model_file`` holds a restorable checkpoint; scoring runs through
+    the same ScoreFn as ``prediction.predict`` — bit-identical per batch
+    shape (pinned by tests/test_serving.py); against predict's own
+    differently-shaped batches, agreement is within a few float32 ULPs
+    on backends where XLA programs of different shapes round apart."""
+
+    def __init__(self, cfg: Config, log=print, state=None, model=None):
+        from fast_tffm_tpu.prediction import load_scoring_state, make_score_fn
+        from fast_tffm_tpu.training import scan_max_nnz
+
+        self._cfg = cfg
+        self._log = log
+        if cfg.max_nnz <= 0 and not (
+            cfg.train_files or cfg.validation_files or cfg.predict_files
+        ):
+            raise ValueError(
+                "serving needs a static feature width: set max_nnz in [Train], "
+                "or configure data files for the width scan"
+            )
+        max_nnz = scan_max_nnz(cfg)
+        if state is None:
+            # Baseline reload signature BEFORE the (possibly multi-second)
+            # restore: a trainer save landing mid-restore must read as
+            # "new" to the watcher, not as already-loaded — worst case it
+            # redundantly reloads the checkpoint we started from.
+            self._loaded_sig = checkpoint_signature(cfg.model_file)
+            model, state = load_scoring_state(cfg, log)
+        else:
+            # Injected state: the on-disk checkpoint was NEVER loaded, so
+            # no signature is "already loaded" — whatever model_file holds
+            # (even something older than this baseline) is news to us.
+            self._loaded_sig = None
+        self._state = state
+        self._score = make_score_fn(cfg, state, max_nnz, model=model)
+        if (
+            cfg.serve_reload_interval_s > 0
+            and cfg.table_layout == "packed"
+            and state.table_opt.accum.size == 0
+        ):
+            # An injected FUSED-packed state (empty-accum marker) compiled
+            # a fused-gather ScoreFn, but the watcher's load_scoring_state
+            # restores plain-packed — a swap would feed a D-stride table
+            # to D+1-stride tile arithmetic: clamped gathers, confidently
+            # wrong scores, no error.  Refuse the combination up front.
+            raise ValueError(
+                "hot reload (serve_reload_interval_s > 0) cannot re-pack "
+                "checkpoints into an injected fused-packed state's layout — "
+                "pass a plain-packed/rows state, or disable the watcher"
+            )
+        self._ladder = BucketLadder(self._score, cfg.serve_buckets)
+        self.max_batch = cfg.serve_max_batch or self._ladder.max_batch
+        if self.max_batch > self._ladder.max_batch:
+            raise ValueError(
+                f"serve_max_batch {self.max_batch} exceeds the largest bucket "
+                f"{self._ladder.max_batch} — a flush that size has no compiled shape"
+            )
+        self.deadline_s = cfg.serve_flush_deadline_ms / 1e3
+        self._policy = cfg.serve_overload
+        self._q: queue.Queue = queue.Queue(maxsize=cfg.serve_queue_size)
+        self.metrics = ServingMetrics()
+        self._metrics_logger = MetricsLogger(cfg.metrics_path)
+        self._metrics_every = cfg.serve_metrics_every_s
+        self._last_metrics_log = time.perf_counter()
+        self._closed = False  # no new submits (set by close AND by a
+        #   collector crash — see _collect's exception handler)
+        self._close_done = False  # close() finalization ran (separate
+        #   flag: a crash sets _closed, but close() must still write the
+        #   final metrics record and join the watcher afterwards)
+        self._stop = threading.Event()
+        # Hot-reload handoff: the watcher STAGES a fully-restored state
+        # here; the collector SWAPS it in between flushes.  One lock, two
+        # one-line critical sections.
+        self._reload_lock = threading.Lock()
+        self._staged_state = None
+        self._staged_step = None
+
+        n = self._ladder.warmup(self._state)
+        log(
+            f"serving: warmed buckets {self._ladder.buckets} "
+            f"(max_nnz {max_nnz}, {n if n >= 0 else '?'} compiled programs, "
+            f"flush deadline {cfg.serve_flush_deadline_ms}ms, "
+            f"queue {cfg.serve_queue_size} {self._policy})"
+        )
+        self._collector = threading.Thread(
+            target=self._collect, name="serve-collector", daemon=True
+        )
+        self._collector.start()
+        self._watcher = None
+        if cfg.serve_reload_interval_s > 0:
+            self._watcher = threading.Thread(
+                target=self._watch, name="serve-reload", daemon=True
+            )
+            self._watcher.start()
+
+    # -- submission ------------------------------------------------------
+
+    @property
+    def buckets(self) -> tuple[int, ...]:
+        return self._ladder.buckets
+
+    @property
+    def step(self) -> int:
+        """Step of the state CURRENTLY serving (advances at the first
+        flush after a reload swap, not when the watcher stages)."""
+        return int(self._state.step)
+
+    def compile_count(self) -> int | None:
+        return self._ladder.compile_count()
+
+    def submit_line(self, line: str) -> Future:
+        """Submit one libsvm/libffm line (``label feat:val ...`` — the
+        label is required by the grammar and ignored, the exact format of
+        predict_files).  Returns a Future resolving to the float score.
+        Malformed lines and rows wider than max_nnz raise ValueError in
+        the caller (admission is never charged for parse errors)."""
+        parsed = parse_lines(
+            [line],
+            vocabulary_size=self._cfg.vocabulary_size,
+            hash_feature_id_flag=self._cfg.hash_feature_id,
+            max_nnz=self._score.max_nnz,
+        )
+        return self._submit_row(
+            (
+                parsed.ids[0].astype(np.int32, copy=False),
+                parsed.vals[0],
+                parsed.fields[0],
+            )
+        )
+
+    def submit(self, ids, vals, fields=None) -> Future:
+        """Submit one pre-parsed example (1-D ids/vals[/fields], up to
+        max_nnz entries; zero-padded here).  The programmatic twin of
+        submit_line for callers that skip text."""
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        vals = np.asarray(vals, np.float32).reshape(-1)
+        w = self._score.max_nnz
+        if ids.shape != vals.shape or ids.size > w:
+            raise ValueError(
+                f"ids/vals must match and carry <= max_nnz={w} entries, "
+                f"got {ids.shape} / {vals.shape}"
+            )
+        v = self._cfg.vocabulary_size
+        if ids.size and (int(ids.min()) < 0 or int(ids.max()) >= v):
+            # Same range invariant parse_lines enforces on the text path:
+            # the jitted gather CLAMPS out-of-bounds ids, which would turn
+            # a caller bug into a confidently wrong score from an
+            # unrelated embedding row.
+            raise ValueError(
+                f"feature ids must lie in [0, {v}); got "
+                f"[{int(ids.min())}, {int(ids.max())}]"
+            )
+        fields = (
+            np.zeros(ids.shape, np.int32)
+            if fields is None
+            else np.asarray(fields, np.int32).reshape(-1)
+        )
+        if fields.shape != ids.shape:
+            raise ValueError(f"fields shape {fields.shape} != ids shape {ids.shape}")
+        pad = w - ids.size
+        if pad:
+            ids = np.pad(ids, (0, pad))
+            vals = np.pad(vals, (0, pad))
+            fields = np.pad(fields, (0, pad))
+        return self._submit_row((ids, vals, fields))
+
+    def _submit_row(self, row) -> Future:
+        req = _Request(row)
+        if self._closed:
+            raise EngineClosed("engine is closed")
+        if self._policy == "reject":
+            try:
+                self._q.put_nowait(req)
+            except queue.Full:
+                self.metrics.on_submit(accepted=False)
+                raise OverloadError(
+                    f"admission queue full ({self._q.maxsize} pending) — "
+                    "overload; shed load or raise serve_queue_size / switch "
+                    "serve_overload to block"
+                ) from None
+        else:  # block: backpressure, re-checking closure so a shutdown
+            # mid-overload can't strand the caller forever.
+            while True:
+                if self._closed:
+                    raise EngineClosed("engine closed while blocked on admission")
+                try:
+                    self._q.put(req, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+        self.metrics.on_submit(accepted=True)
+        # Close-race epilogue: if close() finished its drain between our
+        # closed-check and our enqueue, nobody will ever pop this request.
+        # _closed is set BEFORE close joins/drains, so observing it here
+        # (after the put) and draining ourselves closes the window — the
+        # drain fails our own future with EngineClosed instead of
+        # stranding the caller.
+        if self._closed and not self._collector.is_alive():
+            self._drain_with_exception(EngineClosed("engine closed"))
+        return req.future
+
+    # -- collector -------------------------------------------------------
+
+    def _collect(self) -> None:
+        pending: list[_Request] = []
+        deadline = 0.0
+        draining = False
+        try:
+            while True:
+                if pending and len(pending) >= self.max_batch:
+                    self._flush(pending, deadline_fired=False)
+                    pending = []
+                    continue
+                timeout = None
+                if pending:
+                    timeout = deadline - time.perf_counter()
+                    if timeout <= 0:
+                        # Deadline expired: top up with already-QUEUED
+                        # requests first.  Under backlog the oldest
+                        # request's deadline is often already past when
+                        # it is popped; flushing it alone would collapse
+                        # micro-batching to singleton dispatches exactly
+                        # when load is highest.
+                        while len(pending) < self.max_batch:
+                            try:
+                                extra = self._q.get_nowait()
+                            except queue.Empty:
+                                break
+                            if extra is _CLOSE:
+                                draining = True
+                                break
+                            pending.append(extra)
+                        self._flush(
+                            pending,
+                            deadline_fired=len(pending) < self.max_batch,
+                        )
+                        pending = []
+                        continue
+                elif draining:
+                    # Close requested and everything flushed: done.
+                    return
+                try:
+                    item = self._q.get(timeout=timeout)
+                except queue.Empty:
+                    continue
+                if item is _CLOSE:
+                    # Flush what's pending plus anything still queued, in
+                    # max_batch groups, then exit.
+                    draining = True
+                    deadline = time.perf_counter()  # expire immediately
+                    continue
+                if not pending:
+                    # Deadline anchors at the oldest request's SUBMIT
+                    # time (the documented contract), so time it spent in
+                    # the admission queue behind a busy flush counts
+                    # against the budget — not just time in `pending`.
+                    deadline = item.t_submit + self.deadline_s
+                pending.append(item)
+        except BaseException as e:  # never strand submitted futures
+            # Mark the engine closed FIRST: with a dead collector, a
+            # block-policy submit would otherwise spin on the full queue
+            # forever (nothing consumes, nothing raises).  Stop the
+            # watcher too — it would keep doing full restores every tick
+            # on an engine that can no longer serve.
+            self._closed = True
+            self._stop.set()
+            for r in pending:
+                if not r.future.done():
+                    r.future.set_exception(e)
+            self._drain_with_exception(e)
+            raise
+        finally:
+            self._drain_with_exception(EngineClosed("engine closed"))
+
+    def _drain_with_exception(self, exc: BaseException) -> None:
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                return
+            if item is not _CLOSE and not item.future.done():
+                item.future.set_exception(exc)
+
+    def _flush(self, pending: list[_Request], deadline_fired: bool) -> None:
+        # Atomic reload swap: flushes are the only reader of _state, so
+        # swapping here means every request in THIS flush (and all later
+        # ones) scores against one consistent checkpoint.
+        with self._reload_lock:
+            staged, self._staged_state = self._staged_state, None
+            staged_step = self._staged_step
+        if staged is not None:
+            self._state = staged
+            self.metrics.on_reload(ok=True)
+            try:
+                self._log(f"serving: swapped in checkpoint step {staged_step}")
+            except Exception:
+                pass  # a raising log callback must not kill the collector
+        # Claim the futures: a pending Future is always cancellable, and
+        # resolving a cancelled one raises InvalidStateError — which,
+        # unguarded, would kill the collector over ONE impatient caller.
+        # set_running_or_notify_cancel() both blocks late cancels and
+        # filters already-cancelled requests out of the batch.
+        pending = [r for r in pending if r.future.set_running_or_notify_cancel()]
+        if not pending:
+            return
+        t_start = time.perf_counter()
+        try:
+            batch, bucket = self._ladder.assemble([r.row for r in pending])
+            t_dispatch = time.perf_counter()
+            scores = np.asarray(self._ladder.score(self._state, batch))
+            t_done = time.perf_counter()
+        except BaseException as e:
+            for r in pending:
+                if not r.future.done():
+                    r.future.set_exception(e)
+            try:
+                self._log(f"serving: flush failed: {e!r}")
+            except Exception:
+                pass
+            return
+        for i, r in enumerate(pending):
+            r.future.set_result(float(scores[i]))
+        t_resolved = time.perf_counter()
+        self.metrics.on_flush(
+            bucket,
+            len(pending),
+            queue_waits=[t_start - r.t_submit for r in pending],
+            compute_s=t_done - t_dispatch,
+            total_s=[t_resolved - r.t_submit for r in pending],
+            deadline_fired=deadline_fired,
+        )
+        if (
+            self._metrics_every > 0
+            and t_resolved - self._last_metrics_log >= self._metrics_every
+        ):
+            self._last_metrics_log = t_resolved
+            try:
+                self.metrics.log_to(self._metrics_logger)
+            except Exception:
+                # A full metrics disk (ENOSPC) must degrade to lost
+                # metrics records, never to a dead collector: every
+                # request behind a dead collector hangs or blocks.
+                pass
+
+    # -- hot reload ------------------------------------------------------
+
+    def _watch(self) -> None:
+        from fast_tffm_tpu.prediction import load_scoring_state
+
+        while not self._stop.wait(self._cfg.serve_reload_interval_s):
+            sig = checkpoint_signature(self._cfg.model_file)
+            if sig is None or sig == self._loaded_sig:
+                continue
+            try:
+                # Full restore OFF the hot path: the collector keeps
+                # serving the old state while this loads.
+                _, state = load_scoring_state(self._cfg, log=lambda *_: None)
+            except Exception as e:
+                # Torn write (non-atomic writer, or a checkpoint mid-copy):
+                # count it, keep serving, retry next tick.  The signature
+                # is NOT advanced, so a later complete write reloads.
+                self.metrics.on_reload(ok=False)
+                self._log(f"serving: reload of {self._cfg.model_file} failed: {e!r}")
+                continue
+            self._loaded_sig = sig
+            with self._reload_lock:
+                self._staged_state = state
+                self._staged_step = int(state.step)
+
+    # -- shutdown --------------------------------------------------------
+
+    def metrics_snapshot(self) -> dict:
+        return self.metrics.snapshot()
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Stop accepting, flush everything already admitted, stop the
+        threads, write the final metrics record.  Idempotent."""
+        if self._close_done:
+            return
+        self._close_done = True
+        self._closed = True
+        self._stop.set()
+        # Bounded-queue etiquette: a live collector will make room for
+        # the sentinel; a DEAD one (flush raised) never will — don't
+        # block close() forever on its full queue.
+        while True:
+            try:
+                self._q.put(_CLOSE, timeout=0.1)
+                break
+            except queue.Full:
+                if not self._collector.is_alive():
+                    break
+        self._collector.join(timeout=timeout)
+        # A submit that passed the closed-check concurrently with this
+        # close can enqueue AFTER the collector's exit drain — fail its
+        # future rather than strand the caller (submit re-checks too).
+        self._drain_with_exception(EngineClosed("engine closed"))
+        if self._watcher is not None:
+            self._watcher.join(timeout=timeout)
+        try:
+            # Same stance as the in-flush writes: a metrics I/O failure
+            # (ENOSPC) degrades to a lost record, it must not turn an
+            # otherwise-successful serve run into a nonzero exit.
+            self.metrics.log_to(self._metrics_logger)
+        except Exception:
+            pass
+        finally:
+            self._metrics_logger.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def serve_lines(cfg: Config, lines=None, out=None, log=print) -> int:
+    """The ``serve`` CLI verb: stream libsvm lines (default stdin) through
+    a ServingEngine, writing one ``%.6f`` score per input line in input
+    order — wire-compatible with predict's score file, but micro-batched
+    through the online path.  A bounded future window keeps memory flat on
+    arbitrarily long input; under serve_overload = reject the writer is
+    its own load-shedder (drains a result, retries) so file-fed serving
+    never drops a line."""
+    import sys
+    from collections import deque
+
+    lines = sys.stdin if lines is None else lines
+    out = sys.stdout if out is None else out
+    window: deque = deque()
+    n = 0
+
+    def write_next(block: bool = True) -> bool:
+        """Pop-and-write the oldest future; False when it isn't done yet
+        (non-blocking mode) or nothing is in flight."""
+        nonlocal n
+        if not window or (not block and not window[0].done()):
+            return False
+        out.write(f"{window.popleft().result():.6f}\n")
+        n += 1
+        return True
+
+    with ServingEngine(cfg, log=log) as engine:
+        cap = max(4 * engine.max_batch, 1024)
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            while True:
+                try:
+                    window.append(engine.submit_line(line))
+                    break
+                except OverloadError:
+                    if not write_next():  # nothing of ours in flight:
+                        time.sleep(engine.deadline_s or 0.001)
+            # Opportunistic in-order drain: a LIVE stream (slow stdin
+            # producer) must see each score as soon as it resolves, not
+            # in cap-sized bursts at EOF.
+            wrote = False
+            while write_next(block=False):
+                wrote = True
+            while len(window) >= cap:  # bound memory on a fast producer
+                wrote = write_next() or wrote
+            if wrote:
+                out.flush()
+        while write_next():
+            pass
+        out.flush()
+        snap = engine.metrics_snapshot()
+    log(
+        f"served {n} scores: occupancy {snap['batch_occupancy']}, "
+        f"p50/p99 total {snap['total_ms'].get('p50')}/"
+        f"{snap['total_ms'].get('p99')}ms, reloads {snap['reloads']}"
+    )
+    return 0

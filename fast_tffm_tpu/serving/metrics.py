@@ -1,0 +1,177 @@
+"""Serving-side metrics: latency histograms, occupancy, reload counters.
+
+Online latency is a distribution, not a mean — an overloaded collector
+shows up at p99 long before it moves the average.  ``LatencyHistogram``
+keeps fixed log-spaced bins (O(bins) memory for any request count, the
+same bounded-memory stance as metrics.StreamingAUC) and interpolates
+quantiles inside the hit bin; ``ServingMetrics`` aggregates the per-stage
+histograms plus the engine's counters and renders one flat JSONL record
+for utils.tracing.MetricsLogger.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+__all__ = ["LatencyHistogram", "ServingMetrics"]
+
+
+class LatencyHistogram:
+    """Fixed log-spaced latency histogram with interpolated quantiles.
+
+    Bins span [lo, hi) seconds geometrically (default 10µs..100s, 120
+    bins → ~13% resolution per bin, tighter than any SLO anyone sets);
+    samples outside clamp to the edge bins, and exact min/max/sum ride
+    along so the snapshot never lies about the tails' extremes.
+    """
+
+    def __init__(self, lo: float = 1e-5, hi: float = 100.0, bins: int = 120):
+        if not (0 < lo < hi) or bins < 2:
+            raise ValueError(f"bad histogram spec lo={lo} hi={hi} bins={bins}")
+        self._edges = np.geomspace(lo, hi, bins + 1)
+        self._counts = np.zeros(bins, np.int64)
+        self._n = 0
+        self._sum = 0.0
+        self._min = float("inf")
+        self._max = 0.0
+
+    def add(self, seconds: float) -> None:
+        i = int(np.searchsorted(self._edges, seconds, side="right")) - 1
+        self._counts[min(max(i, 0), self._counts.size - 1)] += 1
+        self._n += 1
+        self._sum += seconds
+        self._min = min(self._min, seconds)
+        self._max = max(self._max, seconds)
+
+    @property
+    def count(self) -> int:
+        return self._n
+
+    def quantile(self, q: float) -> float:
+        """Value at quantile ``q`` (log-interpolated inside the hit bin);
+        nan when empty.  Clamped by the exact min/max so a one-sample
+        histogram reports the sample, not its bin edge."""
+        if self._n == 0:
+            return float("nan")
+        target = q * self._n
+        cum = np.cumsum(self._counts)
+        i = int(np.searchsorted(cum, target, side="left"))
+        i = min(i, self._counts.size - 1)
+        prev = float(cum[i - 1]) if i > 0 else 0.0
+        inbin = float(self._counts[i])
+        frac = (target - prev) / inbin if inbin > 0 else 0.0
+        lo, hi = self._edges[i], self._edges[i + 1]
+        v = float(lo * (hi / lo) ** min(max(frac, 0.0), 1.0))
+        return min(max(v, self._min), self._max)
+
+    def snapshot(self) -> dict:
+        """{count, mean, p50, p95, p99, max} in MILLISECONDS (the unit
+        every serving dashboard speaks; raw seconds would misread 1000x)."""
+        if self._n == 0:
+            return {"count": 0}
+        ms = 1e3
+        return {
+            "count": self._n,
+            "mean": round(self._sum / self._n * ms, 3),
+            "p50": round(self.quantile(0.50) * ms, 3),
+            "p95": round(self.quantile(0.95) * ms, 3),
+            "p99": round(self.quantile(0.99) * ms, 3),
+            "max": round(self._max * ms, 3),
+        }
+
+
+class ServingMetrics:
+    """Aggregate serving counters + per-stage latency histograms.
+
+    Writers: ``submit`` callers (requests/rejected) and the collector
+    thread (everything else) — one lock covers both; every op is O(1) so
+    contention is noise next to a flush's device dispatch.
+
+    Stages: ``queue`` (submit → flush start: micro-batching wait +
+    deadline), ``compute`` (device dispatch → scores on host, whole
+    flush), ``total`` (submit → future resolved, what a caller feels).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.queue = LatencyHistogram()
+        self.compute = LatencyHistogram()
+        self.total = LatencyHistogram()
+        self.requests = 0
+        self.rejected = 0
+        self.flushes = 0
+        self.flushes_deadline = 0  # timer fired before max_batch filled
+        self.flushes_full = 0  # max_batch filled before the timer
+        self.rows = 0  # real rows scored (excl. bucket padding)
+        self.padded_rows = 0  # bucket-padding rows scored and discarded
+        self.reloads = 0
+        self.reload_failures = 0  # watcher restore attempts that raised
+        self.bucket_rows: dict[int, int] = {}  # bucket size -> real rows
+
+    def on_submit(self, accepted: bool) -> None:
+        with self._lock:
+            self.requests += 1
+            if not accepted:
+                self.rejected += 1
+
+    def on_flush(
+        self,
+        bucket: int,
+        n_rows: int,
+        queue_waits: list[float],
+        compute_s: float,
+        total_s: list[float],
+        deadline_fired: bool,
+    ) -> None:
+        with self._lock:
+            self.flushes += 1
+            if deadline_fired:
+                self.flushes_deadline += 1
+            else:
+                self.flushes_full += 1
+            self.rows += n_rows
+            self.padded_rows += bucket - n_rows
+            self.bucket_rows[bucket] = self.bucket_rows.get(bucket, 0) + n_rows
+            self.compute.add(compute_s)
+            for w in queue_waits:
+                self.queue.add(w)
+            for t in total_s:
+                self.total.add(t)
+
+    def on_reload(self, ok: bool) -> None:
+        with self._lock:
+            if ok:
+                self.reloads += 1
+            else:
+                self.reload_failures += 1
+
+    def snapshot(self) -> dict:
+        """One flat dict (JSONL-ready).  Latencies in ms, keyed per stage;
+        occupancy in [0, 1]; bucket_rows keyed by stringified bucket size
+        (JSON objects take string keys)."""
+        with self._lock:
+            scored = self.rows + self.padded_rows
+            return {
+                "requests": self.requests,
+                "rejected": self.rejected,
+                "flushes": self.flushes,
+                "flushes_deadline": self.flushes_deadline,
+                "flushes_full": self.flushes_full,
+                "rows": self.rows,
+                "padded_rows": self.padded_rows,
+                "batch_occupancy": round(self.rows / scored, 4) if scored else None,
+                "reloads": self.reloads,
+                "reload_failures": self.reload_failures,
+                "bucket_rows": {str(k): v for k, v in sorted(self.bucket_rows.items())},
+                "queue_ms": self.queue.snapshot(),
+                "compute_ms": self.compute.snapshot(),
+                "total_ms": self.total.snapshot(),
+            }
+
+    def log_to(self, metrics_logger) -> None:
+        """Append the snapshot to a utils.tracing.MetricsLogger (no-op
+        logger ⇒ no-op here), tagged so serving records can be filtered
+        out of a shared train/serve metrics file."""
+        metrics_logger.log(kind="serving", **self.snapshot())

@@ -259,11 +259,18 @@ def _run_training(
     evaluate=None,
     extra_metrics=None,
     saveable=None,
+    step_hook=None,
 ):
     """Shared step loop.  ``train_stream(epoch)`` overrides the per-epoch
     input stream, ``to_batch(parsed, w)`` the host→device batch assembly,
     and ``evaluate`` the validation pass — the multi-host path plugs in
     sharded input + global-array stitching here without forking the loop.
+
+    ``step_hook(step_num)`` (optional) runs in the LOOP THREAD after every
+    dispatch, before the graceful-stop check — a deterministic injection
+    point for tests (e.g. raising SIGTERM at an exact step instead of
+    racing a wall-clock timer) and for external schedulers.  It must be
+    cheap: it sits on the hot path.
 
     Step fusion (``steps_per_call`` > 1) needs no fork either: a fused
     ``step_fn`` returns a PER-MICRO-STEP loss vector [K] instead of a
@@ -364,6 +371,11 @@ def _run_training(
                     meter.add(sum(p.batch_size for p in parsed))
                 else:
                     meter.add(parsed.batch_size)
+                if step_hook is not None:
+                    # Before the stop check: a hook that raises a signal
+                    # here is honored on THIS iteration (the handler sets
+                    # stop_requested in this same thread).
+                    step_hook(step_num)
                 if stop_requested.is_set():
                     break
                 if pending_steps >= cfg.log_every:
@@ -436,7 +448,7 @@ def _run_training(
     return state
 
 
-def train(cfg: Config, *, resume: bool = False, log=print):
+def train(cfg: Config, *, resume: bool = False, log=print, step_hook=None):
     """Local (single-device) training — the reference's `train` mode."""
     if not cfg.train_files:
         raise ValueError("no train_files configured")
@@ -539,10 +551,11 @@ def train(cfg: Config, *, resume: bool = False, log=print):
             cfg, state, step_fn, predict_step, max_nnz, log,
             train_stream=train_stream, to_batch=to_batch,
             examples_per_step=examples_per_step, saveable=saveable,
+            step_hook=step_hook,
         )
     return _run_training(
         cfg, state, step_fn, predict_step, max_nnz, log, to_batch=to_batch,
-        saveable=saveable,
+        saveable=saveable, step_hook=step_hook,
     )
 
 
@@ -642,7 +655,7 @@ def _device_cached_input(cfg: Config, model, max_nnz: int, log, body=None):
     return step_fn, train_stream, cfg.batch_size
 
 
-def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
+def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None, step_hook=None):
     """Mesh-distributed training — the reference's `dist_train` mode.
 
     One SPMD program over all visible chips; no job_name/task_index because
@@ -965,4 +978,5 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None):
         evaluate=evaluate,
         extra_metrics=extra_metrics,
         saveable=dist_saveable,
+        step_hook=step_hook,
     )

@@ -146,6 +146,46 @@ def test_cli_train_predict_subprocess(workdir):
     assert (workdir / "scores.txt").exists()
 
 
+def test_cli_serve_subprocess(workdir):
+    """`serve` verb: stdin lines -> stdout scores, identical to the
+    predict score file written by test's offline run of the same
+    checkpoint (serving/ engine underneath; logs stay on stderr)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "fast_tffm.py"), "train", str(workdir / "run.cfg")],
+        capture_output=True,
+        text=True,
+        env=env,
+        cwd=REPO,
+    )
+    assert r.returncode == 0, r.stderr
+    cfg = load_config(str(workdir / "run.cfg"))
+    predict(cfg, log=lambda *_: None)
+    want = open(cfg.score_path).read()
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "fast_tffm.py"), "serve", str(workdir / "run.cfg")],
+        input=open(workdir / "valid.libsvm").read(),
+        capture_output=True,
+        text=True,
+        env=env,
+        cwd=REPO,
+    )
+    assert r.returncode == 0, r.stderr
+    # Wire-compatible with the predict score file: same count/order/%.6f
+    # format.  Values compare as floats at one format-ULP — predict runs
+    # a batch_size-shaped XLA program, serving runs bucket-shaped ones,
+    # and cross-program drift on this backend is a few float32 ULPs
+    # (same rationale as the relaxed asserts in test_optim_trainer.py).
+    got_lines = r.stdout.splitlines()
+    want_lines = want.splitlines()
+    assert len(got_lines) == len(want_lines)
+    assert all(len(l.split(".")[1]) == 6 for l in got_lines)
+    np.testing.assert_allclose(
+        [float(x) for x in got_lines], [float(x) for x in want_lines], atol=2e-6
+    )
+    assert "warmed buckets" in r.stderr  # engine logs stayed off stdout
+
+
 def test_cli_convert_packs_configured_files(workdir):
     """`convert` pre-builds the FMB cache for every configured data file,
     and a second invocation reuses the fresh caches."""

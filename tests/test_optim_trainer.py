@@ -203,13 +203,22 @@ class TestRowAccumulator:
         step = make_sharded_train_step(model, 0.05, mesh, lookup=lookup)
         sharded, mloss = step(sharded, batch)
         np.testing.assert_allclose(float(sloss), float(mloss), rtol=1e-6)
-        np.testing.assert_array_equal(
+        # Few-ULP tolerance, not bit-identity: the single-device jit and
+        # the shard_map SPMD step are DIFFERENT XLA programs, and on the
+        # installed jax 0.4.37 CPU backend the row-mode Adagrad's
+        # sum(g²)·rsqrt sequence fuses/rounds differently between them
+        # (observed drift: 1/320 elements, 3.5e-10 abs ≈ 3 ULP at 1e-3).
+        # Bit-identity IS still pinned where one program serves both
+        # paths (tests/test_steps_per_call.py, device-cache parity).
+        np.testing.assert_allclose(
             np.asarray(jax.device_get(single.table)),
             np.asarray(jax.device_get(sharded.table))[:64],
+            rtol=1e-6, atol=1e-9,
         )
-        np.testing.assert_array_equal(
+        np.testing.assert_allclose(
             np.asarray(jax.device_get(single.table_opt.accum)),
             np.asarray(jax.device_get(sharded.table_opt.accum))[:64],
+            rtol=1e-6, atol=1e-9,
         )
 
     def test_restore_rejects_accumulator_mode_mismatch(self, tmp_path):

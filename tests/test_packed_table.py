@@ -717,29 +717,50 @@ def test_sharded_packed_dense_bitwise_matches_local_dense():
 @pytest.mark.parametrize(
     "mesh_shape", [(1, 8), (2, 4), (8, 1)], ids=lambda s: f"data{s[0]}xrow{s[1]}"
 )
-def test_sharded_fused_matches_sharded_row_mode(mesh_shape):
+def test_sharded_fused_matches_sharded_row_mode(mesh_shape, tmp_path):
     """The FUSED tile-row layout through the MESH-SHARDED step (round 5:
     fused_sharded_gather/update) tracks the rows-layout row-accumulator
     sharded step, its state unpacks to the same logical table, and the
-    fused sharded predict matches."""
+    fused sharded predict matches.
+
+    Both states restore ONE logical checkpoint (the dist-resume path)
+    rather than sharing a PRNG key: the packed sharded init draws its
+    table at the PACK-padded vocab size, and jax.random folds the array
+    size into the threefry counter pairing — same key at a different
+    padding is a completely different draw, so the old same-key premise
+    compared two unrelated inits (factor columns 90+% mismatched from
+    step 0, masked by the loss assert's insensitivity to ±0.01 factors).
+    From a shared checkpoint the two layouts track to ~1e-7."""
+    from fast_tffm_tpu.checkpoint import restore_checkpoint, save_checkpoint
     from fast_tffm_tpu.parallel import (
         init_sharded_state,
         make_mesh,
         make_sharded_predict_step,
         make_sharded_train_step,
+        pack_sharded_on_device,
         unpack_sharded_to_logical,
     )
+    from fast_tffm_tpu.parallel.train_step import packed_shard_meta
+    from fast_tffm_tpu.trainer import init_state
 
     model = FMModel(vocabulary_size=V, factor_num=4, order=2, factor_lambda=1e-4)
     mesh = make_mesh(*mesh_shape)
     rng = np.random.default_rng(60)
     batches = _batches(rng, n=3)
 
-    rs = init_sharded_state(model, mesh, jax.random.key(14), accumulator="row")
-    rstep = make_sharded_train_step(model, 0.1, mesh)
-    fs = init_sharded_state(
-        model, mesh, jax.random.key(14), accumulator="fused", table_layout="packed"
+    ck = str(tmp_path / "seed.npz")
+    save_checkpoint(ck, init_state(model, jax.random.key(14), accumulator="row"))
+
+    rs = restore_checkpoint(
+        ck, init_sharded_state(model, mesh, jax.random.key(0), accumulator="row")
     )
+    rstep = make_sharded_train_step(model, 0.1, mesh)
+    padded_model, _, _ = packed_shard_meta(model, mesh, fused=True)
+    logical = restore_checkpoint(
+        ck,
+        init_sharded_state(padded_model, mesh, jax.random.key(1), accumulator="fused"),
+    )
+    fs = pack_sharded_on_device(logical, model, mesh, 0.1, fused=True)
     fstep = make_sharded_train_step(
         model, 0.1, mesh, table_layout="packed", accumulator="fused",
         compact_cap=32, packed_update="compact",
@@ -841,7 +862,7 @@ def test_dist_train_fused_driver(tmp_path):
             ]
             f.write(f"{rng.integers(0, 2)} {' '.join(toks)}\n")
 
-    def run(tag, **kw):
+    def run(tag, resume=False, **kw):
         cfg = Config(
             model="fm", factor_num=4, vocabulary_size=V,
             model_file=str(tmp_path / f"m_{tag}.npz"),
@@ -850,7 +871,7 @@ def test_dist_train_fused_driver(tmp_path):
             metrics_path=str(tmp_path / f"jl_{tag}.jsonl"),
             row_parallel=4, data_parallel=2, **kw,
         ).validate()
-        dist_train(cfg, log=lambda *_: None)
+        dist_train(cfg, resume=resume, log=lambda *_: None)
         losses = [
             r["loss"]
             for r in map(json.loads, open(cfg.metrics_path).read().splitlines())
@@ -858,13 +879,28 @@ def test_dist_train_fused_driver(tmp_path):
         ]
         return cfg, losses
 
-    cfg_r, l_r = run("row", adagrad_accumulator="row")
+    # Both runs RESUME from one logical checkpoint: the same-key premise
+    # never held across layouts (the packed init draws at the PACK-padded
+    # vocab size, and jax.random folds the array size into the threefry
+    # counter pairing — a different padding is a different draw).  From a
+    # shared start the two layouts track to ~1e-7.
+    from fast_tffm_tpu.checkpoint import save_checkpoint
+    from fast_tffm_tpu.trainer import init_state as _init_state
+
+    seed_state = _init_state(
+        FMModel(vocabulary_size=V, factor_num=4), jax.random.key(7), accumulator="row"
+    )
+    save_checkpoint(str(tmp_path / "m_row.npz"), seed_state)
+    save_checkpoint(str(tmp_path / "m_fused.npz"), seed_state)
+
+    cfg_r, l_r = run("row", adagrad_accumulator="row", resume=True)
     cfg_f, l_f = run("fused", table_layout="packed",
-                     adagrad_accumulator="fused", packed_compact_cap=64)
+                     adagrad_accumulator="fused", packed_compact_cap=64,
+                     resume=True)
     np.testing.assert_allclose(l_f, l_r, rtol=1e-5)
     tr = np.load(cfg_r.model_file)["table"][:V]
     tf = np.load(cfg_f.model_file)["table"][:V]
-    np.testing.assert_allclose(tf, tr, rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(tf, tr, rtol=5e-5, atol=1e-7)
     # Resume continues from the fused checkpoint without error.
     dist_train(cfg_f, resume=True, log=lambda *_: None)
 

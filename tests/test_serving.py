@@ -1,0 +1,575 @@
+"""Online serving subsystem (serving/): parity, flushing, overload, reload.
+
+The acceptance contract: serving scores are BIT-IDENTICAL to the offline
+predict path for the same checkpoint and inputs (same ScoreFn underneath
+— structural, but pinned here anyway), and mixed-size traffic causes
+ZERO steady-state XLA recompiles after the warmup pass.
+"""
+
+import io
+import os
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from fast_tffm_tpu.checkpoint import save_checkpoint
+from fast_tffm_tpu.config import Config, build_model
+from fast_tffm_tpu.data.libsvm import parse_lines
+from fast_tffm_tpu.models.base import Batch
+from fast_tffm_tpu.serving import (
+    BucketLadder,
+    LatencyHistogram,
+    OverloadError,
+    ServingEngine,
+    validate_buckets,
+)
+from fast_tffm_tpu.trainer import init_state
+
+V = 128
+NNZ = 6
+
+
+def _lines(rng, n, nnz_lo=1, nnz_hi=NNZ):
+    """Mixed-width libsvm lines — every request size in [lo, hi]."""
+    out = []
+    for _ in range(n):
+        k = int(rng.integers(nnz_lo, nnz_hi + 1))
+        ids = rng.choice(V, size=k, replace=False)
+        vals = np.round(np.abs(rng.normal(size=k)) + 0.1, 4)
+        out.append(
+            f"{int(rng.integers(0, 2))} "
+            + " ".join(f"{i}:{v}" for i, v in zip(ids, vals))
+        )
+    return out
+
+
+def _cfg(tmp_path, **kw):
+    kw.setdefault("model", "fm")
+    kw.setdefault("factor_num", 4)
+    kw.setdefault("vocabulary_size", V)
+    kw.setdefault("max_nnz", NNZ)
+    kw.setdefault("model_file", str(tmp_path / "m.ckpt"))
+    kw.setdefault("serve_buckets", (1, 4, 16))
+    kw.setdefault("serve_flush_deadline_ms", 20.0)
+    return Config(**kw).validate()
+
+
+def _checkpoint(cfg, shift=0.5, step=0):
+    """Write a distinguishable-from-init checkpoint for cfg.model_file."""
+    model = build_model(cfg)
+    state = init_state(model, jax.random.key(0), cfg.init_accumulator_value)
+    state = state._replace(table=state.table + shift, step=state.step + step)
+    save_checkpoint(cfg.model_file, state)
+    return state
+
+
+def _offline_scores(cfg, lines):
+    """Reference scores through the SAME shared ScoreFn the offline
+    predict driver uses — the parity baseline."""
+    from fast_tffm_tpu.prediction import load_scoring_state, make_score_fn
+
+    model, state = load_scoring_state(cfg, log=lambda *_: None)
+    score = make_score_fn(cfg, state, NNZ, model=model)
+    parsed = parse_lines(lines, vocabulary_size=V, max_nnz=NNZ)
+    return np.asarray(
+        score(state, Batch.from_parsed(parsed, with_fields=score.uses_fields))
+    )
+
+
+# ---------------------------------------------------------------------------
+# bucket ladder units
+# ---------------------------------------------------------------------------
+
+
+def test_validate_buckets():
+    assert validate_buckets((512, 8, 1, 64, 8)) == (1, 8, 64, 512)
+    with pytest.raises(ValueError):
+        validate_buckets(())
+    with pytest.raises(ValueError):
+        validate_buckets((0, 4))
+    with pytest.raises(ValueError):
+        validate_buckets(("a",))
+
+
+def test_bucket_routing_and_padding_at_every_boundary(tmp_path):
+    """bucket_for at n, n±1 around every rung; assemble pads with
+    weight-0 all-zero rows up to exactly the chosen bucket."""
+    cfg = _cfg(tmp_path)
+    _checkpoint(cfg)
+    from fast_tffm_tpu.prediction import load_scoring_state, make_score_fn
+
+    model, state = load_scoring_state(cfg, log=lambda *_: None)
+    ladder = BucketLadder(make_score_fn(cfg, state, NNZ, model=model), (1, 4, 16))
+    assert [ladder.bucket_for(n) for n in (1, 2, 3, 4, 5, 15, 16)] == [
+        1, 4, 4, 4, 16, 16, 16,
+    ]
+    with pytest.raises(ValueError):
+        ladder.bucket_for(17)
+    with pytest.raises(ValueError):
+        ladder.bucket_for(0)
+
+    rng = np.random.default_rng(3)
+    for n in (1, 2, 4, 5, 16):
+        parsed = parse_lines(_lines(rng, n), vocabulary_size=V, max_nnz=NNZ)
+        rows = [
+            (parsed.ids[i].astype(np.int32), parsed.vals[i], parsed.fields[i])
+            for i in range(n)
+        ]
+        batch, bucket = ladder.assemble(rows)
+        assert bucket == ladder.bucket_for(n)
+        assert batch.ids.shape == (bucket, NNZ)
+        got_w = np.asarray(batch.weights)
+        np.testing.assert_array_equal(got_w[:n], 1.0)
+        np.testing.assert_array_equal(got_w[n:], 0.0)
+        # Padding rows are all-zero (vals==0 ⇒ score contribution 0).
+        np.testing.assert_array_equal(np.asarray(batch.vals)[n:], 0.0)
+
+
+def test_latency_histogram_quantiles():
+    h = LatencyHistogram()
+    assert h.snapshot() == {"count": 0}
+    for ms in (1, 2, 3, 4, 100):
+        h.add(ms / 1e3)
+    snap = h.snapshot()
+    assert snap["count"] == 5
+    assert snap["max"] == 100.0
+    # p50 lands in the 2-3ms region (log-binned, interpolated).
+    assert 1.5 <= snap["p50"] <= 3.5
+    assert snap["p99"] <= 100.0
+    h2 = LatencyHistogram()
+    h2.add(5e-9)  # below range: clamps to edge bin, min keeps it honest
+    assert h2.quantile(0.5) == pytest.approx(5e-9)
+
+
+# ---------------------------------------------------------------------------
+# engine: parity + compile ladder
+# ---------------------------------------------------------------------------
+
+
+def test_serving_scores_bit_identical_to_predict_per_bucket(tmp_path):
+    """Acceptance: for every bucket occupancy (full rungs AND the padded
+    odd sizes between them), engine scores == the offline scoring path's
+    scores, bitwise."""
+    cfg = _cfg(tmp_path)
+    _checkpoint(cfg)
+    rng = np.random.default_rng(7)
+    with ServingEngine(cfg, log=lambda *_: None) as eng:
+        for n in (1, 2, 3, 4, 7, 16):
+            lines = _lines(rng, n)
+            got = np.asarray(
+                [f.result(timeout=10) for f in [eng.submit_line(l) for l in lines]],
+                np.float32,
+            )
+            want = _offline_scores(cfg, lines).astype(np.float32)
+            np.testing.assert_array_equal(got, want)
+        snap = eng.metrics_snapshot()
+    assert snap["rows"] == 1 + 2 + 3 + 4 + 7 + 16
+    assert snap["rejected"] == 0
+
+
+def test_zero_steady_state_recompiles_with_mixed_sizes(tmp_path):
+    """Acceptance: after the warmup pass, mixed request sizes (hence
+    mixed flush sizes and buckets) never trigger a fresh XLA compile —
+    the jit cache count stays flat."""
+    cfg = _cfg(tmp_path, serve_flush_deadline_ms=1.0)
+    _checkpoint(cfg)
+    rng = np.random.default_rng(11)
+    with ServingEngine(cfg, log=lambda *_: None) as eng:
+        warm = eng.compile_count()
+        assert warm is not None and warm >= len(eng.buckets)
+        # Bursts of every size around the rungs, interleaved with idle
+        # gaps so both deadline flushes and full flushes occur.
+        for burst in (1, 3, 4, 5, 16, 2, 16, 7, 1):
+            futs = [eng.submit_line(l) for l in _lines(rng, burst)]
+            for f in futs:
+                f.result(timeout=10)
+        end = eng.compile_count()
+        snap = eng.metrics_snapshot()
+    assert end == warm, f"steady-state recompiles: {end} != {warm}"
+    assert len(snap["bucket_rows"]) >= 2  # traffic really crossed buckets
+
+
+def test_submit_parsed_matches_submit_line(tmp_path):
+    cfg = _cfg(tmp_path)
+    _checkpoint(cfg)
+    line = "1 3:0.5 9:1.25 40:0.75"
+    with ServingEngine(cfg, log=lambda *_: None) as eng:
+        a = eng.submit_line(line).result(timeout=10)
+        b = eng.submit(ids=[3, 9, 40], vals=[0.5, 1.25, 0.75]).result(timeout=10)
+        with pytest.raises(ValueError):
+            eng.submit(ids=list(range(NNZ + 1)), vals=[1.0] * (NNZ + 1))
+        with pytest.raises(ValueError):  # OOB id: gather would CLAMP it
+            eng.submit(ids=[V], vals=[1.0])
+        with pytest.raises(ValueError):
+            eng.submit_line("1 " + " ".join(f"{i}:1" for i in range(NNZ + 1)))
+    assert a == b
+
+
+# ---------------------------------------------------------------------------
+# engine: flush policy
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_flush_fires_before_full_batch(tmp_path):
+    """3 requests against max_batch 16: only the deadline can flush them,
+    and it must do so in deadline-order time, not hang for a full batch."""
+    cfg = _cfg(tmp_path, serve_flush_deadline_ms=30.0)
+    _checkpoint(cfg)
+    with ServingEngine(cfg, log=lambda *_: None) as eng:
+        t0 = time.perf_counter()
+        futs = [eng.submit_line(l) for l in _lines(np.random.default_rng(1), 3)]
+        for f in futs:
+            f.result(timeout=10)
+        dt = time.perf_counter() - t0
+        snap = eng.metrics_snapshot()
+    assert snap["flushes_deadline"] >= 1
+    assert snap["rows"] == 3
+    assert dt >= 0.025  # waited for the deadline (not an instant flush)
+    assert dt < 5.0
+
+
+def test_full_batch_flushes_without_waiting_for_deadline(tmp_path):
+    """max_batch requests with a 10s deadline must resolve in well under
+    the deadline: the size trigger, not the timer, flushed them."""
+    cfg = _cfg(
+        tmp_path, serve_flush_deadline_ms=10_000.0, serve_buckets=(1, 4), serve_max_batch=4
+    )
+    _checkpoint(cfg)
+    with ServingEngine(cfg, log=lambda *_: None) as eng:
+        t0 = time.perf_counter()
+        futs = [eng.submit_line(l) for l in _lines(np.random.default_rng(2), 4)]
+        for f in futs:
+            f.result(timeout=8)
+        dt = time.perf_counter() - t0
+        snap = eng.metrics_snapshot()
+    assert dt < 5.0  # far under the 10s deadline
+    assert snap["flushes_full"] >= 1
+    assert snap["batch_occupancy"] == 1.0
+
+
+def test_cancelled_future_does_not_kill_collector(tmp_path):
+    """A caller cancelling its pending future (its own timeout path) must
+    cost that caller its score, not the whole engine: the flush claims
+    futures via set_running_or_notify_cancel and drops cancelled ones.
+
+    Deterministic by construction: with a 10s deadline nothing can flush
+    between submit and cancel (no wall-clock race on loaded CI), and the
+    flush that processes the cancelled request is forced by close()."""
+    cfg = _cfg(tmp_path, serve_flush_deadline_ms=10_000.0)
+    _checkpoint(cfg)
+    line = "1 3:1.0 9:1.0"
+    eng = ServingEngine(cfg, log=lambda *_: None)
+    f1 = eng.submit_line(line)
+    assert f1.cancel()  # still pending: the 10s deadline cannot have fired
+    f2 = eng.submit_line(line)
+    eng.close()  # flushes the pending pair: f1 dropped at claim, f2 scored
+    assert 0.0 <= f2.result(timeout=1) <= 1.0  # collector survived the cancel
+    snap = eng.metrics_snapshot()
+    assert snap["rows"] == 1  # the cancelled request was never scored
+
+
+def test_close_flushes_pending_under_long_deadline(tmp_path):
+    """close() must not strand sub-deadline pending requests."""
+    cfg = _cfg(tmp_path, serve_flush_deadline_ms=10_000.0)
+    _checkpoint(cfg)
+    eng = ServingEngine(cfg, log=lambda *_: None)
+    futs = [eng.submit_line(l) for l in _lines(np.random.default_rng(4), 3)]
+    eng.close()
+    for f in futs:
+        assert 0.0 <= f.result(timeout=1) <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# engine: admission control
+# ---------------------------------------------------------------------------
+
+
+def _slow_score(eng, delay=0.005):
+    """Slow the flush down so a submit burst outruns the collector —
+    the deterministic way to fill the admission queue."""
+    orig = eng._ladder._score
+
+    def slow(state, batch):
+        time.sleep(delay)
+        return orig(state, batch)
+
+    eng._ladder._score = slow
+
+
+def test_overload_reject_sheds_and_counts(tmp_path):
+    cfg = _cfg(
+        tmp_path,
+        serve_queue_size=2,
+        serve_overload="reject",
+        serve_buckets=(1,),
+        serve_flush_deadline_ms=0.0,
+    )
+    _checkpoint(cfg)
+    with ServingEngine(cfg, log=lambda *_: None) as eng:
+        _slow_score(eng)
+        lines = _lines(np.random.default_rng(5), 60, nnz_lo=1, nnz_hi=1)
+        futs, rejected = [], 0
+        for l in lines:
+            try:
+                futs.append(eng.submit_line(l))
+            except OverloadError:
+                rejected += 1
+        assert rejected > 0  # the burst overran a queue of 2
+        for f in futs:  # every ACCEPTED request still gets its score
+            assert 0.0 <= f.result(timeout=30) <= 1.0
+        snap = eng.metrics_snapshot()
+    assert snap["rejected"] == rejected
+    assert snap["requests"] == 60
+    assert snap["rows"] == 60 - rejected
+
+
+def test_overload_block_applies_backpressure_drops_nothing(tmp_path):
+    cfg = _cfg(
+        tmp_path,
+        serve_queue_size=2,
+        serve_overload="block",
+        serve_buckets=(1,),
+        serve_flush_deadline_ms=0.0,
+    )
+    _checkpoint(cfg)
+    with ServingEngine(cfg, log=lambda *_: None) as eng:
+        _slow_score(eng, delay=0.002)
+        futs = [
+            eng.submit_line(l)
+            for l in _lines(np.random.default_rng(6), 40, nnz_lo=1, nnz_hi=1)
+        ]
+        for f in futs:
+            assert 0.0 <= f.result(timeout=30) <= 1.0
+        snap = eng.metrics_snapshot()
+    assert snap["rejected"] == 0
+    assert snap["rows"] == 40
+
+
+# ---------------------------------------------------------------------------
+# engine: hot checkpoint reload
+# ---------------------------------------------------------------------------
+
+
+def test_hot_reload_picks_up_new_step_mid_stream(tmp_path):
+    cfg = _cfg(tmp_path, serve_reload_interval_s=0.05)
+    state0 = _checkpoint(cfg, shift=0.5, step=0)
+    line = "1 3:1.0 9:1.0 40:1.0"
+    with ServingEngine(cfg, log=lambda *_: None) as eng:
+        before = eng.submit_line(line).result(timeout=10)
+        assert eng.step == 0
+        # Trainer drops a newer checkpoint into the shared model_file.
+        save_checkpoint(
+            cfg.model_file,
+            state0._replace(table=state0.table * 2.0, step=state0.step + 77),
+        )
+        deadline = time.perf_counter() + 10.0
+        after = before
+        while time.perf_counter() < deadline:
+            after = eng.submit_line(line).result(timeout=10)
+            if eng.step == 77:
+                break
+            time.sleep(0.02)
+        assert eng.step == 77, "watcher never swapped the new checkpoint in"
+        after = eng.submit_line(line).result(timeout=10)
+        snap = eng.metrics_snapshot()
+    assert snap["reloads"] == 1
+    assert snap["reload_failures"] == 0
+    assert after != before
+    # And the post-reload scores are the OFFLINE scores of the new ckpt.
+    np.testing.assert_array_equal(
+        np.float32(after), _offline_scores(cfg, [line]).astype(np.float32)[0]
+    )
+
+
+def test_reload_survives_torn_checkpoint(tmp_path):
+    """A garbage model_file mid-stream must not kill serving: the stage
+    fails (counted), the old state keeps serving, and a later good
+    checkpoint still reloads."""
+    cfg = _cfg(tmp_path, serve_reload_interval_s=0.05)
+    state0 = _checkpoint(cfg)
+    line = "1 3:1.0 9:1.0"
+    with ServingEngine(cfg, log=lambda *_: None) as eng:
+        before = eng.submit_line(line).result(timeout=10)
+        # Unreadable garbage: no step ⇒ the signature reads as "absent"
+        # and the watcher just keeps waiting — not even a failure.
+        with open(cfg.model_file, "wb") as f:
+            f.write(b"\x00not a checkpoint")
+        time.sleep(0.2)
+        assert eng.submit_line(line).result(timeout=10) == before
+        assert eng.metrics.reloads == 0
+        # Readable step but missing arrays (a writer died mid-copy into
+        # a non-atomic location): the stage FAILS, is counted, and the
+        # old state keeps serving.
+        with open(cfg.model_file, "wb") as f:  # (bare np.savez appends .npz)
+            np.savez(f, step=np.asarray(5))
+        deadline = time.perf_counter() + 10.0
+        while time.perf_counter() < deadline:
+            if eng.metrics.reload_failures >= 1:
+                break
+            time.sleep(0.02)
+        assert eng.metrics.reload_failures >= 1
+        # Old state still serves, bit-identically.
+        assert eng.submit_line(line).result(timeout=10) == before
+        save_checkpoint(
+            cfg.model_file, state0._replace(table=state0.table + 1.0, step=state0.step + 9)
+        )
+        deadline = time.perf_counter() + 10.0
+        while time.perf_counter() < deadline:
+            eng.submit_line(line).result(timeout=10)
+            if eng.step == 9:
+                break
+            time.sleep(0.02)
+        assert eng.step == 9
+
+
+# ---------------------------------------------------------------------------
+# serve CLI path + loadgen + config
+# ---------------------------------------------------------------------------
+
+
+def test_serve_lines_matches_predict_score_file(tmp_path):
+    """The `serve` verb's output is wire-compatible with predict's score
+    file: same lines in, same %.6f scores out, same order."""
+    from fast_tffm_tpu.prediction import predict
+    from fast_tffm_tpu.serving import serve_lines
+
+    lines = _lines(np.random.default_rng(9), 37)
+    data = tmp_path / "req.libsvm"
+    data.write_text("\n".join(lines) + "\n")
+    cfg = _cfg(
+        tmp_path,
+        predict_files=(str(data),),
+        score_path=str(tmp_path / "scores.txt"),
+        batch_size=16,
+    )
+    _checkpoint(cfg)
+    predict(cfg, log=lambda *_: None)
+    want = (tmp_path / "scores.txt").read_text()
+
+    out = io.StringIO()
+    rc = serve_lines(cfg, lines=iter(lines), out=out, log=lambda *_: None)
+    assert rc == 0
+    # Same count/order/%.6f format; values at one format-ULP (predict's
+    # batch_size-shaped program vs serving's bucket-shaped programs can
+    # drift a few float32 ULPs across XLA programs on some backends).
+    got, ref = out.getvalue().splitlines(), want.splitlines()
+    assert len(got) == len(ref)
+    np.testing.assert_allclose(
+        [float(x) for x in got], [float(x) for x in ref], atol=2e-6
+    )
+
+
+def test_loadgen_smoke_zero_recompiles(tmp_path):
+    """CPU loadgen smoke (acceptance): mixed request sizes, compile count
+    flat after warmup, BENCH_SERVE JSON well-formed."""
+    import json
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cfg_path = tmp_path / "serve.cfg"
+    cfg_path.write_text(
+        f"""
+[General]
+model = fm
+factor_num = 4
+vocabulary_size = {V}
+model_file = {tmp_path}/m.ckpt
+
+[Train]
+max_nnz = {NNZ}
+
+[Serving]
+buckets = 1 4 16
+flush_deadline_ms = 2
+"""
+    )
+    _checkpoint(_cfg(tmp_path))
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(repo, "tools", "loadgen.py"),
+            str(cfg_path),
+            "--mode",
+            "closed",
+            "--concurrency",
+            "4",
+            "--duration",
+            "1.0",
+        ],
+        capture_output=True,
+        text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        cwd=repo,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    result = json.loads(proc.stdout)
+    assert result["bench"] == "BENCH_SERVE"
+    assert result["steady_state_recompiles"] == 0
+    assert result["requests_scored"] > 0
+    assert result["client_ms"]["p99"] > 0
+    assert 0 < result["batch_occupancy"] <= 1
+
+
+def test_serving_config_section_and_validation(tmp_path):
+    from fast_tffm_tpu.config import load_config
+
+    p = tmp_path / "s.cfg"
+    p.write_text(
+        """
+[General]
+model = fm
+
+[Serving]
+buckets = 1 32 256     ; ladder
+max_batch = 128
+flush_deadline_ms = 2.5
+queue_size = 64
+overload = reject
+reload_interval_s = 1.5
+metrics_every_s = 0
+"""
+    )
+    cfg = load_config(str(p))
+    assert cfg.serve_buckets == (1, 32, 256)
+    assert cfg.serve_max_batch == 128
+    assert cfg.serve_flush_deadline_ms == 2.5
+    assert cfg.serve_queue_size == 64
+    assert cfg.serve_overload == "reject"
+    assert cfg.serve_reload_interval_s == 1.5
+    assert cfg.serve_metrics_every_s == 0.0
+
+    with pytest.raises(ValueError, match="serve_max_batch"):
+        Config(serve_buckets=(1, 8), serve_max_batch=16).validate()
+    with pytest.raises(ValueError, match="serve_overload"):
+        Config(serve_overload="drop").validate()
+    with pytest.raises(ValueError, match="serve_buckets"):
+        Config(serve_buckets=()).validate()
+    with pytest.raises(ValueError, match="serve_queue_size"):
+        Config(serve_queue_size=0).validate()
+
+
+def test_serving_metrics_jsonl_export(tmp_path):
+    """Serving metrics flow through the existing MetricsLogger JSONL
+    path, tagged kind=serving, with latency percentiles present."""
+    import json
+
+    cfg = _cfg(tmp_path, metrics_path=str(tmp_path / "metrics.jsonl"))
+    _checkpoint(cfg)
+    with ServingEngine(cfg, log=lambda *_: None) as eng:
+        futs = [eng.submit_line(l) for l in _lines(np.random.default_rng(8), 10)]
+        for f in futs:
+            f.result(timeout=10)
+    records = [
+        json.loads(l) for l in (tmp_path / "metrics.jsonl").read_text().splitlines()
+    ]
+    serving = [r for r in records if r.get("kind") == "serving"]
+    assert serving, "no serving record reached the JSONL sink"
+    final = serving[-1]
+    assert final["rows"] == 10
+    assert final["total_ms"]["count"] == 10
+    assert {"p50", "p95", "p99"} <= final["total_ms"].keys()
+    assert final["requests"] == 10

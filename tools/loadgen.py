@@ -1,0 +1,270 @@
+#!/usr/bin/env python
+"""Serving load generator: drive a ServingEngine, emit BENCH_SERVE JSON.
+
+The serving analog of bench.py's train BENCH files: one JSON object with
+client-observed latency percentiles (p50/p95/p99), achieved QPS, the
+engine's own queue/compute/occupancy metrics, and the compile counts
+that pin "zero steady-state recompiles" — so future PRs can track a
+serving trajectory the way BENCH_r*.json tracks training.
+
+Two modes:
+
+  * ``open`` (default) — open-loop Poisson arrivals at ``--qps``: the
+    generator submits on a fixed random schedule whether or not earlier
+    requests finished, which is what exposes queueing collapse (a
+    closed loop self-throttles and hides it).
+  * ``closed`` — ``--concurrency`` workers each submit-and-wait in a
+    loop: measures best-case service latency and saturation throughput.
+
+Request sizes are MIXED by construction (per-line nnz drawn 1..max_nnz)
+so the run exercises every ladder bucket.
+
+Usage:
+    python tools/loadgen.py run.cfg --mode open --qps 500 --duration 3
+    python tools/loadgen.py run.cfg --mode closed --concurrency 8 \
+        --requests 2000 --out BENCH_SERVE.json
+
+With no --input and no predict_files, synthetic libsvm lines are drawn
+from the configured vocabulary; --init-missing-checkpoint writes a fresh
+random checkpoint when model_file is absent (zero-setup smoke runs).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def synth_lines(cfg, n: int, max_nnz: int, seed: int) -> list[str]:
+    """Random libsvm lines over the configured vocab, nnz mixed 1..max_nnz
+    so the bucket ladder (and its padding) sees every width."""
+    rng = np.random.default_rng(seed)
+    v = min(cfg.vocabulary_size, 1 << 20)
+    lines = []
+    for _ in range(n):
+        # Clamp to the vocab: choice(replace=False) can't draw k > v.
+        k = int(rng.integers(1, min(max_nnz, v) + 1))
+        ids = rng.choice(v, size=k, replace=False)
+        vals = np.round(np.abs(rng.normal(size=k)) + 0.1, 4)
+        toks = " ".join(f"{i}:{x}" for i, x in zip(ids, vals))
+        lines.append(f"{int(rng.integers(0, 2))} {toks}")
+    return lines
+
+
+def run_open(engine, lines, qps: float, duration: float, max_requests: int, seed: int):
+    """Open-loop Poisson arrivals; returns client latencies (seconds)."""
+    rng = np.random.default_rng(seed)
+    lat: list[float] = []
+    lat_lock = threading.Lock()
+    inflight: list = []
+    t_end = time.perf_counter() + duration
+    i = sent = 0
+    t_next = time.perf_counter()
+    while time.perf_counter() < t_end and sent < max_requests:
+        now = time.perf_counter()
+        if now < t_next:
+            time.sleep(min(t_next - now, 0.005))
+            continue
+        t_next += rng.exponential(1.0 / qps)
+        t0 = time.perf_counter()
+        try:
+            fut = engine.submit_line(lines[i % len(lines)])
+        except Exception:
+            i += 1
+            continue  # rejected (overload policy): engine counts it
+        def _record(f, t0=t0):
+            if f.exception() is None:
+                with lat_lock:
+                    lat.append(time.perf_counter() - t0)
+
+        fut.add_done_callback(_record)
+        inflight.append(fut)
+        i += 1
+        sent += 1
+    for f in inflight:
+        try:
+            f.result(timeout=30)
+        except Exception:
+            pass
+    return lat, sent
+
+
+def run_closed(engine, lines, concurrency: int, duration: float, max_requests: int):
+    """Closed-loop submit-and-wait workers; returns client latencies."""
+    lat: list[float] = []
+    lock = threading.Lock()
+    stop = time.perf_counter() + duration
+    counter = [0]
+
+    def worker(wid: int):
+        i = wid
+        while time.perf_counter() < stop:
+            with lock:
+                if counter[0] >= max_requests:
+                    return
+                counter[0] += 1
+            t0 = time.perf_counter()
+            try:
+                s = engine.submit_line(lines[i % len(lines)]).result(timeout=30)
+                del s
+            except Exception:
+                # Advance past the failing line (a reject, or one bad
+                # input row) and yield briefly — retrying the SAME line
+                # in a tight loop would busy-spin the whole --duration.
+                i += concurrency
+                time.sleep(0.001)
+                continue
+            with lock:
+                lat.append(time.perf_counter() - t0)
+            i += concurrency
+
+    threads = [threading.Thread(target=worker, args=(w,)) for w in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return lat, counter[0]
+
+
+def percentiles_ms(lat: list[float]) -> dict:
+    if not lat:
+        return {"count": 0}
+    a = np.asarray(lat) * 1e3
+    return {
+        "count": int(a.size),
+        "mean": round(float(a.mean()), 3),
+        "p50": round(float(np.percentile(a, 50)), 3),
+        "p95": round(float(np.percentile(a, 95)), 3),
+        "p99": round(float(np.percentile(a, 99)), 3),
+        "max": round(float(a.max()), 3),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("config", help="INI config (uses [Serving] + model_file)")
+    ap.add_argument("--mode", choices=("open", "closed"), default="open")
+    ap.add_argument("--qps", type=float, default=500.0, help="open-loop arrival rate")
+    ap.add_argument("--concurrency", type=int, default=8, help="closed-loop workers")
+    ap.add_argument("--duration", type=float, default=3.0, help="seconds of traffic")
+    ap.add_argument("--requests", type=int, default=10**9, help="request cap")
+    ap.add_argument("--input", default=None, help="libsvm file of request lines")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=None, help="also write the JSON here")
+    ap.add_argument(
+        "--init-missing-checkpoint",
+        action="store_true",
+        help="write a fresh random checkpoint when model_file is absent",
+    )
+    args = ap.parse_args(argv)
+    if args.mode == "open" and args.qps <= 0:
+        ap.error("--qps must be > 0 in open mode (it is the Poisson arrival rate)")
+    if args.mode == "closed" and args.concurrency < 1:
+        ap.error("--concurrency must be >= 1 in closed mode")
+
+    from fast_tffm_tpu.config import build_model, load_config
+    from fast_tffm_tpu.serving import ServingEngine
+
+    cfg = load_config(args.config)
+    if args.mode == "open" and cfg.serve_overload == "block":
+        # A blocking submit would stall the Poisson arrival schedule the
+        # moment the queue fills — turning the open loop into a closed
+        # one exactly at the queueing-collapse point it exists to expose.
+        # Shed instead; rejects are counted in the result.
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, serve_overload="reject")
+        print(
+            "loadgen: open-loop mode forces serve_overload = reject "
+            "(blocking submits would self-throttle the arrival schedule)",
+            file=sys.stderr,
+        )
+    if args.init_missing_checkpoint and not os.path.exists(cfg.model_file.rstrip("/")):
+        import jax
+
+        from fast_tffm_tpu.checkpoint import save_checkpoint
+        from fast_tffm_tpu.trainer import init_state
+
+        save_checkpoint(
+            cfg.model_file,
+            init_state(
+                build_model(cfg),
+                jax.random.key(args.seed),
+                cfg.init_accumulator_value,
+                cfg.adagrad_accumulator,
+            ),
+        )
+        print(f"loadgen: wrote fresh checkpoint {cfg.model_file}", file=sys.stderr)
+
+    if args.input:
+        lines = [l.strip() for l in open(args.input) if l.strip()]
+    elif cfg.predict_files:
+        lines = [
+            l.strip() for p in cfg.predict_files for l in open(p) if l.strip()
+        ]
+    else:
+        width = cfg.max_nnz if cfg.max_nnz > 0 else 8
+        lines = synth_lines(cfg, 4096, width, args.seed)
+        print(f"loadgen: synthesized {len(lines)} request lines", file=sys.stderr)
+
+    log = lambda *a: print(*a, file=sys.stderr)
+    t_setup = time.perf_counter()
+    engine = ServingEngine(cfg, log=log)
+    warm = engine.compile_count()  # ladder fully compiled here (ctor warmup)
+    t_warm = time.perf_counter() - t_setup
+
+    t0 = time.perf_counter()
+    if args.mode == "open":
+        lat, sent = run_open(
+            engine, lines, args.qps, args.duration, args.requests, args.seed
+        )
+    else:
+        lat, sent = run_closed(
+            engine, lines, args.concurrency, args.duration, args.requests
+        )
+    wall = time.perf_counter() - t0
+    end = engine.compile_count()
+    snap = engine.metrics_snapshot()
+    engine.close()
+
+    result = {
+        "bench": "BENCH_SERVE",
+        "mode": args.mode,
+        "qps_target": args.qps if args.mode == "open" else None,
+        "concurrency": args.concurrency if args.mode == "closed" else None,
+        "duration_s": round(wall, 3),
+        "warmup_s": round(t_warm, 3),
+        "requests_sent": sent,
+        "requests_scored": len(lat),
+        "qps_achieved": round(len(lat) / wall, 1) if wall > 0 else None,
+        "client_ms": percentiles_ms(lat),
+        "buckets": list(engine.buckets),
+        "flush_deadline_ms": cfg.serve_flush_deadline_ms,
+        "overload": cfg.serve_overload,
+        # Flat compile count across the traffic phase IS the acceptance
+        # signal: every request shape landed on a warmed bucket.
+        "compile_count_warm": warm,
+        "compile_count_end": end,
+        "steady_state_recompiles": (
+            end - warm if warm is not None and end is not None else None
+        ),
+        **snap,
+    }
+    out = json.dumps(result, indent=2)
+    print(out)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(out + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
