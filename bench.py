@@ -324,18 +324,31 @@ def interleaved_measure(step, state, batches_a, batches_b, iters, rounds=4, batc
     ), state
 
 
-def ensure_scale_fmb(vocab, rows=1 << 19, seed=7):
+def ensure_scale_fmb(vocab, rows=1 << 19, seed=7, all_ones=False):
     """Synthesize (once, cached) an FMB file of Zipf-id rows at the scale
     vocab — built directly in the FMB layout (the text→FMB converter would
     spend minutes parsing 250 MB of synthetic text for no extra fidelity;
-    the STREAM under test is identical either way)."""
-    from fast_tffm_tpu.data.binary import _HEADER, FMB_MAGIC, _section_offsets, open_fmb
+    the STREAM under test is identical either way).  ``all_ones`` writes
+    1.0 values with the v2 elision flags set — the binary-feature CTR
+    regime the packed wire format's vals elision targets."""
+    from fast_tffm_tpu.data.binary import (
+        _HEADER,
+        FLAG_FIELDS_ALL_ZERO,
+        FLAG_VALS_ALL_ONES,
+        FMB_MAGIC,
+        FMB_VERSION,
+        _section_offsets,
+        open_fmb,
+    )
 
-    path = f"/tmp/fmb_scale_cache/zipf_v{vocab}_n{NNZ}_r{rows}_s{seed}.fmb"
+    tag = "ones" if all_ones else "zipf"
+    path = f"/tmp/fmb_scale_cache/{tag}_v{vocab}_n{NNZ}_r{rows}_s{seed}.fmb"
     if os.path.exists(path):
         try:
             f = open_fmb(path)
-            if f.n_rows == rows and f.vocabulary_size == vocab:
+            if f.n_rows == rows and f.vocabulary_size == vocab and (
+                not all_ones or f.flags & FLAG_VALS_ALL_ONES
+            ):
                 return path
         except ValueError:
             pass
@@ -346,8 +359,9 @@ def ensure_scale_fmb(vocab, rows=1 << 19, seed=7):
     with open(tmp, "wb") as fh:
         fh.truncate(total)
     mm = np.memmap(tmp, np.uint8, mode="r+")
+    flags = FLAG_FIELDS_ALL_ZERO | (FLAG_VALS_ALL_ONES if all_ones else 0)
     mm[: _HEADER.size] = np.frombuffer(
-        _HEADER.pack(FMB_MAGIC, 1, rows, NNZ, vocab, 1, 4, 0, 0, NNZ),
+        _HEADER.pack(FMB_MAGIC, FMB_VERSION, rows, NNZ, vocab, 1, 4, flags, 0, 0, NNZ),
         np.uint8,
     )
 
@@ -361,9 +375,12 @@ def ensure_scale_fmb(vocab, rows=1 << 19, seed=7):
     view(o_ids, rows * NNZ, np.int32, (rows, NNZ))[:] = zipf_ids(
         rng, (rows, NNZ), vocab
     )
-    view(o_val, rows * NNZ, np.float32, (rows, NNZ))[:] = np.abs(
-        rng.normal(size=(rows, NNZ)).astype(np.float32)
-    ) + 0.1
+    if all_ones:
+        view(o_val, rows * NNZ, np.float32, (rows, NNZ))[:] = 1.0
+    else:
+        view(o_val, rows * NNZ, np.float32, (rows, NNZ))[:] = np.abs(
+            rng.normal(size=(rows, NNZ)).astype(np.float32)
+        ) + 0.1
     view(o_fld, rows * NNZ, np.int32, (rows, NNZ))[:] = 0
     mm.flush()
     del mm
@@ -371,36 +388,65 @@ def ensure_scale_fmb(vocab, rows=1 << 19, seed=7):
     return path
 
 
-def bench_fmb_streamed(step, state, path, vocab):
-    """(final state, examples/sec) through the REAL input path: memmap
-    stream → producer-thread H2D conversion (training's binary-input
-    placement) → jitted step."""
-    from fast_tffm_tpu.data.binary import fmb_batch_stream, open_fmb
+def bench_fmb_streamed(step, state, path, vocab, wire_format="packed"):
+    """(final state, examples/sec, info) through the REAL input path:
+    memmap stream → producer-thread H2D staging (training's binary-input
+    placement, ``wire_format`` selecting packed-wire vs classic arrays)
+    → jitted step.  ``info`` carries the wire accounting the BENCH JSON
+    commits: bytes/step on the wire and the per-batch staging-time median.
+    """
+    from fast_tffm_tpu.data.binary import fmb_batch_stream, fmb_wire_flags, open_fmb
+    from fast_tffm_tpu.data.wire import WireConverter, arrays_nbytes, make_spec
     from fast_tffm_tpu.utils.prefetch import prefetch
 
     n_rows = open_fmb(path).n_rows
     count = n_rows // BATCH
+    if wire_format == "packed":
+        all_ones, _ = fmb_wire_flags([path])
+        conv = WireConverter(
+            make_spec(vocab, NNZ, with_vals=not all_ones, with_fields=False)
+        )
+        wire_bytes = conv.spec.batch_nbytes(BATCH)
+    else:
+        conv = lambda p, w: Batch.from_parsed(p, w, with_fields=False)
+        wire_bytes = arrays_nbytes(BATCH, NNZ, with_fields=False)
+    stage_ms = []
 
-    def stream():
+    def stream(timed=False):
         raw = fmb_batch_stream(
             [path], batch_size=BATCH, vocabulary_size=vocab,
             hash_feature_id=True, max_nnz=NNZ, epochs=1, drop_remainder=True,
         )
-        return prefetch(
-            ((Batch.from_parsed(p, w, with_fields=False), p, w) for p, w in raw),
-            depth=8,
-        )
+
+        def gen():
+            for p, w in raw:
+                t0 = time.perf_counter()
+                b = conv(p, w)
+                if timed:
+                    stage_ms.append(1e3 * (time.perf_counter() - t0))
+                yield b, p, w
+
+        return prefetch(gen(), depth=8)
 
     loss = None
     for b, _p, _w in stream():  # warm epoch (page cache, executable reuse)
         state, loss = step(state, b)
     forced_sync(state)
     t0 = time.perf_counter()
-    for b, _p, _w in stream():
+    for b, _p, _w in stream(timed=True):
         state, loss = step(state, b)
     forced_sync(state)
     dt = time.perf_counter() - t0
-    return state, count * BATCH / dt
+    import statistics
+
+    info = {
+        "wire_format": wire_format,
+        "wire_bytes_per_step": wire_bytes,
+        "h2d_stage_ms_median": (
+            round(statistics.median(stage_ms), 3) if stage_ms else None
+        ),
+    }
+    return state, count * BATCH / dt, info
 
 
 def _probe_rung(cand: int) -> None:
@@ -720,15 +766,40 @@ def main():
         results["uniform_ids_value"] = None
         results["uniform_ids_error"] = str(e)[:120]
 
-    # --- end-to-end through the FMB input path (same live state) ---
+    # --- end-to-end through the FMB input path (same live state), on the
+    #     default packed wire.  Then the wire_format A/B on the all-ones
+    #     workload (the vals-elision regime): same stream, same step, the
+    #     two formats timed back to back so the trajectory captures the
+    #     wire win (or a regression) automatically. ---
     try:
-        state, fmb_rate = bench_fmb_streamed(
+        state, fmb_rate, fmb_info = bench_fmb_streamed(
             step, state, ensure_scale_fmb(vocab), vocab
         )
         results["fmb_streamed_value"] = round(fmb_rate, 1)
+        results["streamed_wire_bytes_per_step"] = fmb_info["wire_bytes_per_step"]
+        results["streamed_h2d_ms_median"] = fmb_info["h2d_stage_ms_median"]
     except Exception as e:  # tunnel/disk trouble must not kill the headline
         results["fmb_streamed_value"] = None
         results["fmb_streamed_error"] = str(e)[:120]
+    try:
+        ones_path = ensure_scale_fmb(vocab, all_ones=True)
+        ab = {}
+        for wf in ("packed", "arrays"):
+            state, r, info = bench_fmb_streamed(
+                step, state, ones_path, vocab, wire_format=wf
+            )
+            ab[wf] = {
+                "value": round(r, 1),
+                "wire_bytes_per_step": info["wire_bytes_per_step"],
+                "h2d_stage_ms_median": info["h2d_stage_ms_median"],
+            }
+        ab["wire_cut_x"] = round(
+            ab["arrays"]["wire_bytes_per_step"] / ab["packed"]["wire_bytes_per_step"],
+            3,
+        )
+        results["wire_format_ab_allones"] = ab
+    except Exception as e:
+        results["wire_format_ab_error"] = str(e)[:120]
 
     # --- same shapes through the sharded SPMD step (dist_train's program).
     #     The rung state is FUSED (local-only layout), so this section

@@ -815,4 +815,25 @@ int64_t fm_reader_next32(void* reader, int64_t want, int64_t width,
                           nnz, error_code, error_line);
 }
 
+// Parse-time constant detection for the packed wire format (wire v2
+// elision flags): 1 iff every row of `vals` is exactly the all-ones
+// pattern its nnz implies — 1.0f in the first nnz[i] slots, 0.0f in the
+// padding.  Bit-exact comparisons on purpose: elision reconstructs with
+// literal 1.0f/0.0f on device, so anything else must keep explicit vals.
+int32_t fm_vals_all_ones(const float* vals, const int32_t* nnz, int64_t n,
+                         int64_t width) {
+  for (int64_t i = 0; i < n; ++i) {
+    const float* row = vals + i * width;
+    const int64_t m = nnz[i];
+    if (m < 0 || m > width) return 0;  // corrupt nnz: not the pattern, never OOB
+    for (int64_t j = 0; j < m; ++j) {
+      if (row[j] != 1.0f) return 0;
+    }
+    for (int64_t j = m; j < width; ++j) {
+      if (row[j] != 0.0f) return 0;
+    }
+  }
+  return 1;
+}
+
 }  // extern "C"

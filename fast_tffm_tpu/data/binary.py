@@ -45,11 +45,16 @@ from fast_tffm_tpu.data.libsvm import ParsedBatch
 
 __all__ = [
     "FMB_MAGIC",
+    "FMB_VERSION",
+    "FLAG_VALS_ALL_ONES",
+    "FLAG_FIELDS_ALL_ZERO",
     "FmbFile",
     "is_fmb",
     "open_fmb",
     "write_fmb",
     "fmb_batch_stream",
+    "fmb_wire_flags",
+    "fmb_stats",
     "ensure_fmb_cache",
     "fold_epoch_seed",
     "draw_permutation",
@@ -58,14 +63,24 @@ __all__ = [
 FMB_MAGIC = b"FMB1"
 _ALIGN = 64
 # magic, version, n_rows, width, vocabulary_size, hashed, ids_itemsize,
-# (pad), src_size, src_mtime_ns, max_row_nnz
+# flags, (pad), src_size, src_mtime_ns, max_row_nnz
 # max_row_nnz is the file's WIDEST ACTUAL ROW — `width` is the converter's
 # (possibly generous) --max-nnz padding choice.  Readers compare a
 # requested max_nnz against the actual widest row, so a generously-padded
 # file still serves a narrower training config.  0 = unknown (files
 # written before the field existed; readers fall back to scanning nnz).
-_HEADER = struct.Struct("<4sIqqqBB6xqqq")
+#
+# ``flags`` is the v2 wire-compressibility byte, carved out of v1's pad
+# region (v1 writers zeroed it, so v1 files read as flags=0 — i.e. "no
+# elision promised", always safe).  Data sections are identical across
+# versions; only the header gained meaning, so v1 stays fully readable.
+_HEADER = struct.Struct("<4sIqqqBBB5xqqq")
 assert _HEADER.size <= _ALIGN
+FMB_VERSION = 2
+# Per-file wire-elision facts, computed at convert time over EVERY row
+# (data/wire.py consumes them to pick a packed wire spec per stream):
+FLAG_VALS_ALL_ONES = 1  # every row's vals are the 1.0-prefix/0.0-pad pattern
+FLAG_FIELDS_ALL_ZERO = 2  # no row carries a field id (plain libsvm input)
 
 
 def _align(off: int) -> int:
@@ -100,6 +115,7 @@ class FmbFile:
     src_size: int
     src_mtime_ns: int
     max_row_nnz: int  # widest actual row; 0 = unknown (pre-field files)
+    flags: int  # FLAG_* wire-compressibility bits (0 for v1 files)
     labels: np.ndarray  # f32 [n_rows]
     nnz: np.ndarray  # i32 [n_rows]
     ids: np.ndarray  # i32 [n_rows, width]
@@ -121,25 +137,33 @@ def _read_header(path):
         raw = f.read(_HEADER.size)
     if len(raw) < _HEADER.size:
         raise ValueError(f"{path}: truncated FMB header")
-    magic, version, n_rows, width, vocab, hashed, isz, src_size, src_mtime, widest = (
+    magic, version, n_rows, width, vocab, hashed, isz, flags, src_size, src_mtime, widest = (
         _HEADER.unpack(raw)
     )
     if magic != FMB_MAGIC:
         raise ValueError(f"{path}: not an FMB file")
-    if version != 1:
+    if version not in (1, 2):
         raise ValueError(f"{path}: unsupported FMB version {version}")
+    if version == 1:
+        # v1's pad bytes carried no meaning; never trust them as flags.
+        flags = 0
     if isz != 4:
         # int32 ids only: Batch.from_parsed narrows ids to int32 (the TPU
         # gather index dtype) and config caps vocabulary_size to match, so
         # a wider id section could only ever truncate silently downstream.
         raise ValueError(f"{path}: unsupported ids itemsize {isz} (int32 only)")
-    return n_rows, width, vocab, bool(hashed), isz, src_size, src_mtime, widest
+    return (
+        n_rows, width, vocab, bool(hashed), isz, src_size, src_mtime,
+        widest, flags, version,
+    )
 
 
 def open_fmb(path) -> FmbFile:
     """Memmap an FMB file into array views (no data is read eagerly)."""
     path = os.fspath(path)
-    n_rows, width, vocab, hashed, isz, src_size, src_mtime, widest = _read_header(path)
+    n_rows, width, vocab, hashed, isz, src_size, src_mtime, widest, flags, _ver = (
+        _read_header(path)
+    )
     o_lab, o_nnz, o_ids, o_val, o_fld, total = _section_offsets(n_rows, width, isz)
     if os.path.getsize(path) < total:
         raise ValueError(f"{path}: truncated FMB file (partial write?)")
@@ -157,6 +181,7 @@ def open_fmb(path) -> FmbFile:
         src_size=src_size,
         src_mtime_ns=src_mtime,
         max_row_nnz=widest,
+        flags=flags,
         labels=view(o_lab, n_rows, np.float32, (n_rows,)),
         nnz=view(o_nnz, n_rows, np.int32, (n_rows,)),
         ids=view(o_ids, n_rows * width, np.int32, (n_rows, width)),
@@ -216,14 +241,6 @@ def write_fmb(
         with open(tmp, "r+b") as f:
             f.truncate(total)
         mm = np.memmap(tmp, np.uint8, mode="r+")
-        mm[: _HEADER.size] = np.frombuffer(
-            _HEADER.pack(
-                FMB_MAGIC, 1, n_rows, width, vocabulary_size,
-                1 if hash_feature_id else 0, isz, st.st_size, st.st_mtime_ns,
-                max(1, widest),
-            ),
-            np.uint8,
-        )
 
         def view(off, count, dtype, shape):
             return mm[off : off + count * np.dtype(dtype).itemsize].view(dtype).reshape(shape)
@@ -234,6 +251,17 @@ def write_fmb(
         vals = view(o_val, n_rows * width, np.float32, (n_rows, width))
         fields = view(o_fld, n_rows * width, np.int32, (n_rows, width))
 
+        # Parse-time constant detection (wire format v2): track, chunk by
+        # chunk, whether EVERY row's vals follow the all-ones pattern and
+        # whether any field id appears — the header flags data/wire.py
+        # later elides H2D tensors on.  The C parser scans in-kernel when
+        # built (fm_vals_all_ones); numpy otherwise.
+        from fast_tffm_tpu.data.wire import vals_all_ones as _np_all_ones
+
+        use_parser = parser if parser is not None else best_parser()
+        native_check = getattr(use_parser, "vals_all_ones", None)
+        all_ones = True
+        fields_zero = True
         row = 0
         for parsed, _w in batch_stream(
             [src_path],
@@ -241,7 +269,7 @@ def write_fmb(
             vocabulary_size=vocabulary_size,
             hash_feature_id=hash_feature_id,
             max_nnz=width,
-            parser=parser if parser is not None else best_parser(),
+            parser=use_parser,
         ):
             take = min(parsed.batch_size, n_rows - row)  # strip tail padding
             labels[row : row + take] = parsed.labels[:take]
@@ -249,12 +277,34 @@ def write_fmb(
             ids[row : row + take] = parsed.ids[:take].astype(ids_dtype, copy=False)
             vals[row : row + take] = parsed.vals[:take]
             fields[row : row + take] = parsed.fields[:take]
+            if all_ones:
+                chunk_vals, chunk_nnz = parsed.vals[:take], parsed.nnz[:take]
+                all_ones = bool(
+                    native_check(chunk_vals, chunk_nnz)
+                    if native_check is not None
+                    else _np_all_ones(chunk_vals, chunk_nnz)
+                )
+            if fields_zero and parsed.fields[:take].any():
+                fields_zero = False
             row += take
         if row != n_rows:
             raise RuntimeError(
                 f"{src_path}: parsed {row} rows, scan said {n_rows} "
                 "(file changed mid-convert?)"
             )
+        flags = (FLAG_VALS_ALL_ONES if all_ones else 0) | (
+            FLAG_FIELDS_ALL_ZERO if fields_zero else 0
+        )
+        # Header LAST: the flags are facts about the whole file, and a
+        # crash mid-fill leaves a magic-less temp, never a lying header.
+        mm[: _HEADER.size] = np.frombuffer(
+            _HEADER.pack(
+                FMB_MAGIC, FMB_VERSION, n_rows, width, vocabulary_size,
+                1 if hash_feature_id else 0, isz, flags, st.st_size,
+                st.st_mtime_ns, max(1, widest),
+            ),
+            np.uint8,
+        )
         mm.flush()
         del mm
         os.replace(tmp, out_path)
@@ -265,6 +315,64 @@ def write_fmb(
             except OSError:
                 pass
     return out_path
+
+
+def fmb_wire_flags(files) -> tuple[bool, bool]:
+    """(vals_all_ones, fields_all_zero) for a STREAM over ``files`` — the
+    AND of every file's v2 header flags.  Any non-FMB or v1 file makes
+    both False: elision is only ever claimed when every row was verified
+    at convert time (the packer re-verifies per batch regardless)."""
+    ones = zero = True
+    for path in files:
+        try:
+            if not is_fmb(path):
+                return False, False
+            flags = _read_header(os.fspath(path))[8]
+        except (OSError, ValueError):
+            return False, False
+        ones = ones and bool(flags & FLAG_VALS_ALL_ONES)
+        zero = zero and bool(flags & FLAG_FIELDS_ALL_ZERO)
+    return ones, zero
+
+
+def fmb_stats(path, chunk: int = 1 << 16) -> dict:
+    """Wire-compressibility report for one FMB file (convert_dataset
+    --stats): per-row all-ones/constant-fields fractions from a full
+    chunked scan (ground truth, not the header flags — a v1 file reports
+    honestly here), plus the projected packed-wire byte saving."""
+    from fast_tffm_tpu.data.wire import arrays_nbytes, make_spec
+
+    f = open_fmb(path)
+    ones_rows = 0
+    zero_field_rows = 0
+    cols = np.arange(f.width)
+    for lo in range(0, f.n_rows, chunk):
+        sl = slice(lo, min(lo + chunk, f.n_rows))
+        expect = (cols < f.nnz[sl][:, None]).astype(np.float32)
+        ones_rows += int((f.vals[sl] == expect).all(axis=1).sum())
+        zero_field_rows += int((~f.fields[sl].any(axis=1)).sum())
+    n = max(1, f.n_rows)
+    all_ones = ones_rows == f.n_rows
+    fields_zero = zero_field_rows == f.n_rows
+    spec = make_spec(
+        f.vocabulary_size,
+        f.width,
+        with_vals=not all_ones,
+        with_fields=not fields_zero,
+    )
+    arrays_row = arrays_nbytes(1, f.width, with_fields=not fields_zero)
+    return {
+        "path": f.path,
+        "rows": f.n_rows,
+        "width": f.width,
+        "vocabulary_size": f.vocabulary_size,
+        "header_flags": f.flags,
+        "vals_all_ones_fraction": round(ones_rows / n, 6),
+        "fields_zero_fraction": round(zero_field_rows / n, 6),
+        "arrays_wire_bytes_per_row": arrays_row,
+        "packed_wire_bytes_per_row": spec.row_bytes,
+        "projected_wire_cut_x": round(arrays_row / spec.row_bytes, 3),
+    }
 
 
 def fold_epoch_seed(shuffle_seed: int, epoch: int) -> int:
@@ -552,9 +660,8 @@ def ensure_fmb_cache(
         try:
             if not is_fmb(cache):
                 return False
-            n, width, vocab, hashed, _isz, src_size, src_mtime, widest = (
-                _read_header(cache)
-            )
+            (n, width, vocab, hashed, _isz, src_size, src_mtime, widest,
+             _flags, version) = _read_header(cache)
         except (ValueError, OSError):
             # OSError: the wait loop polls exactly while a peer's
             # os.replace lands — transient ESTALE/ENOENT on network
@@ -563,6 +670,12 @@ def ensure_fmb_cache(
         return (
             src_size == st.st_size
             and src_mtime == st.st_mtime_ns
+            # A pre-wire-flags cache (v1) is data-valid but its flags byte
+            # is meaningless, so the packed wire could never elide from
+            # it — rebuild ONCE on first use after the upgrade (the source
+            # text still exists on this path, unlike direct .fmb inputs,
+            # which pass through above regardless of version).
+            and version >= 2
             and hashed == bool(hash_feature_id)
             and (vocab == vocabulary_size if hashed else vocab <= vocabulary_size)
             # A generously-padded cache still serves a narrower max_nnz as

@@ -170,6 +170,19 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_int32),  # error_code
         ctypes.POINTER(ctypes.c_int64),  # error_line
     ]
+    try:
+        # Wire-v2 constant detection: absent from .so's built before the
+        # symbol existed — optional, so a prebuilt library on a box with
+        # no toolchain keeps parsing (callers fall back to numpy).
+        lib.fm_vals_all_ones.restype = ctypes.c_int32
+        lib.fm_vals_all_ones.argtypes = [
+            np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),  # vals
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),  # nnz
+            ctypes.c_int64,  # n
+            ctypes.c_int64,  # width
+        ]
+    except AttributeError:
+        pass
     return lib
 
 
@@ -205,6 +218,19 @@ class NativeParser:
 
     def fnv1a64(self, token: bytes) -> int:
         return int(self._lib.fm_fnv1a64(token, len(token)))
+
+    def vals_all_ones(self, vals, nnz) -> bool:
+        """In-kernel twin of data/wire.py's ``vals_all_ones`` (the wire-v2
+        convert-time constant detection); numpy fallback when the loaded
+        .so predates the symbol."""
+        vals = np.ascontiguousarray(vals, np.float32)
+        nnz = np.ascontiguousarray(nnz, np.int32)
+        if not hasattr(self._lib, "fm_vals_all_ones"):
+            from fast_tffm_tpu.data.wire import vals_all_ones
+
+            return vals_all_ones(vals, nnz)
+        n, width = vals.shape
+        return bool(self._lib.fm_vals_all_ones(vals, nnz, n, width))
 
     def __call__(
         self,
@@ -393,7 +419,7 @@ def _scan_one(path) -> tuple[int, int]:
         # padding choice), so an auto-derived training max_nnz doesn't
         # inherit padding; 0 means a pre-field file, where only the
         # stored width is trustworthy.
-        n_rows, width, _v, _h, _i, _s, _m, widest = _read_header(path)
+        n_rows, width, _v, _h, _i, _s, _m, widest, _f, _ver = _read_header(path)
         out = (n_rows, widest if widest > 0 else width)
         _scan_cache[key] = out
         return out

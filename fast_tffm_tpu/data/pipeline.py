@@ -11,6 +11,14 @@ parsing is legitimately CPU work even on pods (SURVEY.md §3 item 1).
 File sharding for distributed data-parallel training: worker ``i`` of ``n``
 takes every ``n``-th *line block*, the analog of the reference's per-worker
 input file assignment.
+
+Stream contract downstream: every stream here yields ``(ParsedBatch,
+weights)`` host pairs; HOW those cross the host→device link is the
+converter's choice — ``wire_format = packed`` routes FMB-backed streams
+through data/wire.py (one coalesced byte buffer per superbatch,
+device-side reconstruction), text streams ship classic per-tensor
+arrays.  The pairs themselves are wire-format-agnostic, which is what
+keeps the packed/arrays bit-parity structural.
 """
 
 from __future__ import annotations

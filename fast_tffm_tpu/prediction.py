@@ -16,7 +16,7 @@ import numpy as np
 from fast_tffm_tpu.checkpoint import restore_checkpoint
 from fast_tffm_tpu.config import Config, build_model
 from fast_tffm_tpu.models.base import Batch
-from fast_tffm_tpu.training import _stream, scan_max_nnz
+from fast_tffm_tpu.training import _batch_converter, _stream, scan_max_nnz
 from fast_tffm_tpu.trainer import init_state, make_predict_step
 
 __all__ = [
@@ -122,7 +122,10 @@ def _run_predict(
     is_lead = jax.process_index() == 0
     shard_input = mesh is not None and nproc > 1 and cfg.batch_size % nproc == 0
     stream_kw = {}
-    to_batch = lambda parsed, w: Batch.from_parsed(parsed, w, with_fields=with_fields)
+    # The local converter (uses_fields-marked) — scoring rides the same
+    # packed-wire staging as training when wire_format = packed and the
+    # input is FMB-backed (one coalesced H2D buffer per batch).
+    to_batch = _batch_converter(with_fields)
     remaining = None
     bs = cfg.batch_size  # per-process stream batch size
     if shard_input:
@@ -140,6 +143,9 @@ def _run_predict(
             pad_to_batches=-(-total // cfg.batch_size),  # ceil
         )
         to_batch = lambda parsed, w: make_global_batch(mesh, parsed, w, with_fields=with_fields)
+        # uses_fields without wire_capable: honest kind=input byte
+        # estimates, packed wire off (the global stitch ships arrays).
+        to_batch.uses_fields = with_fields
         # Padding (short final batch + all-empty tail batches) sits strictly
         # after the data rows, so the real scores are exactly the first
         # `total` of the concatenated stream — no global weight mask needed.

@@ -39,12 +39,38 @@ class BucketLadder:
     programs are shape-keyed, not weight-keyed).
     """
 
-    def __init__(self, score, buckets):
+    def __init__(self, score, buckets, *, wire_format="arrays", vocabulary_size=0):
         self._score = score
         self.buckets = validate_buckets(buckets)
         self.max_nnz = score.max_nnz
         self.uses_fields = score.uses_fields
         self.warmed = False
+        self._wire = None
+        if wire_format == "packed" and vocabulary_size > 0:
+            # Packed wire staging (the training/predict streamed format,
+            # data/wire.py): each flush ships ONE coalesced byte buffer —
+            # narrow ids, 1-byte labels, weights rebuilt on device from
+            # the real-row count — instead of five device_puts.  Request
+            # vals are arbitrary floats, so they always ship explicit
+            # (elision is a convert-time per-file fact; serving has no
+            # files).  One unpack program per bucket shape, compiled by
+            # the same warmup pass that pins the score programs, so the
+            # zero-steady-state-recompiles invariant is unchanged.
+            from fast_tffm_tpu.data.wire import WireConverter, make_spec
+
+            self._wire = WireConverter(
+                make_spec(
+                    vocabulary_size,
+                    self.max_nnz,
+                    with_vals=True,
+                    with_fields=self.uses_fields,
+                    with_weights=False,
+                ),
+                # Rows were range-validated at admission (submit_line's
+                # parse / submit's explicit bounds check) — skip the
+                # packer's per-flush id scan on the latency path.
+                verify_ids=False,
+            )
 
     @property
     def max_batch(self) -> int:
@@ -72,7 +98,8 @@ class BucketLadder:
         """The ONE definition of a dispatched batch's shape: ``rows``
         placed over an all-padding base.  warmup() and assemble() both
         build through here, so a warmed shape can never diverge from a
-        flushed shape (which would defeat the compile ladder)."""
+        flushed shape (which would defeat the compile ladder) — and the
+        wire staging decision rides the same single path."""
         labels, ids, vals, fields, weights = self._empty(bucket)
         for i, (rid, rval, rfld) in enumerate(rows):
             ids[i] = rid
@@ -80,6 +107,20 @@ class BucketLadder:
             if self.uses_fields:
                 fields[i] = rfld
         weights[: len(rows)] = 1.0
+        if self._wire is not None:
+            from fast_tffm_tpu.data.libsvm import ParsedBatch
+
+            # Explicit-vals specs ship no nnz section at all (the packer
+            # never reads this placeholder); the real-row prefix count
+            # drives the weight rebuild.
+            parsed = ParsedBatch(
+                labels=labels,
+                ids=ids,
+                vals=vals,
+                fields=fields,
+                nnz=np.zeros((bucket,), np.int32),
+            )
+            return self._wire(parsed, weights)
         return Batch(
             labels=jnp.asarray(labels),
             ids=jnp.asarray(ids),

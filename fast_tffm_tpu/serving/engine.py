@@ -117,7 +117,12 @@ class ServingEngine:
                 "checkpoints into an injected fused-packed state's layout — "
                 "pass a plain-packed/rows state, or disable the watcher"
             )
-        self._ladder = BucketLadder(self._score, cfg.serve_buckets)
+        self._ladder = BucketLadder(
+            self._score,
+            cfg.serve_buckets,
+            wire_format=cfg.wire_format,
+            vocabulary_size=cfg.vocabulary_size,
+        )
         self.max_batch = cfg.serve_max_batch or self._ladder.max_batch
         if self.max_batch > self._ladder.max_batch:
             raise ValueError(

@@ -76,13 +76,21 @@ def _batch_converter(uses_fields: bool):
     converts via ``Batch.from_parsed``; a LIST of K grouped batches
     (steps_per_call > 1 streams) stacks into one [K, B, ...] superbatch.
     One definition shared by train() and dist_train() so the stacking
-    rule cannot diverge between the local and distributed drivers."""
+    rule cannot diverge between the local and distributed drivers.
+
+    ``wire_capable`` marks this as the LOCAL converter — the marker
+    ``_stream`` keys the packed wire format on (the multi-host
+    global-batch closures deliberately lack it and keep the per-process
+    array stitch, but still carry ``uses_fields`` so the kind=input
+    byte estimates stay honest)."""
 
     def to_batch(parsed, w):
         if isinstance(parsed, list):
             return Batch.stack_parsed(parsed, w, with_fields=uses_fields)
         return Batch.from_parsed(parsed, w, with_fields=uses_fields)
 
+    to_batch.uses_fields = uses_fields
+    to_batch.wire_capable = True
     return to_batch
 
 
@@ -206,15 +214,46 @@ def _stream(
                 yield [p for p, _ in items], [w for _, w in items]
 
         raw = _grouped(raw, steps_per_call)
+    from fast_tffm_tpu.data.wire import InputStats
+    from fast_tffm_tpu.utils.prefetch import InputStream
+
+    convert = None
     if to_batch is not None and binary_input(files):
-        gen = ((to_batch(p, w), p, w) for p, w in raw)
-    else:
-        gen = ((None, p, w) for p, w in raw)
+        convert = to_batch
+        wire_ok = getattr(to_batch, "wire_capable", False)
+        if cfg.wire_format == "packed" and wire_ok and max_nnz:
+            # Packed wire: ONE coalesced byte buffer per (super)batch with
+            # device-side reconstruction, instead of one device_put per
+            # tensor.  Elision decisions are PER STREAM, from facts about
+            # THESE files: all-ones vals come off the FMB v2 header flags
+            # (ANDed; verified again per batch by the packer), fields
+            # follow the model's uses_fields rule, weights elide when the
+            # per-file example weights are uniform.  Local converters only
+            # (wire_capable marker): the multi-host global stitch keeps
+            # the array path, whose per-process slices feed
+            # make_array_from_process_local_data directly.
+            from fast_tffm_tpu.data.binary import fmb_wire_flags
+            from fast_tffm_tpu.data.wire import WireConverter, make_spec
+
+            all_ones, _ = fmb_wire_flags(files)
+            uniform_w = weights is None or all(float(x) == 1.0 for x in weights)
+            convert = WireConverter(
+                make_spec(
+                    cfg.vocabulary_size,
+                    max_nnz,
+                    with_vals=not all_ones,
+                    with_fields=to_batch.uses_fields,
+                    with_weights=not uniform_w,
+                )
+            )
+    stats = InputStats()
+    gen = stats.timed(raw, convert)
     # Each queued item holds steps_per_call batches, so scale the depth
     # down to keep the in-flight memory (device superbatches for FMB
     # input, host staging for text) at the K=1 level — one or two
     # superbatches in flight already keep the consumer overlapped.
-    return prefetch(gen, depth=max(1, cfg.queue_size // max(1, steps_per_call)))
+    depth = max(1, cfg.queue_size // max(1, steps_per_call))
+    return InputStream(prefetch(gen, depth=depth, stats=stats), stats)
 
 
 def _evaluate(
@@ -346,7 +385,13 @@ def _run_training(
         for epoch in range(cfg.epoch_num):
             if stop_requested.is_set():
                 break
-            for b, parsed, w in train_stream(epoch):
+            epoch_stream = train_stream(epoch)
+            # Streamed inputs carry per-stream InputStats (wire bytes,
+            # parse/H2D ms, prefetch depth — data/wire.py); drained into
+            # kind=input records at every log point.  Device-cached
+            # streams are bare generators (no stats — no per-step wire).
+            input_stats = getattr(epoch_stream, "stats", None)
+            for b, parsed, w in epoch_stream:
                 if b is None:
                     b = to_batch(parsed, w)
                 tracer.on_step()
@@ -405,10 +450,26 @@ def _run_training(
                         examples_per_sec_per_chip=round(rate / n_chips, 1),
                         **extra,
                     )
+                    if input_stats is not None:
+                        rec = input_stats.drain()
+                        if rec:
+                            metrics.log(
+                                step=int(state.step), epoch=epoch,
+                                kind="input", **rec,
+                            )
                     losses.clear()
                     meter.reset()
             if stop_requested.is_set():
                 break
+            if input_stats is not None:
+                # Epoch-tail drain: the stream (and its stats) dies here,
+                # and a run (or tail) shorter than log_every would
+                # otherwise never emit its kind=input record at all.
+                rec = input_stats.drain()
+                if rec:
+                    metrics.log(
+                        step=int(state.step), epoch=epoch, kind="input", **rec
+                    )
             if losses:
                 # Epoch boundary syncs anyway (validation / checkpoint); a
                 # poisoned state must abort BEFORE the save below replaces
@@ -920,6 +981,11 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None, step_
                     mesh, parsed, w, with_fields=model.uses_fields
                 )
             return make_global_batch(mesh, parsed, w, with_fields=model.uses_fields)
+
+        # uses_fields WITHOUT wire_capable: the kind=input byte estimate
+        # stays honest (fields may be skipped) while the packed wire
+        # stays off this per-process stitch path.
+        to_batch.uses_fields = model.uses_fields
 
         examples_per_step = cfg.batch_size
 

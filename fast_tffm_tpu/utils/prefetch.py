@@ -16,9 +16,22 @@ import queue
 import threading
 from collections.abc import Iterable, Iterator
 
-__all__ = ["prefetch", "chunk"]
+__all__ = ["prefetch", "chunk", "InputStream"]
 
 _SENTINEL = object()
+
+
+class InputStream:
+    """An input iterator plus its InputStats (data/wire.py): the driver
+    iterates it like the bare generator it wraps and drains ``.stats``
+    into kind=input metrics records at log points."""
+
+    def __init__(self, it: Iterable, stats):
+        self._it = it
+        self.stats = stats
+
+    def __iter__(self) -> Iterator:
+        return iter(self._it)
 
 
 def chunk(it: Iterable, k: int) -> Iterator[list]:
@@ -42,8 +55,13 @@ def chunk(it: Iterable, k: int) -> Iterator[list]:
         yield buf
 
 
-def prefetch(it: Iterable, depth: int = 8) -> Iterator:
-    """Iterate ``it`` in a background thread, keeping ``depth`` items ready."""
+def prefetch(it: Iterable, depth: int = 8, stats=None) -> Iterator:
+    """Iterate ``it`` in a background thread, keeping ``depth`` items ready.
+
+    ``stats`` (an object with ``on_queue_depth(int)``) samples the queue
+    occupancy at every consumer pop — the overlap-efficiency signal the
+    kind=input metrics records carry (depth ~0 = producer-bound, depth at
+    the cap = consumer-bound)."""
     q: queue.Queue = queue.Queue(maxsize=max(1, depth))
     err: list[BaseException] = []
 
@@ -59,6 +77,8 @@ def prefetch(it: Iterable, depth: int = 8) -> Iterator:
     t = threading.Thread(target=worker, daemon=True)
     t.start()
     while True:
+        if stats is not None:
+            stats.on_queue_depth(q.qsize())
         item = q.get()
         if item is _SENTINEL:
             if err:

@@ -25,3 +25,48 @@ jax.config.update("jax_platforms", "cpu")
 # skipif), so no device-count assert here — an ambient XLA_FLAGS with a
 # smaller forced count must degrade to skips, not a collection error.
 assert jax.default_backend() == "cpu", "tests must run on the virtual CPU mesh"
+
+# Tier-1 wall-clock budget (ROADMAP's verify timeout is 870 s; leave slack
+# for collection + interpreter startup).  Exceeding it doesn't fail the
+# run — the driver's timeout already does that, brutally — but the summary
+# warning names the problem while it is one new test old, not twenty.
+TIER1_BUDGET_S = 700
+
+
+def pytest_collection_modifyitems(config, items):
+    """Collection-time tier-1 guard: tests that spawn multi-process worker
+    jobs (their module uses the ``_run_workers`` subprocess harness) MUST
+    carry ``@pytest.mark.slow``, or the 'not slow' verify gate silently
+    inherits minutes-long subprocess runs and blows the ROADMAP timeout.
+    Unknown markers are caught by --strict-markers (pytest.ini addopts)."""
+    offenders = [
+        item.nodeid
+        for item in items
+        if getattr(item.module, "_run_workers", None) is not None
+        and "slow" not in {m.name for m in item.iter_markers()}
+    ]
+    if offenders:
+        import pytest
+
+        raise pytest.UsageError(
+            "tier-1 guard: these tests use the subprocess worker harness "
+            "(_run_workers) but are not @pytest.mark.slow — they would run "
+            "inside the 'not slow' verify gate and exceed its timeout:\n  "
+            + "\n  ".join(offenders)
+        )
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    start = getattr(terminalreporter, "_sessionstarttime", None)
+    if start is None or "not slow" not in (config.getoption("-m") or ""):
+        return  # only the tier-1 selection carries the budget
+    import time as _time
+
+    elapsed = _time.time() - start
+    if elapsed > TIER1_BUDGET_S:
+        terminalreporter.write_line(
+            f"WARNING: 'not slow' suite took {elapsed:.0f}s > tier-1 budget "
+            f"{TIER1_BUDGET_S}s — the verify gate (870s hard timeout) is "
+            "at risk; mark long tests slow or trim them",
+            yellow=True,
+        )

@@ -84,6 +84,61 @@ def test_write_and_open_roundtrip(dataset):
     assert f.labels[0] == p.labels[0]
 
 
+def test_v1_file_still_readable(dataset):
+    """Wire-format-v2 compat pin: a version-1 FMB (pre-flags container)
+    opens, reports flags=0 (no elision promised), and streams batches
+    bit-identical to the v2 rewrite of the same source."""
+    from fast_tffm_tpu.data.binary import _HEADER, FMB_VERSION
+
+    a, _ = dataset
+    fa = write_fmb(a, a + ".fmb", vocabulary_size=1000)
+    assert FMB_VERSION == 2
+    v2 = _collect(fmb_batch_stream([fa], batch_size=16, vocabulary_size=1000))
+    # Rewrite the header as v1: version=1, flags byte zeroed (v1 pad).
+    with open(fa, "r+b") as fh:
+        vals = list(_HEADER.unpack(fh.read(_HEADER.size)))
+        vals[1] = 1  # version
+        vals[7] = 0  # flags slot was padding in v1
+        fh.seek(0)
+        fh.write(_HEADER.pack(*vals))
+    f = open_fmb(fa)
+    assert f.flags == 0
+    from fast_tffm_tpu.data.binary import fmb_wire_flags
+
+    assert fmb_wire_flags([fa]) == (False, False)  # conservative: no elision
+    v1 = _collect(fmb_batch_stream([fa], batch_size=16, vocabulary_size=1000))
+    _assert_streams_equal(v1, v2)
+    # Unknown future versions still refuse loudly.
+    with open(fa, "r+b") as fh:
+        vals[1] = 3
+        fh.seek(0)
+        fh.write(_HEADER.pack(*vals))
+    with pytest.raises(ValueError, match="version"):
+        open_fmb(fa)
+
+
+def test_v1_cache_rebuilds_to_v2(dataset):
+    """binary_cache: a fresh-looking v1 cache (pre-wire-flags) rebuilds
+    ONCE so the packed wire's elision flags get computed — otherwise the
+    upgrade would silently never engage for cache users."""
+    from fast_tffm_tpu.data.binary import _HEADER, FMB_VERSION
+
+    a, _ = dataset
+    cache = ensure_fmb_cache([a], vocabulary_size=1000)[0]
+    with open(cache, "rb") as fh:
+        assert _HEADER.unpack(fh.read(_HEADER.size))[1] == FMB_VERSION  # v2 written
+    # Downgrade the cache header to v1 in place (src size/mtime still match).
+    with open(cache, "r+b") as fh:
+        vals = list(_HEADER.unpack(fh.read(_HEADER.size)))
+        vals[1], vals[7] = 1, 0  # version=1, flags zeroed
+        fh.seek(0)
+        fh.write(_HEADER.pack(*vals))
+    cache2 = ensure_fmb_cache([a], vocabulary_size=1000)[0]
+    assert cache2 == cache
+    with open(cache, "rb") as fh:
+        assert _HEADER.unpack(fh.read(_HEADER.size))[1] == FMB_VERSION
+
+
 @pytest.mark.parametrize(
     "kw",
     [

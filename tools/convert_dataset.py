@@ -12,6 +12,10 @@ then accept the .fmb paths directly in train_files/predict_files — or set
 fresh) automatically.
 
 --inspect prints an existing FMB file's header instead of converting.
+--stats additionally scans each output for wire compressibility: the
+all-ones-vals fraction, the constant-fields fraction, and the projected
+packed-wire byte saving (wire_format = packed elides what the v2 header
+flags promise).  Inputs that already are FMB are scanned in place.
 """
 
 import argparse
@@ -31,9 +35,17 @@ def main():
     ap.add_argument("-o", "--output", nargs="*", default=None,
                     help="output paths (default: <file>.fmb), aligned with files")
     ap.add_argument("--inspect", action="store_true", help="print FMB headers and exit")
+    ap.add_argument(
+        "--stats",
+        action="store_true",
+        help="report per-file wire compressibility (all-ones-vals / "
+        "constant-fields fractions, projected packed-wire saving)",
+    )
     args = ap.parse_args()
 
-    from fast_tffm_tpu.data.binary import open_fmb, write_fmb
+    import json
+
+    from fast_tffm_tpu.data.binary import fmb_stats, is_fmb, open_fmb, write_fmb
 
     if args.inspect:
         for path in args.files:
@@ -41,7 +53,8 @@ def main():
             print(
                 f"{path}: rows={f.n_rows} width={f.width} "
                 f"vocabulary_size={f.vocabulary_size} hashed={f.hashed} "
-                f"ids={f.ids.dtype} bytes={os.path.getsize(path)}"
+                f"ids={f.ids.dtype} flags={f.flags} "
+                f"bytes={os.path.getsize(path)}"
             )
         return
 
@@ -49,6 +62,10 @@ def main():
     if len(outs) != len(args.files):
         ap.error(f"{len(outs)} outputs for {len(args.files)} inputs")
     for src, dst in zip(args.files, outs):
+        if args.stats and is_fmb(src):
+            # Already converted: scan in place, no rebuild.
+            print(json.dumps(fmb_stats(src)))
+            continue
         t0 = time.perf_counter()
         write_fmb(
             src,
@@ -64,6 +81,8 @@ def main():
             f"{os.path.getsize(dst)} bytes in {dt:.1f}s "
             f"({f.n_rows / max(dt, 1e-9):,.0f} rows/s)"
         )
+        if args.stats:
+            print(json.dumps(fmb_stats(dst)))
 
 
 if __name__ == "__main__":
