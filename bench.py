@@ -38,15 +38,17 @@ import json
 import os
 import time
 
-import _bench_watchdog
+# telemetry's hang-exit watchdog is importable WITHOUT jax (the package
+# __init__ is lazy for exactly this): armed before the jax import below.
+from fast_tffm_tpu.telemetry import arm_hang_exit
 
-# Armed before jax/fast_tffm_tpu imports: backend init inside `import jax`
+# Armed before jax/backend init: backend init inside `import jax`
 # is itself a known hang point behind a dead tunnel.  Budget covers the
 # fallback ladder (each rejected rung costs a ~60s failed remote compile)
 # PLUS the honest value-synced measurement: steps genuinely cost
 # 0.1-0.7 s each on this backend (DESIGN 6), so windows take real time.
 if __name__ == "__main__":
-    _watchdog = _bench_watchdog.arm(seconds=3300, what="bench.py")
+    _watchdog = arm_hang_exit(seconds=3300, what="bench.py")
 else:
     # Imported as a library (bench_all / tools reuse forced_sync etc.):
     # arming here would plant a stray os._exit timer inside the importer's
@@ -638,7 +640,7 @@ def main():
                 # may be nearly spent by the failed full run, and dying
                 # mid-retry without a line is worse than a late line.
                 _watchdog.cancel()
-                _watchdog = _bench_watchdog.arm(seconds=3000, what="bench.py retry")
+                _watchdog = arm_hang_exit(seconds=3000, what="bench.py retry")
                 env = dict(os.environ, BENCH_RUNG=str(cand))
                 try:
                     r = subprocess.run(
@@ -1037,19 +1039,30 @@ def main():
             "table, Zipf(1.1) ids, fused tile-row layout, capped compact tail)"
         )
     _watchdog.cancel()
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": value,
-                "unit": "examples/sec/chip",
-                "vs_baseline": round(
-                    value / BASELINE_EXAMPLES_PER_SEC_PER_CHIP, 4
-                ),
-                **results,
-            }
-        )
-    )
+    result = {
+        "metric": metric,
+        "value": value,
+        "unit": "examples/sec/chip",
+        "vs_baseline": round(value / BASELINE_EXAMPLES_PER_SEC_PER_CHIP, 4),
+        **results,
+    }
+    print(json.dumps(result))
+    # Round-over-round delta table: REPORT_rNN.md next to the committed
+    # BENCH_r*.json artifacts (tools/report.py) — the bench's own compare
+    # gate output, written best-effort AFTER the result line so a report
+    # failure can never cost the number.
+    try:
+        import sys
+
+        from tools.report import write_bench_report
+
+        rp = write_bench_report(result, os.path.dirname(os.path.abspath(__file__)))
+        if rp:
+            print(f"bench report -> {rp}", file=sys.stderr)
+    except Exception as e:
+        import sys
+
+        print(f"bench report skipped: {e!r}", file=sys.stderr)
 
 
 if __name__ == "__main__":

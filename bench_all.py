@@ -26,12 +26,13 @@ import json
 import sys
 import time
 
-import _bench_watchdog
+from fast_tffm_tpu.telemetry import arm_hang_exit
 
-# Armed before jax/fast_tffm_tpu imports (backend init can hang behind a
-# dead tunnel); generous budget — the --full sweep is ~25-35 min healthy
+# Armed before the jax import below (backend init can hang behind a dead
+# tunnel; telemetry + the lazy package __init__ stay jax-free for exactly
+# this); generous budget — the --full sweep is ~25-35 min healthy
 # (the 2.4M-row convergence dataset dominates: generation + one parse).
-_watchdog = _bench_watchdog.arm(seconds=3600, what="bench_all.py")
+_watchdog = arm_hang_exit(seconds=3600, what="bench_all.py")
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402

@@ -11,40 +11,70 @@ parameter-server sharding, redone as `jax.sharding` + collectives).
 
 __version__ = "0.1.0"
 
-from fast_tffm_tpu.config import Config, build_model, load_config  # noqa: F401
-from fast_tffm_tpu.data.binary import open_fmb, write_fmb  # noqa: F401
-from fast_tffm_tpu.metrics import StreamingAUC, auc  # noqa: F401
-from fast_tffm_tpu.models import Batch, DeepFMModel, FFMModel, FMModel  # noqa: F401
-from fast_tffm_tpu.ops.fm import fm_score  # noqa: F401
+import importlib
 
-__all__ = [
-    "Batch",
-    "Config",
-    "DeepFMModel",
-    "FFMModel",
-    "FMModel",
-    "StreamingAUC",
-    "auc",
-    "build_model",
-    "fm_score",
-    "load_config",
-    "open_fmb",
-    "write_fmb",
-    "train",
-    "dist_train",
-    "predict",
-    "dist_predict",
-    "ServingEngine",
-    "serve_lines",
-]
+# PEP 562 lazy exports.  Two reasons this is a name table and not a block
+# of eager imports:
+#
+#   * the package exports pull in jax (models, drivers) — but the
+#     telemetry module's hang-exit watchdog must be armable BEFORE
+#     ``import jax`` (backend init behind a dead TPU tunnel is itself a
+#     known hang point, bench.py's headnote), so
+#     ``import fast_tffm_tpu.telemetry`` has to stay jax-free, which
+#     means THIS module has to stay jax-free;
+#   * CLI startup (`--help`, config errors) stops paying backend-init
+#     latency on paths that never touch a device.
+#
+# Driver modules are named training/prediction — NOT train/predict — so
+# the package-level FUNCTIONS (the reference's entrypoint vocabulary)
+# never collide with a submodule attribute: `from fast_tffm_tpu import
+# train` is always the function, and `fast_tffm_tpu.training.scan_max_nnz`
+# -style module access keeps working.  Heavy optional deps (orbax) stay
+# lazy inside the driver modules.
+_EXPORTS = {
+    "Config": "fast_tffm_tpu.config",
+    "build_model": "fast_tffm_tpu.config",
+    "load_config": "fast_tffm_tpu.config",
+    "open_fmb": "fast_tffm_tpu.data.binary",
+    "write_fmb": "fast_tffm_tpu.data.binary",
+    "StreamingAUC": "fast_tffm_tpu.metrics",
+    "auc": "fast_tffm_tpu.metrics",
+    "Batch": "fast_tffm_tpu.models",
+    "DeepFMModel": "fast_tffm_tpu.models",
+    "FFMModel": "fast_tffm_tpu.models",
+    "FMModel": "fast_tffm_tpu.models",
+    "fm_score": "fast_tffm_tpu.ops.fm",
+    "predict": "fast_tffm_tpu.prediction",
+    "dist_predict": "fast_tffm_tpu.prediction",
+    "ServingEngine": "fast_tffm_tpu.serving",
+    "serve_lines": "fast_tffm_tpu.serving",
+    "RunMonitor": "fast_tffm_tpu.telemetry",
+    "train": "fast_tffm_tpu.training",
+    "dist_train": "fast_tffm_tpu.training",
+}
+
+__all__ = sorted(_EXPORTS)
 
 
-# Driver modules are named training/prediction — NOT train/predict — so the
-# package-level FUNCTIONS (the reference's entrypoint vocabulary) never
-# collide with a submodule attribute: `from fast_tffm_tpu import train` is
-# always the function, and `fast_tffm_tpu.training.scan_max_nnz`-style
-# module access keeps working.  Heavy optional deps (orbax) stay lazy
-# inside the driver modules.
-from fast_tffm_tpu.prediction import dist_predict, predict  # noqa: F401, E402
-from fast_tffm_tpu.serving import ServingEngine, serve_lines  # noqa: F401, E402
-from fast_tffm_tpu.training import dist_train, train  # noqa: F401, E402
+def __getattr__(name: str):
+    mod = _EXPORTS.get(name)
+    if mod is not None:
+        value = getattr(importlib.import_module(mod), name)
+    else:
+        # The eager imports used to bind submodules as package attributes
+        # (`fast_tffm_tpu.training.scan_max_nnz`-style access, documented
+        # above) — keep that working lazily too.
+        try:
+            value = importlib.import_module(f"{__name__}.{name}")
+        except ModuleNotFoundError as e:
+            if e.name != f"{__name__}.{name}":
+                raise  # the submodule EXISTS but one of its deps is missing
+            raise AttributeError(
+                f"module {__name__!r} has no attribute {name!r}"
+            ) from None
+    globals()[name] = value  # cache: resolve each name once
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

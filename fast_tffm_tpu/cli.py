@@ -26,6 +26,20 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("config", help="INI config file (see sample.cfg)")
     ap.add_argument("legacy", nargs="*", help="ignored job_name/task_index (TF-1.x compat)")
     ap.add_argument("--resume", action="store_true", help="resume training from model_file")
+    ap.add_argument(
+        "--metrics-path",
+        default=None,
+        metavar="PATH",
+        help="telemetry JSONL sink; overrides [Train] metrics_path so a run "
+        "can be instrumented (tools/report.py) without editing the config",
+    )
+    ap.add_argument(
+        "--run-id",
+        default=None,
+        metavar="ID",
+        help="telemetry run id stamped on every record; overrides "
+        "[Telemetry] run_id (default: auto-generated per run)",
+    )
     args = ap.parse_args(argv)
 
     from fast_tffm_tpu.utils.platform import apply_platform_env
@@ -33,6 +47,10 @@ def main(argv: list[str] | None = None) -> int:
     apply_platform_env()
 
     cfg = load_config(args.config)
+    if args.metrics_path is not None:
+        cfg.metrics_path = args.metrics_path
+    if args.run_id is not None:
+        cfg.telemetry_run_id = args.run_id
     if args.legacy:
         print(
             f"note: ignoring legacy cluster args {args.legacy!r} — the SPMD mesh "

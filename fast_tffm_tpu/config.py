@@ -88,7 +88,15 @@ class Config:
     save_every_epochs: int = 1
     trace_dir: str = ""  # jax.profiler trace output (TensorBoard/XProf)
     trace_steps: int = 20  # bounded trace window length (after warmup)
-    metrics_path: str = ""  # JSONL step-metrics sink
+    metrics_path: str = ""  # JSONL telemetry sink (enveloped records; see
+    #   telemetry.py SCHEMAS and tools/report.py)
+    # [Telemetry] — the RunMonitor knobs (records go to metrics_path)
+    telemetry_run_id: str = ""  # envelope run id; empty = auto-generated
+    telemetry_mem_every_s: float = 30.0  # kind=mem watermark cadence
+    #   (0 = only the guaranteed final record at close)
+    telemetry_stall_timeout_s: float = 0.0  # liveness watchdog: dump thread
+    #   stacks + prefetch depth as kind=stall when no step completes for
+    #   this many seconds (0 = watchdog off)
     # [Predict]
     predict_files: tuple[str, ...] = ()
     score_path: str = "scores.txt"
@@ -225,6 +233,11 @@ class Config:
             raise ValueError(
                 "serve_reload_interval_s and serve_metrics_every_s must be >= 0"
             )
+        if self.telemetry_mem_every_s < 0 or self.telemetry_stall_timeout_s < 0:
+            raise ValueError(
+                "telemetry_mem_every_s and telemetry_stall_timeout_s must be "
+                ">= 0 (0 disables)"
+            )
         if self.packed_update not in ("auto", "dense", "compact", "sorted"):
             raise ValueError(
                 f"unknown packed_update {self.packed_update!r} "
@@ -360,6 +373,13 @@ def load_config(path: str) -> Config:
     cfg.trace_dir = get(t, "trace_dir", str, cfg.trace_dir)
     cfg.trace_steps = get(t, "trace_steps", int, cfg.trace_steps)
     cfg.metrics_path = get(t, "metrics_path", str, cfg.metrics_path)
+
+    te = "Telemetry"
+    cfg.telemetry_run_id = get(te, "run_id", str, cfg.telemetry_run_id)
+    cfg.telemetry_mem_every_s = get(te, "mem_every_s", float, cfg.telemetry_mem_every_s)
+    cfg.telemetry_stall_timeout_s = get(
+        te, "stall_timeout_s", float, cfg.telemetry_stall_timeout_s
+    )
 
     p = "Predict"
     cfg.predict_files = get(p, "predict_files", _split_files, cfg.predict_files)

@@ -389,7 +389,24 @@ class InputStats:
         import threading
 
         self._lock = threading.Lock()
+        self._queue = None  # bound by prefetch(); live-depth probe
+        self.last_depth = None  # most recent consumer-pop sample
         self._reset()
+
+    def bind_queue(self, q) -> None:
+        """prefetch() hands over its queue so ``queue_depth`` can read
+        LIVE occupancy (the stall watchdog asks from another thread,
+        exactly when the consumer has stopped sampling)."""
+        self._queue = q
+
+    def queue_depth(self) -> int | None:
+        q = self._queue
+        if q is not None:
+            try:
+                return int(q.qsize())
+            except Exception:
+                pass
+        return self.last_depth
 
     def _reset(self):
         self.items = 0  # queue items (superbatch = 1 item)
@@ -448,6 +465,7 @@ class InputStats:
 
     def on_queue_depth(self, depth: int) -> None:
         with self._lock:
+            self.last_depth = depth
             self.q_depth_sum += depth
             self.q_samples += 1
 

@@ -10,6 +10,7 @@ train loop.
 from __future__ import annotations
 
 import time
+from collections import deque
 
 import numpy as np
 
@@ -413,19 +414,58 @@ class StreamingAUC:
 
 
 class Throughput:
-    """Examples/sec meter over a sliding window of steps."""
+    """Examples/sec meter over a sliding window of recent steps.
 
-    def __init__(self):
-        self._t0 = time.perf_counter()
-        self._examples = 0
+    The original meter was cumulative-since-reset while its docstring
+    claimed a sliding window: minutes after the last reset, a sudden
+    slowdown averaged into invisibility.  This one keeps a deque of
+    ``(t, n)`` step samples and reports the rate over the trailing
+    ``window_s`` seconds — the ``examples_per_sec`` telemetry field
+    tracks CURRENT throughput even when a driver stops resetting.
+
+    ``rate()`` divides the in-window example count by the window span
+    measured from ``max(last reset, now - window_s)`` — so shortly after
+    a reset it behaves exactly like the old meter (the drivers reset at
+    every log point), and only long unreset stretches change behavior.
+    ``clock`` is injectable for deterministic tests.  Memory is bounded:
+    past ``max_samples`` the two oldest samples merge (their step
+    boundary blurs; totals stay exact).
+    """
+
+    def __init__(
+        self, window_s: float = 60.0, max_samples: int = 8192, clock=time.perf_counter
+    ):
+        if window_s <= 0:
+            raise ValueError(f"window_s must be > 0, got {window_s}")
+        self._window_s = float(window_s)
+        self._max_samples = max(2, int(max_samples))
+        self._clock = clock
+        self._samples: deque[tuple[float, int]] = deque()
+        self._in_window = 0
+        self._t0 = clock()  # window anchor: max(reset time, pruned cutoff)
 
     def add(self, n: int):
-        self._examples += n
+        self._samples.append((self._clock(), n))
+        self._in_window += n
+        if len(self._samples) > self._max_samples:
+            (t1, n1), (_, n2) = self._samples.popleft(), self._samples.popleft()
+            self._samples.appendleft((t1, n1 + n2))
+
+    def _prune(self, now: float) -> None:
+        cutoff = now - self._window_s
+        while self._samples and self._samples[0][0] < cutoff:
+            _, n = self._samples.popleft()
+            self._in_window -= n
+        if cutoff > self._t0:
+            self._t0 = cutoff
 
     def rate(self) -> float:
-        dt = time.perf_counter() - self._t0
-        return self._examples / dt if dt > 0 else 0.0
+        now = self._clock()
+        self._prune(now)
+        dt = now - self._t0
+        return self._in_window / dt if dt > 0 else 0.0
 
     def reset(self):
-        self._t0 = time.perf_counter()
-        self._examples = 0
+        self._samples.clear()
+        self._in_window = 0
+        self._t0 = self._clock()

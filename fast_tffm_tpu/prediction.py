@@ -8,6 +8,7 @@ score path; dist variant shards input across workers).
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, NamedTuple
 
 import jax
@@ -16,6 +17,7 @@ import numpy as np
 from fast_tffm_tpu.checkpoint import restore_checkpoint
 from fast_tffm_tpu.config import Config, build_model
 from fast_tffm_tpu.models.base import Batch
+from fast_tffm_tpu.telemetry import RunMonitor
 from fast_tffm_tpu.training import _batch_converter, _stream, scan_max_nnz
 from fast_tffm_tpu.trainer import init_state, make_predict_step
 
@@ -153,8 +155,24 @@ def _run_predict(
         if is_lead:
             log(f"predict input sharding: {total} rows over {nproc} processes")
     n = 0
-    out = open(cfg.score_path, "w") if is_lead else None
+    batches = 0
+    # Same envelope/sentinels as training, tagged source=predict: a
+    # steady-state recompile or a parse stall in a backfill surfaces in
+    # the same JSONL stream tools/report.py reads.
+    monitor = RunMonitor(
+        cfg.metrics_path if is_lead else None,
+        run_id=cfg.telemetry_run_id,
+        source="predict",
+        stall_timeout_s=cfg.telemetry_stall_timeout_s,
+        mem_every_s=cfg.telemetry_mem_every_s,
+        log=log,
+    )
+    t_start = time.perf_counter()
+    out = None
     try:
+        # Inside the try: an unwritable score_path must still close the
+        # monitor (summary record, watchdog thread) on the way out.
+        out = open(cfg.score_path, "w") if is_lead else None
         # _stream owns the prefetch wiring AND the conversion-placement
         # policy (H2D in the prefetch thread iff the input is FMB-backed);
         # a None batch means convert here in the consumer (text input).
@@ -168,10 +186,13 @@ def _run_predict(
             to_batch=to_batch,
             **stream_kw,
         )
+        monitor.set_queue_depth_fn(getattr(stream, "queue_depth", None))
         for b, parsed, w in stream:
             if b is None:
                 b = to_batch(parsed, w)
             scores = np.asarray(predict_step(state, b))
+            batches += 1
+            monitor.on_dispatch(batches, warmup=(batches == 1))
             if not np.isfinite(scores).all():
                 # Under lookup_overflow=fallback an overflow cannot
                 # poison scores (the lookup reran via allgather).
@@ -181,6 +202,9 @@ def _run_predict(
                     "fallback, or use lookup=allgather) or a diverged model"
                     if cfg.lookup == "alltoall" and cfg.lookup_overflow == "abort"
                     else "a diverged model (non-finite weights)"
+                )
+                monitor.emit_anomaly(
+                    batches, None, event="nonfinite_scores", state=state
                 )
                 raise RuntimeError(
                     f"non-finite scores — {cause}; refusing to write a "
@@ -196,9 +220,22 @@ def _run_predict(
                 for s in scores[real]:
                     out.write(f"{s:.6f}\n")
             n += int(real.sum())
+        dt = time.perf_counter() - t_start
+        stats = getattr(stream, "stats", None)
+        if stats is not None:
+            rec = stats.drain()
+            if rec:
+                monitor.emit("input", step=batches, **rec)
+        monitor.emit(
+            "predict",
+            step=batches,
+            examples=n,
+            examples_per_sec=round(n / dt, 1) if dt > 0 else None,
+        )
     finally:
         if out is not None:
             out.close()
+        monitor.close()
     if is_lead:
         log(f"wrote {n} scores -> {cfg.score_path}")
     return cfg.score_path

@@ -43,7 +43,7 @@ from fast_tffm_tpu.config import Config
 from fast_tffm_tpu.data.libsvm import parse_lines
 from fast_tffm_tpu.serving.buckets import BucketLadder
 from fast_tffm_tpu.serving.metrics import ServingMetrics
-from fast_tffm_tpu.utils.tracing import MetricsLogger
+from fast_tffm_tpu.telemetry import RunMonitor
 
 __all__ = ["ServingEngine", "OverloadError", "EngineClosed", "serve_lines"]
 
@@ -133,7 +133,19 @@ class ServingEngine:
         self._policy = cfg.serve_overload
         self._q: queue.Queue = queue.Queue(maxsize=cfg.serve_queue_size)
         self.metrics = ServingMetrics()
-        self._metrics_logger = MetricsLogger(cfg.metrics_path)
+        # kind=serving records ride the same telemetry envelope as the
+        # train/predict drivers (shared run_id per engine lifetime); the
+        # compile sentinel turns any steady-state flush compile into a
+        # kind=compile event — the bucket-ladder pin, now observable.
+        # No stall watchdog here: an idle engine is healthy, not stalled.
+        self._monitor = RunMonitor(
+            cfg.metrics_path,
+            run_id=cfg.telemetry_run_id,
+            source="serving",
+            mem_every_s=cfg.telemetry_mem_every_s,
+            log=log,
+        )
+        self._flush_seq = 0  # telemetry step for serving = flush ordinal
         self._metrics_every = cfg.serve_metrics_every_s
         self._last_metrics_log = time.perf_counter()
         self._closed = False  # no new submits (set by close AND by a
@@ -150,6 +162,9 @@ class ServingEngine:
         self._staged_step = None
 
         n = self._ladder.warmup(self._state)
+        # Attribute every startup compile (ladder rungs + unpackers) to
+        # warmup; anything the sentinel sees after this is steady-state.
+        self._monitor.on_dispatch(0, warmup=True)
         log(
             f"serving: warmed buckets {self._ladder.buckets} "
             f"(max_nnz {max_nnz}, {n if n >= 0 else '?'} compiled programs, "
@@ -395,6 +410,14 @@ class ServingEngine:
         for i, r in enumerate(pending):
             r.future.set_result(float(scores[i]))
         t_resolved = time.perf_counter()
+        self._flush_seq += 1
+        try:
+            self._monitor.on_dispatch(self._flush_seq)
+        except Exception:
+            # Same stance as the metrics writes below: a telemetry I/O
+            # failure (ENOSPC mem record) degrades to a lost record —
+            # it must NEVER kill the collector.
+            pass
         self.metrics.on_flush(
             bucket,
             len(pending),
@@ -409,7 +432,7 @@ class ServingEngine:
         ):
             self._last_metrics_log = t_resolved
             try:
-                self.metrics.log_to(self._metrics_logger)
+                self.metrics.log_to(self._monitor)
             except Exception:
                 # A full metrics disk (ENOSPC) must degrade to lost
                 # metrics records, never to a dead collector: every
@@ -475,11 +498,14 @@ class ServingEngine:
             # Same stance as the in-flush writes: a metrics I/O failure
             # (ENOSPC) degrades to a lost record, it must not turn an
             # otherwise-successful serve run into a nonzero exit.
-            self.metrics.log_to(self._metrics_logger)
+            self.metrics.log_to(self._monitor)
         except Exception:
             pass
         finally:
-            self._metrics_logger.close()
+            try:
+                self._monitor.close()
+            except Exception:
+                pass
 
     def __enter__(self):
         return self

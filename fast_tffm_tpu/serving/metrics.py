@@ -170,8 +170,14 @@ class ServingMetrics:
                 "total_ms": self.total.snapshot(),
             }
 
-    def log_to(self, metrics_logger) -> None:
-        """Append the snapshot to a utils.tracing.MetricsLogger (no-op
-        logger ⇒ no-op here), tagged so serving records can be filtered
-        out of a shared train/serve metrics file."""
-        metrics_logger.log(kind="serving", **self.snapshot())
+    def log_to(self, sink) -> None:
+        """Append the snapshot as a ``kind=serving`` record.  ``sink`` is
+        a telemetry.RunMonitor (the engine's — records get the shared
+        envelope) or, for bare callers, a utils.tracing.MetricsLogger
+        (no-op logger ⇒ no-op here)."""
+        snap = self.snapshot()
+        emit = getattr(sink, "emit", None)
+        if emit is not None:
+            emit("serving", **snap)
+        else:
+            sink.log(kind="serving", **snap)

@@ -1,0 +1,633 @@
+"""Run telemetry: one envelope, three sentinels, one sink.
+
+The reference's only observability was periodic loss prints (SURVEY.md
+§5: TF RunMetadata existed but was never wired), and this repo had grown
+three UNRELATED emitters on top of that — per-window ``kind=input``
+stats, ``kind=serving`` histograms, and bare step records — sharing no
+schema and carrying no run identity.  ``RunMonitor`` unifies them:
+
+  * **envelope** — every record carries ``run_id`` (one per driver run),
+    ``schema_version``, ``kind``, a monotonic ``step``, ``t`` (monotonic
+    seconds since the run started — immune to wall-clock jumps) and
+    ``ts`` (wall clock, for humans).  Per-kind required keys live in
+    ``SCHEMAS`` and are pinned by tests/test_telemetry.py — schema drift
+    is a test failure, not a silently broken dashboard.
+  * **compile sentinel** — a process-wide ``jax.monitoring`` listener
+    counts XLA backend compiles; ``on_dispatch`` drains the delta each
+    driver dispatch, so a steady-state recompile in train/predict/serving
+    surfaces as a ``kind=compile`` event (the generalization of the
+    serving bucket-ladder's flat-jit-cache pin).  Compiles issued from
+    the prefetch thread (packed-wire unpack programs) attribute to the
+    next dispatch that drains.
+  * **memory watermarks** — periodic ``kind=mem`` records with host RSS
+    (/proc, with ru_maxrss as the peak floor) and device live-buffer
+    bytes (``memory_stats`` where the runtime exposes it, live-array sum
+    otherwise), plus peak-so-far; one final record is always emitted at
+    close so every run documents its high-water mark.
+  * **liveness watchdog** — a heartbeat thread: when no dispatch
+    completes for ``stall_timeout_s``, it dumps every Python thread's
+    stack and the prefetch queue depth as a ``kind=stall`` event,
+    classified input-starved (empty queue: the producer is the
+    bottleneck) vs device-bound (data ready, the consumer/device is
+    wedged).  Armed by the first completed dispatch; suspended
+    (``suspended()``) through phases that legitimately dispatch nothing
+    (validation, checkpoint saves); defers while a stack shows an XLA
+    compile in progress (slow, not stuck — up to 10x the deadline, then
+    fires classified "compiling").  One event per stall episode; a
+    recovered-then-stalled run fires again.
+
+``arm_hang_exit`` is the absorbed ``_bench_watchdog.py``: the hard
+os._exit timer the bench/probe tools arm BEFORE ``import jax`` (backend
+init behind a dead TPU tunnel is itself a known hang point).  That
+contract is why this module — and the package ``__init__`` — must import
+without jax; everything jax-touching here is lazy and degrades to a
+no-op when jax is absent.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import threading
+import time
+import traceback
+
+from fast_tffm_tpu.utils.tracing import MetricsLogger
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "ENVELOPE_FIELDS",
+    "SCHEMAS",
+    "RunMonitor",
+    "CompileSentinel",
+    "new_run_id",
+    "thread_stacks",
+    "classify_stall",
+    "first_nonfinite_leaf",
+    "arm_hang_exit",
+]
+
+SCHEMA_VERSION = 1
+
+# Fields every record carries (ts is stamped by MetricsLogger).
+ENVELOPE_FIELDS = ("run_id", "schema_version", "kind", "step", "t", "ts")
+
+# kind -> keys REQUIRED on every record of that kind (beyond the
+# envelope).  Values may be null when a source genuinely cannot measure
+# them (e.g. device_bytes without a backend), but the key must be there —
+# a missing key means the emitter and the readers have drifted.
+# Extra keys are allowed (extra_metrics merges, serving counters).
+SCHEMAS: dict[str, tuple[str, ...]] = {
+    "train": ("epoch", "loss", "examples_per_sec", "examples_per_sec_per_chip"),
+    "validation": ("epoch", "validation_auc"),
+    "input": ("input_items", "input_steps", "input_examples", "parse_ms"),
+    "predict": ("examples", "examples_per_sec"),
+    "serving": ("requests", "flushes", "rows", "queue_ms", "compute_ms", "total_ms"),
+    "compile": ("source", "compiles", "total_compiles", "warmup"),
+    "mem": (
+        "host_rss_bytes",
+        "host_rss_peak_bytes",
+        "device_bytes",
+        "device_peak_bytes",
+    ),
+    "stall": (
+        "deadline_s",
+        "since_last_step_s",
+        "classification",
+        "prefetch_queue_depth",
+        "stacks",
+    ),
+    "anomaly": ("event", "loss"),
+    "summary": ("total_compiles", "steady_compiles", "stalls", "anomalies"),
+}
+
+
+def new_run_id() -> str:
+    """Sortable-by-start-time and collision-safe across processes."""
+    return f"{time.strftime('%Y%m%d-%H%M%S')}-{os.getpid():x}-{os.urandom(3).hex()}"
+
+
+# -- compile sentinel -----------------------------------------------------
+
+# One process-wide counter fed by one jax.monitoring listener: jax has no
+# listener UNregistration in its public API, so per-monitor listeners
+# would leak across every test/run in a process.  Sentinels snapshot the
+# counter instead.
+_compile_lock = threading.Lock()
+_compile_count = 0
+_listener_state = [None]  # None = not tried, True/False = outcome
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+def _on_duration_event(event: str, duration: float, **kw) -> None:
+    global _compile_count
+    if event == _COMPILE_EVENT:
+        with _compile_lock:
+            _compile_count += 1
+
+
+def _ensure_compile_listener() -> bool:
+    if _listener_state[0] is None:
+        try:
+            import jax.monitoring
+
+            jax.monitoring.register_event_duration_secs_listener(_on_duration_event)
+            _listener_state[0] = True
+        except Exception:
+            _listener_state[0] = False
+    return _listener_state[0]
+
+
+def global_compile_count() -> int:
+    """XLA backend compiles observed process-wide since the first
+    sentinel was created (0 before that)."""
+    with _compile_lock:
+        return _compile_count
+
+
+class CompileSentinel:
+    """Per-consumer view of the process-wide compile counter.
+
+    ``drain()`` returns how many XLA backend compiles happened since the
+    previous drain (or construction).  Concurrent consumers (a trainer
+    and a serving engine in one process) each see every compile — the
+    counter is global, attribution is the caller's framing.
+    """
+
+    def __init__(self):
+        self._ok = _ensure_compile_listener()
+        self._seen = global_compile_count()
+
+    @property
+    def available(self) -> bool:
+        return bool(self._ok)
+
+    def drain(self) -> int:
+        if not self._ok:
+            return 0
+        n = global_compile_count()
+        delta = n - self._seen
+        self._seen = n
+        return delta
+
+
+# -- memory watermarks ----------------------------------------------------
+
+
+def host_rss_bytes() -> int | None:
+    """Current resident set size (linux /proc; None where unreadable)."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        pass
+    return None
+
+
+def _ru_maxrss_bytes() -> int | None:
+    try:
+        import resource
+
+        v = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        # Linux reports KiB; macOS reports bytes.
+        return int(v) if sys.platform == "darwin" else int(v) * 1024
+    except Exception:
+        return None
+
+
+def device_live_bytes() -> int | None:
+    """Live device-buffer bytes: runtime memory_stats where exposed
+    (real TPU/GPU backends), falling back to summing live jax arrays
+    (CPU backend exposes no allocator stats).  None without jax."""
+    if "jax" not in sys.modules:
+        # Never the import that drags the backend up — telemetry observes.
+        return None
+    try:
+        import jax
+
+        total, had_stats = 0, False
+        for d in jax.local_devices():
+            ms = getattr(d, "memory_stats", None)
+            stats = ms() if callable(ms) else None
+            if stats and "bytes_in_use" in stats:
+                total += int(stats["bytes_in_use"])
+                had_stats = True
+        if had_stats:
+            return total
+        return int(sum(int(x.nbytes) for x in jax.live_arrays()))
+    except Exception:
+        return None
+
+
+class _MemWatermarks:
+    """Sample-and-track-peaks; ru_maxrss floors the host peak so the
+    watermark is honest even when sampling missed the actual spike."""
+
+    def __init__(self):
+        self._host_peak = 0
+        self._dev_peak = 0
+
+    def sample(self) -> dict:
+        host = host_rss_bytes()
+        dev = device_live_bytes()
+        if host is not None:
+            self._host_peak = max(self._host_peak, host)
+        maxrss = _ru_maxrss_bytes()
+        if maxrss is not None:
+            self._host_peak = max(self._host_peak, maxrss)
+        if dev is not None:
+            self._dev_peak = max(self._dev_peak, dev)
+        return {
+            "host_rss_bytes": host,
+            "host_rss_peak_bytes": self._host_peak or None,
+            "device_bytes": dev,
+            "device_peak_bytes": self._dev_peak if dev is not None else None,
+        }
+
+
+# -- stall forensics ------------------------------------------------------
+
+
+def thread_stacks(max_frames: int = 25) -> dict[str, str]:
+    """Formatted stack of every live Python thread (deepest frames kept),
+    keyed by thread name — the watchdog's core forensic payload."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = {}
+    for ident, frame in sys._current_frames().items():
+        name = names.get(ident) or f"thread-{ident}"
+        lines = traceback.format_stack(frame)
+        out[name] = "".join(lines[-max_frames:])
+    return out
+
+
+_DEVICE_MARKERS = (
+    "block_until_ready",
+    "backend_compile",
+    "jaxlib",
+    "_xla",
+    "device_put",
+)
+
+# Frames visible (empirically, jax 0.4.37) while a jit cache miss is
+# being traced/lowered/XLA-compiled.  A compile is SLOW, not stuck —
+# the same reasoning that prices the first dispatch into warmup — so the
+# watchdog defers while one is on a stack (up to a 10x-deadline cap:
+# a compile that long is worth an event, classified "compiling").
+_COMPILING_MARKERS = (
+    "backend_compile",
+    "compile_or_get_cached",
+    "cache_miss",
+    "_python_pjit_helper",
+)
+
+
+def compiling_now(stacks: dict[str, str]) -> bool:
+    blob = "\n".join(stacks.values())
+    return any(m in blob for m in _COMPILING_MARKERS)
+
+
+def classify_stall(queue_depth: int | None, stacks: dict[str, str]) -> str:
+    """input-starved: the prefetch queue is empty, so the producer (parse
+    / disk / conversion) is what everyone is waiting on.  device-bound:
+    data is ready (or there is no input queue) and a thread is inside the
+    device runtime — the dispatch/compile/transfer is what's wedged."""
+    if queue_depth == 0:
+        return "input-starved"
+    blob = "\n".join(stacks.values())
+    if any(m in blob for m in _DEVICE_MARKERS):
+        return "device-bound"
+    if queue_depth is not None and queue_depth > 0:
+        return "device-bound"
+    return "unknown"
+
+
+def first_nonfinite_leaf(tree) -> str | None:
+    """Path of the first pytree leaf holding a NaN/Inf, or None.  "Cheap"
+    only relative to an abort (it syncs every leaf to host) — call it on
+    the way down, never on the hot path."""
+    try:
+        import jax
+        import numpy as np
+
+        for path, leaf in jax.tree_util.tree_leaves_with_path(tree):
+            arr = np.asarray(leaf)
+            if arr.dtype.kind == "f" and arr.size and not np.isfinite(arr).all():
+                return jax.tree_util.keystr(path)
+    except Exception:
+        return None
+    return None
+
+
+# -- the monitor ----------------------------------------------------------
+
+
+class RunMonitor:
+    """Owns the MetricsLogger and stamps the shared envelope on every
+    record; hosts the compile sentinel, the memory sampler, and the
+    liveness watchdog.  Thread-safe: drivers emit from their loop thread,
+    the watchdog from its own.
+
+    ``source`` names the driver (train / predict / serving) on compile
+    events.  ``queue_depth_fn`` (settable later via
+    ``set_queue_depth_fn``) lets the stall classifier read the live
+    prefetch-queue depth.  ``stall_timeout_s`` 0 disables the watchdog;
+    ``mem_every_s`` 0 reduces kind=mem to the one guaranteed close()
+    record.
+    """
+
+    def __init__(
+        self,
+        path: str | None = None,
+        *,
+        run_id: str = "",
+        source: str = "train",
+        stall_timeout_s: float = 0.0,
+        mem_every_s: float = 0.0,
+        queue_depth_fn=None,
+        logger: MetricsLogger | None = None,
+        log=None,
+    ):
+        self._logger = logger if logger is not None else MetricsLogger(path)
+        self._own_logger = logger is None
+        self.run_id = run_id or new_run_id()
+        self.source = source
+        self._log = log
+        self._t0 = time.monotonic()
+        self._lock = threading.Lock()
+        self._step = 0
+        self._closed = False
+
+        self._sentinel = CompileSentinel()
+        self.compiles_total = 0
+        self.compiles_steady = 0  # compiles NOT marked warmup
+        self._last_warmup = True  # nothing dispatched yet = startup/warmup
+
+        self._mem = _MemWatermarks()
+        self._mem_every_s = float(mem_every_s)
+        self._last_mem = self._t0
+
+        self.stalls = 0
+        self.anomalies = 0
+        self._stall_timeout = float(stall_timeout_s)
+        self._queue_depth_fn = queue_depth_fn
+        # Armed by the FIRST heartbeat: the gap before dispatch 1 is
+        # dominated by XLA compile (legitimately >> any stall deadline),
+        # and startup hangs are arm_hang_exit's department.
+        self._last_beat = None
+        self._stall_fired = False
+        self._suspended = 0
+        self._stop = threading.Event()
+        self._watchdog = None
+        if self._stall_timeout > 0:
+            self._watchdog = threading.Thread(
+                target=self._watch, name="telemetry-watchdog", daemon=True
+            )
+            self._watchdog.start()
+
+    @property
+    def active(self) -> bool:
+        """Whether records reach a file (sentinels run regardless)."""
+        return self._logger.active
+
+    def set_queue_depth_fn(self, fn) -> None:
+        """Swap the prefetch-depth probe (drivers rebuild streams per
+        epoch; the watchdog should read the CURRENT one)."""
+        self._queue_depth_fn = fn
+
+    # -- emission ---------------------------------------------------------
+
+    def emit(self, kind: str, step: int | None = None, **fields) -> None:
+        """Append one enveloped record.  ``kind`` must be registered in
+        SCHEMAS — an unknown kind is a programming error the schema test
+        could never catch, so it raises here."""
+        if kind not in SCHEMAS:
+            raise ValueError(f"unknown telemetry kind {kind!r} (register it in SCHEMAS)")
+        self._logger.log(
+            run_id=self.run_id,
+            schema_version=SCHEMA_VERSION,
+            kind=kind,
+            step=self._step if step is None else int(step),
+            t=round(time.monotonic() - self._t0, 3),
+            **fields,
+        )
+
+    def heartbeat(self, step: int) -> None:
+        """The liveness signal: call whenever a dispatch completes."""
+        with self._lock:
+            self._step = int(step)
+            self._last_beat = time.monotonic()
+            self._stall_fired = False
+
+    @contextlib.contextmanager
+    def suspended(self):
+        """Suspend the liveness watchdog for a phase that legitimately
+        completes no dispatches (a long validation pass, a checkpoint
+        save) — otherwise a healthy epoch boundary reads as a stall,
+        misclassified input-starved because the drained train stream's
+        queue depth is 0.  Re-entrant; the heartbeat clock restarts on
+        exit."""
+        with self._lock:
+            self._suspended += 1
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._suspended -= 1
+                if self._last_beat is not None:
+                    self._last_beat = time.monotonic()
+                self._stall_fired = False
+
+    def on_dispatch(self, step: int, warmup: bool = False) -> None:
+        """Per-dispatch hook for driver loops: heartbeat + compile drain +
+        due memory sample.  ``warmup`` marks dispatches where a compile
+        is EXPECTED (first call, bucket warmup) so steady-state recompiles
+        are separable from the priced-in ones."""
+        self.heartbeat(step)
+        delta = self._sentinel.drain()
+        self._last_warmup = bool(warmup)
+        if delta:
+            with self._lock:
+                self.compiles_total += delta
+                if not warmup:
+                    self.compiles_steady += delta
+            self.emit(
+                "compile",
+                step=step,
+                source=self.source,
+                compiles=delta,
+                total_compiles=self.compiles_total,
+                warmup=bool(warmup),
+            )
+        if self._mem_every_s > 0:
+            now = time.monotonic()
+            if now - self._last_mem >= self._mem_every_s:
+                self._last_mem = now
+                self.emit_mem(step=step)
+
+    def emit_mem(self, step: int | None = None) -> None:
+        self.emit("mem", step=step, **self._mem.sample())
+
+    def emit_anomaly(
+        self, step: int, loss, event: str = "nonfinite_loss", state=None, **fields
+    ) -> None:
+        """Structured divergence record (the satellite): step, loss, and —
+        when a state pytree is handed over — the first non-finite tensor's
+        path, so report.py can say WHICH table diverged."""
+        with self._lock:
+            self.anomalies += 1
+        if state is not None and "first_nonfinite" not in fields:
+            fields["first_nonfinite"] = first_nonfinite_leaf(state)
+        self.emit(
+            "anomaly",
+            step=step,
+            event=event,
+            loss=None if loss is None else float(loss),
+            **fields,
+        )
+
+    # -- watchdog ---------------------------------------------------------
+
+    def _watch(self) -> None:
+        poll = max(0.02, min(self._stall_timeout / 4.0, 1.0))
+        while not self._stop.wait(poll):
+            with self._lock:
+                if self._last_beat is None or self._suspended:
+                    continue  # not armed yet / in a no-dispatch phase
+                since = time.monotonic() - self._last_beat
+                fired = self._stall_fired
+                step = self._step
+            if since < self._stall_timeout or fired:
+                continue
+            stacks = thread_stacks()
+            stacks.pop("telemetry-watchdog", None)  # our own frame is noise
+            compiling = compiling_now(stacks)
+            if compiling and since < 10.0 * self._stall_timeout:
+                # An XLA compile in progress (e.g. a new shape's warmup
+                # program) is slow, not wedged — don't fire, don't latch;
+                # re-check next poll.  Past 10x the deadline it IS worth
+                # an event, classified "compiling".
+                continue
+            with self._lock:
+                self._stall_fired = True
+                self.stalls += 1
+            depth = None
+            if self._queue_depth_fn is not None:
+                try:
+                    depth = self._queue_depth_fn()
+                except Exception:
+                    depth = None
+            cls = "compiling" if compiling else classify_stall(depth, stacks)
+            try:
+                self.emit(
+                    "stall",
+                    step=step,
+                    deadline_s=self._stall_timeout,
+                    since_last_step_s=round(since, 3),
+                    classification=cls,
+                    prefetch_queue_depth=depth,
+                    stacks=stacks,
+                )
+            except Exception:
+                pass  # a full metrics disk must not kill stall detection
+            if self._log is not None:
+                try:
+                    self._log(
+                        f"telemetry watchdog: no step for {since:.1f}s "
+                        f"(deadline {self._stall_timeout:.1f}s) at step {step} — "
+                        f"{cls}; thread stacks -> kind=stall record"
+                    )
+                except Exception:
+                    pass  # a raising log callback must not kill the watchdog
+
+    # -- shutdown ---------------------------------------------------------
+
+    def close(self, **summary_fields) -> None:
+        """Final drain: any unattributed compiles, the guaranteed last
+        memory watermark, and the kind=summary totals (the compile
+        sentinel's "final count").  Extra keyword fields merge into the
+        summary record (drivers pass their end-of-run counters).
+        Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=2.0)
+        delta = self._sentinel.drain()
+        if delta:
+            # Compiles landing between the last dispatch and close (e.g.
+            # the prefetch thread mid-compiling an unpack program when a
+            # SIGTERM stopped the loop) inherit the last dispatch's
+            # warmup framing — a warmup-era run must not report them as
+            # steady-state recompiles.
+            warm = self._last_warmup
+            with self._lock:
+                self.compiles_total += delta
+                if not warm:
+                    self.compiles_steady += delta
+            self.emit(
+                "compile",
+                source=self.source,
+                compiles=delta,
+                total_compiles=self.compiles_total,
+                warmup=warm,
+            )
+        self.emit_mem()
+        self.emit(
+            "summary",
+            total_compiles=self.compiles_total,
+            steady_compiles=self.compiles_steady,
+            stalls=self.stalls,
+            anomalies=self.anomalies,
+            **summary_fields,
+        )
+        if self._own_logger:
+            self._logger.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# -- the hang-exit watchdog (absorbed _bench_watchdog.py) -----------------
+
+DEFAULT_HANG_EXIT_SECS = 600.0
+
+
+def arm_hang_exit(seconds: float = DEFAULT_HANG_EXIT_SECS, what: str = "bench"):
+    """Hard hang watchdog for batch tools: os._exit(2) with a stderr note
+    if not cancelled within ``seconds``.
+
+    The TPU here sits behind a tunnel that has been observed to hang
+    outright (device RPCs block forever, load average ~0) — sometimes as
+    early as backend initialization inside ``import jax``.  A hung
+    benchmark is worse than a missing one: it stalls the whole harness.
+    The bench/probe scripts arm this BEFORE importing jax/fast_tffm_tpu
+    and cancel it once their last result line is printed — which is why
+    this module (and the package __init__) must import jax-free.
+
+    Unlike RunMonitor's liveness watchdog (observe, classify, keep
+    running), this one KILLS: batch tools have nothing to salvage from a
+    wedged backend.  Returns the armed ``threading.Timer`` (call
+    ``.cancel()`` on success).
+    """
+
+    def fire():
+        print(
+            f"{what} watchdog: no result after {seconds:.0f}s — device "
+            "backend appears hung (tunnel down?); aborting without a number",
+            file=sys.stderr,
+            flush=True,
+        )
+        os._exit(2)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t

@@ -29,9 +29,10 @@ from fast_tffm_tpu.data.native import best_parser
 from fast_tffm_tpu.data.pipeline import batch_stream
 from fast_tffm_tpu.metrics import StreamingAUC, Throughput
 from fast_tffm_tpu.models.base import Batch
+from fast_tffm_tpu.telemetry import RunMonitor
 from fast_tffm_tpu.trainer import init_state, make_predict_step, make_train_step
 from fast_tffm_tpu.utils.prefetch import prefetch
-from fast_tffm_tpu.utils.tracing import MetricsLogger, WindowTracer, step_trace
+from fast_tffm_tpu.utils.tracing import WindowTracer, step_trace
 
 __all__ = ["train", "dist_train", "scan_max_nnz"]
 
@@ -49,10 +50,15 @@ def scan_max_nnz(cfg: Config) -> int:
     return max(1, widest)
 
 
-def _check_finite(loss: float, cfg: Config) -> None:
+def _check_finite(loss: float, cfg: Config, monitor=None, step=0, state=None) -> None:
     """Abort on a non-finite loss instead of training on (and eventually
-    checkpointing) poisoned state."""
+    checkpointing) poisoned state.  With a ``monitor``, the divergence
+    lands in the telemetry stream as a structured ``kind=anomaly`` record
+    (step, loss, first non-finite tensor path) BEFORE the raise, so
+    tools/report.py can flag the run without log-grepping."""
     if not np.isfinite(loss):
+        if monitor is not None:
+            monitor.emit_anomaly(step, loss, state=state)
         # Under lookup_overflow=fallback an overflow cannot produce NaN
         # (the step reran via allgather) — divergence is the only cause.
         hint = (
@@ -366,7 +372,19 @@ def _run_training(
         log(f"note: {cfg.model_file} is an orbax checkpoint dir — keeping orbax format")
         ckpt_format = "orbax"
     tracer = WindowTracer(cfg.trace_dir if is_lead else None, count=cfg.trace_steps)
-    metrics = MetricsLogger(cfg.metrics_path if is_lead else None)
+    # Unified telemetry: every record (train/input/validation/compile/mem/
+    # stall/anomaly/summary) shares one run_id and the envelope schema
+    # (telemetry.SCHEMAS); the compile sentinel drains per dispatch, the
+    # liveness watchdog fires kind=stall with thread stacks when the loop
+    # wedges, and the close() record documents the run's totals.
+    monitor = RunMonitor(
+        cfg.metrics_path if is_lead else None,
+        run_id=cfg.telemetry_run_id,
+        source="train",
+        stall_timeout_s=cfg.telemetry_stall_timeout_s,
+        mem_every_s=cfg.telemetry_mem_every_s,
+        log=log,
+    )
     # Preemption-safe shutdown (the reference's only recovery story was
     # Supervisor restart-from-checkpoint; cloud TPU maintenance sends
     # SIGTERM): first signal finishes the current step, checkpoints, and
@@ -391,6 +409,9 @@ def _run_training(
             # kind=input records at every log point.  Device-cached
             # streams are bare generators (no stats — no per-step wire).
             input_stats = getattr(epoch_stream, "stats", None)
+            # Each epoch's stream owns a fresh prefetch queue; point the
+            # stall watchdog's depth probe at the current one.
+            monitor.set_queue_depth_fn(getattr(epoch_stream, "queue_depth", None))
             for b, parsed, w in epoch_stream:
                 if b is None:
                     b = to_batch(parsed, w)
@@ -408,6 +429,16 @@ def _run_training(
                     # includes it reads as a throughput collapse.
                     jax.block_until_ready(loss)
                     meter.reset()
+                # Heartbeat + compile-sentinel drain + due mem sample.
+                # Epoch 0 is the shape-discovery pass: the first dispatch
+                # AND the epoch-tail remainder shape (steps_per_call > 1
+                # ships a shorter [K', B, ...] superbatch) legitimately
+                # compile once — all priced in as warmup.  Every shape
+                # recurs identically from epoch 1 on, so any later
+                # kind=compile event is a steady-state recompile — the
+                # thing the serving bucket ladder pins to zero, now
+                # visible on the train path too.
+                monitor.on_dispatch(step_num, warmup=(epoch == 0))
                 losses.append(loss)  # device value(s); only sync at log points
                 pending_steps += k
                 if examples_per_step is not None:
@@ -433,7 +464,10 @@ def _run_training(
                             )
                         )
                     )
-                    _check_finite(mean_loss, cfg)
+                    _check_finite(
+                        mean_loss, cfg, monitor=monitor,
+                        step=int(state.step), state=state,
+                    )
                     extra = extra_metrics() if extra_metrics is not None else {}
                     extra_txt = "".join(f" {k} {v}" for k, v in extra.items() if v)
                     log(
@@ -442,7 +476,8 @@ def _run_training(
                         f"examples/sec {rate:,.0f} (/chip {rate / n_chips:,.0f})"
                         f"{extra_txt}"
                     )
-                    metrics.log(
+                    monitor.emit(
+                        "train",
                         step=int(state.step),
                         epoch=epoch,
                         loss=round(float(mean_loss), 6),
@@ -453,9 +488,8 @@ def _run_training(
                     if input_stats is not None:
                         rec = input_stats.drain()
                         if rec:
-                            metrics.log(
-                                step=int(state.step), epoch=epoch,
-                                kind="input", **rec,
+                            monitor.emit(
+                                "input", step=int(state.step), epoch=epoch, **rec
                             )
                     losses.clear()
                     meter.reset()
@@ -467,32 +501,47 @@ def _run_training(
                 # otherwise never emit its kind=input record at all.
                 rec = input_stats.drain()
                 if rec:
-                    metrics.log(
-                        step=int(state.step), epoch=epoch, kind="input", **rec
-                    )
+                    monitor.emit("input", step=int(state.step), epoch=epoch, **rec)
             if losses:
                 # Epoch boundary syncs anyway (validation / checkpoint); a
                 # poisoned state must abort BEFORE the save below replaces
                 # the last good checkpoint.  The final entry may be a [K]
                 # fused-call vector — check its LAST micro-step.
-                _check_finite(float(np.asarray(losses[-1]).reshape(-1)[-1]), cfg)
+                _check_finite(
+                    float(np.asarray(losses[-1]).reshape(-1)[-1]), cfg,
+                    monitor=monitor, step=int(state.step), state=state,
+                )
             if cfg.validation_files:
-                val_auc = evaluate(cfg, predict_step, state, cfg.validation_files, max_nnz)
+                # No train dispatches complete during validation — a long
+                # pass must not read as a stall (watchdog suspended).
+                with monitor.suspended():
+                    val_auc = evaluate(
+                        cfg, predict_step, state, cfg.validation_files, max_nnz
+                    )
                 log(f"epoch {epoch} validation auc {val_auc:.5f}")
-                metrics.log(step=int(state.step), epoch=epoch, validation_auc=round(val_auc, 6))
+                monitor.emit(
+                    "validation",
+                    step=int(state.step),
+                    epoch=epoch,
+                    validation_auc=round(val_auc, 6),
+                )
+                # Drain the validation pass's compiles: epoch 0's predict
+                # compile is priced in (warmup); a LATER epoch compiling
+                # again is a genuine steady-state recompile.
+                monitor.on_dispatch(int(state.step), warmup=(epoch == 0))
             if cfg.save_every_epochs and (epoch + 1) % cfg.save_every_epochs == 0:
-                save_checkpoint(cfg.model_file, saveable(state), ckpt_format)
+                with monitor.suspended():  # saves dispatch nothing either
+                    save_checkpoint(cfg.model_file, saveable(state), ckpt_format)
                 log(f"epoch {epoch} checkpoint -> {cfg.model_file}")
     finally:
+        summary_extra = {}
         if extra_metrics is not None:
             # Drain events from the final partial log window (run end,
             # SIGTERM stop, abort) — a skew burst at the end must still
-            # reach the metrics file.
-            extra = extra_metrics()
-            if any(extra.values()):
-                metrics.log(step=int(state.step), **extra)
+            # reach the metrics file; it rides the kind=summary record.
+            summary_extra = {k: v for k, v in extra_metrics().items() if v}
         tracer.close()
-        metrics.close()
+        monitor.close(**summary_extra)
         for sig, handler in restore_handlers.items():
             try:
                 signal.signal(sig, handler)

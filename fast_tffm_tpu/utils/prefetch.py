@@ -23,8 +23,10 @@ _SENTINEL = object()
 
 class InputStream:
     """An input iterator plus its InputStats (data/wire.py): the driver
-    iterates it like the bare generator it wraps and drains ``.stats``
-    into kind=input metrics records at log points."""
+    iterates it like the bare generator it wraps, drains ``.stats`` into
+    kind=input metrics records at log points, and hands
+    ``.queue_depth`` to the telemetry stall watchdog (live occupancy —
+    readable mid-stall, when the consumer loop itself is frozen)."""
 
     def __init__(self, it: Iterable, stats):
         self._it = it
@@ -32,6 +34,9 @@ class InputStream:
 
     def __iter__(self) -> Iterator:
         return iter(self._it)
+
+    def queue_depth(self) -> int | None:
+        return self.stats.queue_depth() if self.stats is not None else None
 
 
 def chunk(it: Iterable, k: int) -> Iterator[list]:
@@ -61,8 +66,12 @@ def prefetch(it: Iterable, depth: int = 8, stats=None) -> Iterator:
     ``stats`` (an object with ``on_queue_depth(int)``) samples the queue
     occupancy at every consumer pop — the overlap-efficiency signal the
     kind=input metrics records carry (depth ~0 = producer-bound, depth at
-    the cap = consumer-bound)."""
+    the cap = consumer-bound).  The queue itself is also bound onto
+    ``stats`` (``bind_queue``) so the telemetry watchdog can read the
+    LIVE depth from its own thread while the consumer is wedged."""
     q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+    if stats is not None and hasattr(stats, "bind_queue"):
+        stats.bind_queue(q)
     err: list[BaseException] = []
 
     def worker():

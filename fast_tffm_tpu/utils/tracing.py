@@ -11,18 +11,38 @@ profiler a config key away:
     line up with host timeline rows;
   * ``MetricsLogger`` — optional JSONL sink for step metrics (loss,
     examples/sec, AUC) next to the stdout log, one object per line.
+
+jax imports stay inside the profiler helpers: ``MetricsLogger`` is the
+sink under telemetry.RunMonitor, whose module must be importable before
+``import jax`` (the hang-exit watchdog contract — see telemetry.py).
 """
 
 from __future__ import annotations
 
 import contextlib
 import json
+import math
 import os
+import threading
 import time
 
-import jax
-
 __all__ = ["maybe_trace", "WindowTracer", "step_trace", "MetricsLogger"]
+
+
+def _jsonsafe(v):
+    """Non-finite floats become their string names ('nan'/'inf'/'-inf'):
+    Python's json would emit bare NaN/Infinity tokens, which strict JSON
+    readers (jq, JSON.parse) reject — and the records carrying them
+    (anomaly losses, single-class validation AUCs) are exactly the ones
+    an external dashboard most wants.  float(...) round-trips the
+    strings for numeric consumers."""
+    if isinstance(v, float) and not math.isfinite(v):
+        return str(v)
+    if isinstance(v, dict):
+        return {k: _jsonsafe(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonsafe(x) for x in v]
+    return v
 
 
 @contextlib.contextmanager
@@ -35,6 +55,8 @@ def maybe_trace(trace_dir: str | None):
     if not trace_dir:
         yield
         return
+    import jax
+
     os.makedirs(trace_dir, exist_ok=True)
     with jax.profiler.trace(trace_dir):
         yield
@@ -61,6 +83,8 @@ class WindowTracer:
         """Call once per train step (before/after — consistency is all)."""
         if self._dir is None:
             return
+        import jax
+
         if not self._active and self._seen == self._skip:
             os.makedirs(self._dir, exist_ok=True)
             jax.profiler.start_trace(self._dir)
@@ -73,6 +97,8 @@ class WindowTracer:
 
     def close(self) -> None:
         if self._active:
+            import jax
+
             jax.profiler.stop_trace()
             self._active = False
             self._dir = None
@@ -80,30 +106,48 @@ class WindowTracer:
 
 def step_trace(name: str, step: int):
     """Annotate one train/eval step on the profiler timeline."""
+    import jax
+
     return jax.profiler.StepTraceAnnotation(name, step_num=step)
 
 
 class MetricsLogger:
-    """Append-only JSONL metrics sink (no-op when path is empty)."""
+    """Append-only JSONL metrics sink (no-op when path is empty).
+
+    Thread-safe: the telemetry watchdog and memory sampler write from
+    their own threads concurrently with the driver loop's records, and
+    two interleaved half-lines would corrupt the JSONL for every reader
+    downstream (tools/report.py).
+    """
 
     def __init__(self, path: str | None):
         self._f = None
+        self._lock = threading.Lock()
         if path:
             dirpart = os.path.dirname(path)
             if dirpart:
                 os.makedirs(dirpart, exist_ok=True)
             self._f = open(path, "a", buffering=1)
 
+    @property
+    def active(self) -> bool:
+        return self._f is not None
+
     def log(self, **fields) -> None:
-        if self._f is None:
+        if self._f is None:  # cheap no-op path; re-checked under the lock
             return
         fields.setdefault("ts", round(time.time(), 3))
-        self._f.write(json.dumps(fields) + "\n")
+        line = json.dumps(_jsonsafe(fields), allow_nan=False) + "\n"
+        with self._lock:
+            if self._f is None:
+                return
+            self._f.write(line)
 
     def close(self) -> None:
-        if self._f is not None:
-            self._f.close()
-            self._f = None
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
 
     def __enter__(self):
         return self

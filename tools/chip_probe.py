@@ -3,8 +3,8 @@
 import sys, time
 import os
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-import _bench_watchdog
-_w = _bench_watchdog.arm(seconds=420, what="chip_probe")
+from fast_tffm_tpu.telemetry import arm_hang_exit
+_w = arm_hang_exit(seconds=420, what="chip_probe")
 import jax, numpy as np
 import bench as B
 from fast_tffm_tpu.models import FMModel

@@ -27,9 +27,9 @@ from functools import partial
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import _bench_watchdog
+from fast_tffm_tpu.telemetry import arm_hang_exit
 
-_watchdog = _bench_watchdog.arm(seconds=2700, what="probe_ffm.py")
+_watchdog = arm_hang_exit(seconds=2700, what="probe_ffm.py")
 
 import jax
 import jax.numpy as jnp

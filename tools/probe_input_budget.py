@@ -241,7 +241,7 @@ def main(argv=None) -> int:
 
 
 if __name__ == "__main__":
-    import _bench_watchdog
+    from fast_tffm_tpu.telemetry import arm_hang_exit
 
-    _bench_watchdog.arm(seconds=2700, what="probe_input_budget.py")
+    arm_hang_exit(seconds=2700, what="probe_input_budget.py")
     raise SystemExit(main())

@@ -13,9 +13,9 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import _bench_watchdog
+from fast_tffm_tpu.telemetry import arm_hang_exit
 
-_watchdog = _bench_watchdog.arm(seconds=3000, what="probe_knee.py")
+_watchdog = arm_hang_exit(seconds=3000, what="probe_knee.py")
 
 import jax
 import numpy as np

@@ -28,9 +28,9 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import _bench_watchdog
+from fast_tffm_tpu.telemetry import arm_hang_exit
 
-_watchdog = _bench_watchdog.arm(seconds=3000, what="probe_nondecisions.py")
+_watchdog = arm_hang_exit(seconds=3000, what="probe_nondecisions.py")
 
 import jax
 import jax.numpy as jnp

@@ -254,7 +254,7 @@ def main(argv=None) -> int:
 
 
 if __name__ == "__main__":
-    import _bench_watchdog
+    from fast_tffm_tpu.telemetry import arm_hang_exit
 
-    _bench_watchdog.arm(seconds=3300, what="probe_wire.py")
+    arm_hang_exit(seconds=3300, what="probe_wire.py")
     raise SystemExit(main())

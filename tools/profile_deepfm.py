@@ -17,9 +17,9 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import _bench_watchdog
+from fast_tffm_tpu.telemetry import arm_hang_exit
 
-_watchdog = _bench_watchdog.arm(seconds=1200, what="profile_deepfm.py")
+_watchdog = arm_hang_exit(seconds=1200, what="profile_deepfm.py")
 
 import jax  # noqa: E402
 import numpy as np  # noqa: E402

@@ -1,0 +1,525 @@
+#!/usr/bin/env python
+"""Render a telemetry JSONL run into a human report; gate regressions.
+
+The consumer half of fast_tffm_tpu/telemetry.py: training/predict/serving
+write enveloped records (one run_id, ``kind`` ∈ telemetry.SCHEMAS) to
+``metrics_path``; this tool turns that stream back into answers —
+*how fast was it, was the input or the device the bottleneck, did it
+recompile / stall / diverge, and is it worse than the last run?*
+
+    python tools/report.py RUN.jsonl                    # markdown → stdout
+    python tools/report.py RUN.jsonl --out REPORT.md
+    python tools/report.py RUN.jsonl --compare BASE.jsonl [--threshold 0.15]
+
+``--compare`` prints per-metric deltas and exits **1** when RUN's median
+throughput is degraded more than ``--threshold`` (fraction) vs BASE — a
+bench gate: wire two instrumented runs into CI and a slowdown fails the
+build.  ``--strict`` additionally fails on NEW steady-state recompiles,
+stalls, or anomalies.  Exit 2 = unusable input.
+
+Stdlib-only on purpose: the report must render on a machine that can't
+even import jax (e.g. triaging a stall dump from a wedged TPU host).
+
+bench.py also imports ``write_bench_report`` to drop a REPORT_rNN.md
+next to each BENCH_rNN.json (delta table vs the previous round).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import statistics
+import sys
+
+_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def spark(vals) -> str:
+    """Unicode sparkline (empty-safe)."""
+    vals = [v for v in vals if v is not None]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        return _BLOCKS[0] * len(vals)
+    return "".join(
+        _BLOCKS[int((v - lo) / (hi - lo) * (len(_BLOCKS) - 1))] for v in vals
+    )
+
+
+def _fmt(v, nd=1) -> str:
+    if v is None:
+        return "–"
+    if isinstance(v, float):
+        if abs(v) < 10:  # losses/AUCs need the decimals, rates don't
+            nd = max(nd, 4)
+        return f"{v:,.{nd}f}"
+    return f"{v:,}"
+
+
+def _fmt_bytes(v) -> str:
+    if v is None:
+        return "–"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(v) < 1024 or unit == "TiB":
+            return f"{v:.1f} {unit}" if unit != "B" else f"{v} B"
+        v /= 1024
+    return f"{v:.1f} TiB"
+
+
+def load_run(path: str) -> list[dict]:
+    """All parseable JSONL records; raises ValueError when nothing is."""
+    records, bad = [], 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                bad += 1
+                continue
+            if isinstance(rec, dict):
+                records.append(rec)
+    if not records:
+        raise ValueError(f"{path}: no parseable JSONL records")
+    if bad:
+        print(f"note: {path}: skipped {bad} malformed line(s)", file=sys.stderr)
+    return records
+
+
+def _by_kind(records):
+    out = {}
+    for r in records:
+        out.setdefault(r.get("kind", "step"), []).append(r)
+    return out
+
+
+def summarize(records: list[dict]) -> dict:
+    """Flatten one run's records into the metrics the report (and the
+    compare gate) speaks: throughput stats, loss endpoints, input-path
+    shares, event counts, memory peaks.
+
+    MetricsLogger appends, so successive runs with one config share one
+    file; pooling them would fake convergence (loss_first from run 1,
+    loss_final from run 2) and corrupt the compare gate both ways — only
+    the LAST run_id is summarized, with a stderr note."""
+    distinct = list(dict.fromkeys(r.get("run_id") for r in records if r.get("run_id")))
+    if len(distinct) > 1:
+        last = distinct[-1]
+        print(
+            f"note: {len(distinct)} runs appended in this file; "
+            f"reporting only the last (run_id {last})",
+            file=sys.stderr,
+        )
+        records = [r for r in records if r.get("run_id") == last]
+    kinds = _by_kind(records)
+    s: dict = {
+        "run_ids": distinct[-1:],
+        "runs_in_file": len(distinct),
+        "kinds": {k: len(v) for k, v in sorted(kinds.items())},
+    }
+    ts = [r["t"] for r in records if isinstance(r.get("t"), (int, float))]
+    s["duration_s"] = round(max(ts) - min(ts), 3) if ts else None
+
+    train = kinds.get("train", [])
+    rates = [
+        r["examples_per_sec"]
+        for r in train
+        if isinstance(r.get("examples_per_sec"), (int, float))
+    ]
+    losses = [r["loss"] for r in train if isinstance(r.get("loss"), (int, float))]
+    s["train_windows"] = len(train)
+    s["steps"] = max((r.get("step", 0) for r in records), default=0)
+    s["throughput_timeline"] = rates
+    s["throughput_median"] = round(statistics.median(rates), 1) if rates else None
+    s["throughput_final"] = rates[-1] if rates else None
+    s["loss_timeline"] = losses
+    s["loss_first"] = losses[0] if losses else None
+    s["loss_final"] = losses[-1] if losses else None
+
+    inputs = kinds.get("input", [])
+
+    def _wsum(key):
+        tot = n = 0.0
+        for r in inputs:
+            v, items = r.get(key), r.get("input_items", 0)
+            if isinstance(v, (int, float)) and items:
+                tot += v * items
+                n += items
+        return (tot, n)
+
+    parse_tot, parse_items = _wsum("parse_ms")
+    h2d_tot, h2d_items = _wsum("h2d_ms")
+    s["parse_ms_mean"] = round(parse_tot / parse_items, 3) if parse_items else None
+    s["h2d_ms_mean"] = round(h2d_tot / h2d_items, 3) if h2d_items else None
+    wires = [
+        r["wire_bytes_per_step"]
+        for r in inputs
+        if isinstance(r.get("wire_bytes_per_step"), (int, float))
+    ]
+    s["wire_bytes_per_step"] = int(statistics.median(wires)) if wires else None
+    depths = [
+        r["prefetch_queue_depth"]
+        for r in inputs
+        if isinstance(r.get("prefetch_queue_depth"), (int, float))
+    ]
+    s["prefetch_queue_depth_mean"] = (
+        round(sum(depths) / len(depths), 2) if depths else None
+    )
+    # Input-vs-compute split: host input work (parse + pack/H2D) as a
+    # share of the run's wall clock.  >1 window is overlap (prefetch
+    # thread) — still an honest "the host was this busy feeding" number.
+    if s["duration_s"]:
+        busy_ms = parse_tot + h2d_tot
+        s["input_time_share"] = round(busy_ms / 1e3 / s["duration_s"], 3)
+    else:
+        s["input_time_share"] = None
+
+    compiles = kinds.get("compile", [])
+    s["warmup_compiles"] = sum(
+        r.get("compiles", 0) for r in compiles if r.get("warmup")
+    )
+    s["steady_compiles"] = sum(
+        r.get("compiles", 0) for r in compiles if not r.get("warmup")
+    )
+    s["steady_compile_steps"] = [r.get("step") for r in compiles if not r.get("warmup")]
+
+    s["stalls"] = len(kinds.get("stall", []))
+    s["stall_events"] = [
+        {
+            "step": r.get("step"),
+            "since_last_step_s": r.get("since_last_step_s"),
+            "classification": r.get("classification"),
+            "prefetch_queue_depth": r.get("prefetch_queue_depth"),
+        }
+        for r in kinds.get("stall", [])
+    ]
+    s["anomalies"] = len(kinds.get("anomaly", []))
+    s["anomaly_events"] = [
+        {
+            "step": r.get("step"),
+            "event": r.get("event"),
+            "loss": r.get("loss"),
+            "first_nonfinite": r.get("first_nonfinite"),
+        }
+        for r in kinds.get("anomaly", [])
+    ]
+
+    mems = kinds.get("mem", [])
+    s["host_rss_peak_bytes"] = max(
+        (r["host_rss_peak_bytes"] for r in mems if r.get("host_rss_peak_bytes")),
+        default=None,
+    )
+    s["device_peak_bytes"] = max(
+        (r["device_peak_bytes"] for r in mems if r.get("device_peak_bytes")),
+        default=None,
+    )
+
+    vals = kinds.get("validation", [])
+    s["validation_aucs"] = [
+        r["validation_auc"] for r in vals if r.get("validation_auc") is not None
+    ]
+    serving = kinds.get("serving", [])
+    s["serving_last"] = serving[-1] if serving else None
+    predict = kinds.get("predict", [])
+    s["predict_last"] = predict[-1] if predict else None
+    summary = kinds.get("summary", [])
+    s["summary_record"] = summary[-1] if summary else None
+    return s
+
+
+def render(s: dict, title: str = "run") -> str:
+    """One markdown report per run.  Sections appear only when the run
+    actually produced that kind — a predict run isn't padded with empty
+    train tables."""
+    L = [f"# Telemetry report — {title}", ""]
+    L.append(f"- run_id: `{', '.join(s['run_ids']) or '?'}`")
+    L.append(f"- duration: {_fmt(s['duration_s'], 1)} s, max step {s['steps']}")
+    L.append(
+        "- records: "
+        + ", ".join(f"{k}={n}" for k, n in s["kinds"].items())
+    )
+    L.append("")
+    if s["throughput_timeline"]:
+        L += ["## Throughput", ""]
+        L.append(f"`{spark(s['throughput_timeline'])}` examples/sec per log window")
+        L.append(
+            f"- median {_fmt(s['throughput_median'])}, "
+            f"final {_fmt(s['throughput_final'])}, "
+            f"min {_fmt(min(s['throughput_timeline']))}, "
+            f"max {_fmt(max(s['throughput_timeline']))}"
+        )
+        L.append("")
+    if s["loss_timeline"]:
+        L += ["## Loss", ""]
+        L.append(f"`{spark(s['loss_timeline'])}`")
+        L.append(f"- first {s['loss_first']} → final {s['loss_final']}")
+        if s["validation_aucs"]:
+            L.append(
+                "- validation AUC per epoch: "
+                + ", ".join(f"{a:.5f}" for a in s["validation_aucs"])
+            )
+        L.append("")
+    if any(
+        s[k] is not None
+        for k in ("parse_ms_mean", "h2d_ms_mean", "input_time_share")
+    ):
+        L += ["## Input path", ""]
+        L.append(f"- parse {_fmt(s['parse_ms_mean'], 3)} ms/item, "
+                 f"pack+H2D {_fmt(s['h2d_ms_mean'], 3)} ms/item")
+        L.append(f"- wire bytes/step: {_fmt(s['wire_bytes_per_step'], 0)}")
+        L.append(
+            f"- prefetch queue depth mean: {_fmt(s['prefetch_queue_depth_mean'], 2)} "
+            "(≈0 = producer-bound, at cap = consumer-bound)"
+        )
+        if s["input_time_share"] is not None:
+            L.append(
+                f"- host input time ≈ {100 * s['input_time_share']:.1f}% of wall "
+                "clock (overlapped via prefetch)"
+            )
+        L.append("")
+    L += ["## Events", ""]
+    L.append(
+        f"- compiles: {s['warmup_compiles']} warmup, "
+        f"**{s['steady_compiles']} steady-state**"
+        + (
+            f" (at steps {s['steady_compile_steps']})"
+            if s["steady_compiles"]
+            else ""
+        )
+    )
+    L.append(f"- stalls: {s['stalls']}")
+    for e in s["stall_events"]:
+        L.append(
+            f"  - step {e['step']}: {e['classification']}, "
+            f"{e['since_last_step_s']}s without a step, "
+            f"queue depth {e['prefetch_queue_depth']}"
+        )
+    L.append(f"- anomalies: {s['anomalies']}")
+    for e in s["anomaly_events"]:
+        L.append(
+            f"  - step {e['step']}: {e['event']} loss={e['loss']}"
+            + (
+                f" first_nonfinite={e['first_nonfinite']}"
+                if e.get("first_nonfinite")
+                else ""
+            )
+        )
+    L.append("")
+    L += ["## Memory", ""]
+    L.append(f"- host RSS peak: {_fmt_bytes(s['host_rss_peak_bytes'])}")
+    L.append(f"- device live-buffer peak: {_fmt_bytes(s['device_peak_bytes'])}")
+    L.append("")
+    if s["predict_last"]:
+        p = s["predict_last"]
+        L += [
+            "## Predict",
+            "",
+            f"- {_fmt(p.get('examples'))} examples at "
+            f"{_fmt(p.get('examples_per_sec'))} examples/sec",
+            "",
+        ]
+    if s["serving_last"]:
+        sv = s["serving_last"]
+        L += ["## Serving (last snapshot)", ""]
+        L.append(
+            f"- requests {_fmt(sv.get('requests'))}, rejected "
+            f"{_fmt(sv.get('rejected'))}, flushes {_fmt(sv.get('flushes'))}, "
+            f"occupancy {sv.get('batch_occupancy')}"
+        )
+        for stage in ("queue_ms", "compute_ms", "total_ms"):
+            h = sv.get(stage) or {}
+            L.append(
+                f"- {stage}: p50 {h.get('p50')}, p95 {h.get('p95')}, "
+                f"p99 {h.get('p99')}, max {h.get('max')}"
+            )
+        L.append("")
+    return "\n".join(L)
+
+
+# -- compare (the bench gate) --------------------------------------------
+
+# (metric key, human label, higher_is_better)
+_GATE_METRICS = [
+    ("throughput_median", "median examples/sec", True),
+    ("throughput_final", "final examples/sec", True),
+    ("loss_final", "final loss", False),
+    ("steady_compiles", "steady-state compiles", False),
+    ("stalls", "stalls", False),
+    ("anomalies", "anomalies", False),
+    ("host_rss_peak_bytes", "host RSS peak", False),
+    ("device_peak_bytes", "device mem peak", False),
+]
+
+
+def compare(run: dict, base: dict, threshold: float, strict: bool = False):
+    """Per-metric deltas (run vs base) + the gate verdict.
+
+    Returns (markdown, regressions: list[str]).  The hard gate is median
+    throughput degraded by more than ``threshold`` (fraction); ``strict``
+    adds NEW steady compiles / stalls / anomalies to the gate.
+    """
+    L = ["# Telemetry compare — run vs base", ""]
+    L.append("| metric | base | run | delta |")
+    L.append("|---|---:|---:|---:|")
+    regressions = []
+    for key, label, _hib in _GATE_METRICS:
+        a, b = run.get(key), base.get(key)
+        if a is None and b is None:
+            continue
+        if isinstance(a, (int, float)) and isinstance(b, (int, float)) and b:
+            delta = f"{(a - b) / abs(b) * 100:+.1f}%"
+        elif isinstance(a, (int, float)) and isinstance(b, (int, float)):
+            delta = f"{a - b:+g}"
+        else:
+            delta = "–"
+        L.append(f"| {label} | {_fmt(b)} | {_fmt(a)} | {delta} |")
+    L.append("")
+    a, b = run.get("throughput_median"), base.get("throughput_median")
+    if isinstance(a, (int, float)) and isinstance(b, (int, float)) and b > 0:
+        drop = (b - a) / b
+        if drop > threshold:
+            regressions.append(
+                f"median throughput degraded {drop * 100:.1f}% "
+                f"(> {threshold * 100:.0f}% threshold): {b} -> {a}"
+            )
+    elif a is None and isinstance(b, (int, float)) and b > 0:
+        # A gate that passes when the candidate produced NO throughput
+        # records would wave through the worst regression of all: a run
+        # that crashed or wedged before its first log window.
+        regressions.append(
+            "run has no train throughput records (base has "
+            f"{b}) — crashed/stalled before the first log window?"
+        )
+    if strict:
+        for key, label in (
+            ("steady_compiles", "steady-state compiles"),
+            ("stalls", "stalls"),
+            ("anomalies", "anomalies"),
+        ):
+            if (run.get(key) or 0) > (base.get(key) or 0):
+                regressions.append(
+                    f"new {label}: {base.get(key) or 0} -> {run.get(key) or 0}"
+                )
+    if regressions:
+        L.append("**REGRESSED:**")
+        L += [f"- {r}" for r in regressions]
+    else:
+        L.append(f"OK — no regression beyond the {threshold * 100:.0f}% threshold.")
+    L.append("")
+    return "\n".join(L), regressions
+
+
+# -- bench wiring ---------------------------------------------------------
+
+
+def write_bench_report(result: dict, root: str, prefix: str = "BENCH_r") -> str | None:
+    """Delta table for one bench result vs the previous committed round:
+    finds the highest-numbered ``BENCH_rNN.json`` under ``root``, compares
+    every shared numeric key, and writes ``REPORT_rMM.md`` (MM = NN + 1,
+    the round this result will be committed as) next to it.  Returns the
+    report path, or None when there is no previous round to compare."""
+    rounds = []
+    for p in glob.glob(os.path.join(root, prefix + "*.json")):
+        m = re.search(r"_r(\d+)\.json$", p)
+        if m:
+            rounds.append((int(m.group(1)), p))
+    if not rounds:
+        return None
+    prev_n, prev_path = max(rounds)
+    try:
+        with open(prev_path) as f:
+            prev = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    L = [
+        f"# Bench report — round r{prev_n + 1:02d} vs r{prev_n:02d}",
+        "",
+        f"Baseline file: `{os.path.basename(prev_path)}`.  Positive delta =",
+        "this run is higher; whether that is good depends on the key",
+        "(examples/sec up = good, *_error present = bad).",
+        "",
+        "| key | " + f"r{prev_n:02d} | new | delta |",
+        "|---|---:|---:|---:|",
+    ]
+    keys = [
+        k
+        for k in result
+        if isinstance(result.get(k), (int, float))
+        and isinstance(prev.get(k), (int, float))
+    ]
+    for k in sorted(keys):
+        a, b = result[k], prev[k]
+        delta = f"{(a - b) / abs(b) * 100:+.1f}%" if b else f"{a - b:+g}"
+        L.append(f"| {k} | {_fmt(b)} | {_fmt(a)} | {delta} |")
+    only_new = sorted(set(result) - set(prev))
+    only_old = sorted(set(prev) - set(result))
+    if only_new:
+        L += ["", "New keys: " + ", ".join(f"`{k}`" for k in only_new)]
+    if only_old:
+        L += ["", "Dropped keys: " + ", ".join(f"`{k}`" for k in only_old)]
+    L.append("")
+    out = os.path.join(root, f"REPORT_r{prev_n + 1:02d}.md")
+    with open(out, "w") as f:
+        f.write("\n".join(L))
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="report",
+        description="Render a fast_tffm_tpu telemetry JSONL run; "
+        "--compare gates regressions (exit 1).",
+    )
+    ap.add_argument("run", help="telemetry JSONL file (metrics_path of the run)")
+    ap.add_argument(
+        "--compare", metavar="BASE", help="baseline telemetry JSONL to diff against"
+    )
+    ap.add_argument(
+        "--threshold",
+        type=float,
+        default=0.15,
+        help="max tolerated median-throughput drop vs BASE (fraction, default 0.15)",
+    )
+    ap.add_argument(
+        "--strict",
+        action="store_true",
+        help="also fail on NEW steady-state compiles / stalls / anomalies",
+    )
+    ap.add_argument("--out", metavar="PATH", help="write the report here instead of stdout")
+    args = ap.parse_args(argv)
+    try:
+        run = summarize(load_run(args.run))
+    except (OSError, ValueError) as e:
+        print(f"report: {e}", file=sys.stderr)
+        return 2
+    text = render(run, title=os.path.basename(args.run))
+    rc = 0
+    if args.compare:
+        try:
+            base = summarize(load_run(args.compare))
+        except (OSError, ValueError) as e:
+            print(f"report: {e}", file=sys.stderr)
+            return 2
+        cmp_text, regressions = compare(
+            run, base, threshold=args.threshold, strict=args.strict
+        )
+        text = text + "\n" + cmp_text
+        if regressions:
+            rc = 1
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+        print(f"report -> {args.out}", file=sys.stderr)
+    else:
+        print(text)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

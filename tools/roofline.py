@@ -25,9 +25,9 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import _bench_watchdog
+from fast_tffm_tpu.telemetry import arm_hang_exit
 
-_watchdog = _bench_watchdog.arm(seconds=2400, what="roofline.py")
+_watchdog = arm_hang_exit(seconds=2400, what="roofline.py")
 
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
