@@ -62,6 +62,16 @@ import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
+# Persistent XLA compilation cache (the [Telemetry] compilation_cache_dir
+# satellite): repeated bench runs skip the multi-minute scale-rung
+# compiles across processes.  Opt-in via env so the default bench still
+# measures cold compiles honestly.
+_CC_DIR = os.environ.get("BENCH_COMPILATION_CACHE", "")
+if _CC_DIR:
+    from fast_tffm_tpu.telemetry import enable_compilation_cache
+
+    enable_compilation_cache(_CC_DIR)
+
 from fast_tffm_tpu.models import Batch, FMModel
 from fast_tffm_tpu.optim import AdagradState
 from fast_tffm_tpu.trainer import (
@@ -993,6 +1003,85 @@ def main():
         results["packed_error"] = str(e)[:120]
 
 
+
+    # --- checkpoint A/B lever (ckpt_mode sync|async|delta): train-loop
+    #     stall per save and bytes per save on a 1M-row state.  `sync` is
+    #     the classic blocking save (convert + D2H + write inline);
+    #     `async` is the boundary cost of the snapshot+handoff (the writer
+    #     thread finishes off-loop); `delta` is the touched-window path
+    #     (bitmap D2H + row gather dispatch).  BENCH_CKPT_MODES selects a
+    #     subset.  ckpt_stall_ms_per_save is the trajectory key the report
+    #     gate watches (ckpt stall share). ---
+    try:
+        import statistics as _stats
+        import tempfile
+
+        from fast_tffm_tpu.checkpoint_async import AsyncCheckpointer
+
+        modes = [
+            m.strip()
+            for m in os.environ.get("BENCH_CKPT_MODES", "sync,async,delta").split(",")
+            if m.strip()
+        ]
+        cv = 1 << 20
+        cmodel = FMModel(vocabulary_size=cv, factor_num=SCALE_K, order=2)
+        cstate = init_state(cmodel, jax.random.key(1))
+        cbatch = make_batch(zipf_ids(rng, (BATCH, NNZ), cv), 900)
+        cdir = tempfile.mkdtemp(prefix="bench_ckpt_")
+        ident = lambda s: s
+        stall_ms: dict = {}
+        bytes_per: dict = {}
+        if "sync" in modes:
+            ck = AsyncCheckpointer(os.path.join(cdir, "sync.ckpt"), "npz")
+            ts = []
+            for i in range(3):
+                t0 = time.perf_counter()
+                ck.save_boundary(cstate, ident, i, sync=True, emit=False)
+                ts.append((time.perf_counter() - t0) * 1e3)
+            stall_ms["sync"] = round(_stats.median(ts), 2)
+            bytes_per["full"] = os.path.getsize(os.path.join(cdir, "sync.ckpt"))
+        if "async" in modes:
+            ck = AsyncCheckpointer(
+                os.path.join(cdir, "async.ckpt"), "npz", async_save=True
+            )
+            ts = []
+            for i in range(3):
+                t0 = time.perf_counter()
+                ck.save_boundary(cstate, ident, i)
+                ts.append((time.perf_counter() - t0) * 1e3)
+                ck.finalize()  # writer time excluded: it overlaps training
+            stall_ms["async"] = round(_stats.median(ts), 2)
+        if "delta" in modes:
+            ck = AsyncCheckpointer(
+                os.path.join(cdir, "delta.ckpt"), "npz",
+                delta_every_steps=1, vocab=cv, row_dim=1 + SCALE_K,
+            )
+            ck.save_boundary(cstate, ident, 0, sync=True, emit=False)  # base
+            ts = []
+            for i in range(3):
+                ck.note_batch(cbatch)
+                t0 = time.perf_counter()
+                ck.delta_boundary(cstate, ident, i + 1)
+                ts.append((time.perf_counter() - t0) * 1e3)
+                ck.finalize()
+            stall_ms["delta"] = round(_stats.median(ts), 2)
+            dps = sorted(
+                p for p in os.listdir(cdir) if ".delta-" in p and p.endswith(".npz")
+            )
+            if dps:
+                bytes_per["delta"] = os.path.getsize(os.path.join(cdir, dps[-1]))
+        results["ckpt_stall_ms_per_save"] = stall_ms
+        results["ckpt_bytes_per_save"] = bytes_per
+        if "sync" in stall_ms and "async" in stall_ms and stall_ms["sync"]:
+            results["ckpt_async_over_sync_stall"] = round(
+                stall_ms["async"] / stall_ms["sync"], 4
+            )
+        del cstate, cbatch
+        import shutil
+
+        shutil.rmtree(cdir, ignore_errors=True)
+    except Exception as e:
+        results["ckpt_ab_error"] = str(e)[:120]
 
     # --- r1 continuity: the 1M-row uniform-id microbench ---
     try:

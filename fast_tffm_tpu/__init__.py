@@ -39,6 +39,9 @@ _EXPORTS = {
     "write_fmb": "fast_tffm_tpu.data.binary",
     "StreamingAUC": "fast_tffm_tpu.metrics",
     "auc": "fast_tffm_tpu.metrics",
+    "AsyncCheckpointer": "fast_tffm_tpu.checkpoint_async",
+    "save_checkpoint": "fast_tffm_tpu.checkpoint",
+    "restore_checkpoint": "fast_tffm_tpu.checkpoint",
     "Batch": "fast_tffm_tpu.models",
     "DeepFMModel": "fast_tffm_tpu.models",
     "FFMModel": "fast_tffm_tpu.models",

@@ -51,6 +51,14 @@ def main(argv: list[str] | None = None) -> int:
         cfg.metrics_path = args.metrics_path
     if args.run_id is not None:
         cfg.telemetry_run_id = args.run_id
+    if cfg.telemetry_compilation_cache_dir:
+        # Before any driver import compiles a program: repeated runs (and
+        # serving cold starts) then read their XLA programs back from the
+        # on-disk cache instead of recompiling — the compile sentinel
+        # reports the hits distinctly (kind=compile cache_hits).
+        from fast_tffm_tpu.telemetry import enable_compilation_cache
+
+        enable_compilation_cache(cfg.telemetry_compilation_cache_dir)
     if args.legacy:
         print(
             f"note: ignoring legacy cluster args {args.legacy!r} — the SPMD mesh "

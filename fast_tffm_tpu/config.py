@@ -36,6 +36,19 @@ class Config:
     #   dist shards it, incl. multi-host)
     model_file: str = "model.ckpt"
     checkpoint_format: str = "npz"  # npz | orbax (orbax = sharded, pod-scale)
+    # [Checkpoint] — async/incremental saves (checkpoint_async.py; npz only)
+    async_save: bool = False  # take full saves off the train loop: on-device
+    #   snapshot at the boundary, a writer thread does convert/D2H/write;
+    #   at most one in flight (next boundary blocks if the writer lags);
+    #   SIGTERM/final saves stay synchronous (last-good-state unchanged)
+    delta_every_steps: int = 0  # >0: between full saves, write a delta-NNNN
+    #   file every N steps carrying ONLY the rows the window touched (the
+    #   on-device touched-row bitmap) + dense leaves, content-signature
+    #   chained to the base; restore replays base+chain; 0 = off
+    delta_chain_max: int = 16  # deltas per chain before the next boundary
+    #   promotes itself to a full save (bounds restore replay length)
+    checkpoint_chunk_mb: int = 64  # save/restore host-staging bound: arrays
+    #   stream D2H/disk in this many MB per slice (never 2x table on host)
     # [Train]
     train_files: tuple[str, ...] = ()
     weight_files: tuple[float, ...] = ()  # per-file example weights
@@ -97,6 +110,10 @@ class Config:
     telemetry_stall_timeout_s: float = 0.0  # liveness watchdog: dump thread
     #   stacks + prefetch depth as kind=stall when no step completes for
     #   this many seconds (0 = watchdog off)
+    telemetry_compilation_cache_dir: str = ""  # persistent XLA compilation
+    #   cache directory (jax_compilation_cache_dir): serving cold-start
+    #   warmup and repeated bench runs skip recompiles across processes;
+    #   the compile sentinel marks cache hits distinctly ("" = off)
     # [Predict]
     predict_files: tuple[str, ...] = ()
     score_path: str = "scores.txt"
@@ -145,6 +162,26 @@ class Config:
             )
         if self.checkpoint_format not in ("npz", "orbax"):
             raise ValueError(f"unknown checkpoint_format {self.checkpoint_format!r}")
+        if self.delta_every_steps < 0:
+            raise ValueError(
+                f"delta_every_steps must be >= 0 (0 = off), got {self.delta_every_steps}"
+            )
+        if self.delta_every_steps > 0 and self.checkpoint_format == "orbax":
+            # The delta container is an npz sibling file chained by content
+            # signature; orbax directories have no such sidecar format (and
+            # orbax's own async machinery is the pod-scale answer there).
+            raise ValueError(
+                "delta_every_steps > 0 requires checkpoint_format = npz "
+                "(the delta chain is an npz sidecar format)"
+            )
+        if self.delta_chain_max < 1:
+            raise ValueError(
+                f"delta_chain_max must be >= 1, got {self.delta_chain_max}"
+            )
+        if self.checkpoint_chunk_mb < 1:
+            raise ValueError(
+                f"checkpoint_chunk_mb must be >= 1, got {self.checkpoint_chunk_mb}"
+            )
         if self.compute_dtype not in ("float32", "bfloat16"):
             raise ValueError(f"unknown compute_dtype {self.compute_dtype!r}")
         if self.lookup not in ("allgather", "alltoall"):
@@ -380,6 +417,15 @@ def load_config(path: str) -> Config:
     cfg.telemetry_stall_timeout_s = get(
         te, "stall_timeout_s", float, cfg.telemetry_stall_timeout_s
     )
+    cfg.telemetry_compilation_cache_dir = get(
+        te, "compilation_cache_dir", str, cfg.telemetry_compilation_cache_dir
+    )
+
+    c = "Checkpoint"
+    cfg.async_save = get(c, "async_save", ini._convert_to_boolean, cfg.async_save)
+    cfg.delta_every_steps = get(c, "delta_every_steps", int, cfg.delta_every_steps)
+    cfg.delta_chain_max = get(c, "delta_chain_max", int, cfg.delta_chain_max)
+    cfg.checkpoint_chunk_mb = get(c, "chunk_mb", int, cfg.checkpoint_chunk_mb)
 
     p = "Predict"
     cfg.predict_files = get(p, "predict_files", _split_files, cfg.predict_files)

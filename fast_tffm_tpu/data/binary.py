@@ -725,11 +725,20 @@ def ensure_fmb_cache(
             def fall_back_to_text(err):
                 passthrough = [os.fspath(p) for p in files if is_fmb(p)]
                 if passthrough:
+                    # Mixed list, conversion failed (DESIGN §8.3): say
+                    # exactly WHICH entries block the text fallback and
+                    # what fixes each side — the bare "hard error" left
+                    # the operator grepping the file list by hand.
+                    listed = "\n".join(f"    {p}" for p in passthrough)
                     raise OSError(
-                        f"binary_cache: cannot write {cache} ({err}) and "
-                        f"{passthrough} have no text form to fall back to; "
-                        "fix cache-directory permissions or make the input "
-                        "list all-text or all-FMB"
+                        f"binary_cache: cannot build the FMB cache for "
+                        f"{path!r} ({err}), and the whole stream cannot fall "
+                        "back to text because these input entries are "
+                        "pre-built FMB with no text form:\n"
+                        f"{listed}\n"
+                        f"  fix one side: make {os.path.dirname(cache) or '.'!r} "
+                        f"writable (or pre-convert {path!r} with the `convert` "
+                        "verb), or make the input list all-text / all-FMB"
                     )
                 warnings.warn(
                     f"binary_cache: cannot write {cache} ({err}); streaming "

@@ -44,6 +44,7 @@ __all__ = [
     "full_epoch_perm",
     "make_cached_train_step",
     "make_cached_scan_train_step",
+    "make_cached_touched_marker",
     "epoch_index_chunks",
 ]
 
@@ -249,6 +250,42 @@ def make_cached_train_step(model, learning_rate: float, data: DeviceDataset, bod
         return _step_shuffled(state, arrays, perm, i)
 
     return step, step_shuffled
+
+
+def make_cached_touched_marker(data: DeviceDataset):
+    """Touched-row bitmap markers for the delta-checkpoint subsystem on
+    the device-cache path, where the driver's per-step "batch" is a
+    resident batch index (scalar) or a [K] scan chunk — the ids live on
+    device, so the mark slices them there (``(mark, mark_shuffled)``;
+    the shuffled variant routes through the epoch permutation exactly as
+    the shuffled step gathers its rows).  Resident arrays are EXPLICIT
+    jit arguments, never closure captures (the embedded-constant cliff,
+    DESIGN §6)."""
+    B = data.batch_size
+
+    def _rows(i):
+        starts = i.reshape(-1).astype(jnp.int32)
+        return (
+            starts[:, None] * B + jnp.arange(B, dtype=jnp.int32)[None, :]
+        ).reshape(-1)
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def _mark(bitmap, ids_arr, i):
+        return bitmap.at[ids_arr[_rows(i)].reshape(-1)].set(True, mode="drop")
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def _mark_shuffled(bitmap, ids_arr, perm, i):
+        return bitmap.at[ids_arr[perm[_rows(i)]].reshape(-1)].set(
+            True, mode="drop"
+        )
+
+    def mark(bitmap, i):
+        return _mark(bitmap, data.ids, i)
+
+    def mark_shuffled(bitmap, perm, i):
+        return _mark_shuffled(bitmap, data.ids, perm, i)
+
+    return mark, mark_shuffled
 
 
 def epoch_index_chunks(batches: int, k: int):

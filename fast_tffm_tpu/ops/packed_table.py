@@ -57,6 +57,9 @@ __all__ = [
     "unpack_accum_rows",
     "unpack_accum_any",
     "packed_gather",
+    "packed_accum_gather_any",
+    "fused_accum_gather",
+    "scatter_logical_rows",
     "lane_spread",
     "packed_dense_grad",
     "packed_dense_adagrad_update",
@@ -179,6 +182,74 @@ def packed_gather(packed: jax.Array, ids: jax.Array, d: int) -> jax.Array:
         piece = rows128[..., s * d : (s + 1) * d]
         out = out + jnp.where((slot == s)[..., None], piece, 0)
     return out
+
+
+def packed_accum_gather_any(
+    acc_packed: jax.Array, ids: jax.Array, d: int
+) -> jax.Array:
+    """Logical accumulator rows for ``ids`` from a packed accumulator of
+    either granularity: [VP, 128] element → [..., D] (same packing as the
+    table, so the table gather serves it), [VP, P] row → [..., 1] slot
+    scalars.  The checkpoint delta writer's accumulator twin of
+    ``packed_gather`` — deltas store LOGICAL rows, so packed and rows
+    checkpoints stay interchangeable link by link."""
+    p = rows_per_tile(d)
+    if acc_packed.shape[-1] == LANES and p != LANES:
+        return packed_gather(acc_packed, ids, d)
+    return acc_packed[ids // p, ids % p][..., None]
+
+
+def fused_accum_gather(fused: jax.Array, ids: jax.Array, d: int) -> jax.Array:
+    """[..., 1] row-accumulator scalars for logical ``ids`` from a FUSED
+    tile-row table (the accumulator lane at slot offset s·(D+1)+D)."""
+    p = fused_rows_per_tile(d)
+    d1 = d + 1
+    phys = ids // p
+    slot = ids % p
+    rows128 = fused[phys]
+    out = jnp.zeros(ids.shape, fused.dtype)
+    for s in range(p):
+        out = out + jnp.where(slot == s, rows128[..., s * d1 + d], 0)
+    return out[..., None]
+
+
+def scatter_logical_rows(
+    packed: jax.Array, ids: jax.Array, rows: jax.Array, d: int
+) -> jax.Array:
+    """Write logical rows INTO a packed table: the inverse of
+    ``packed_gather``, used by the serving hot-reload watcher to apply a
+    checkpoint delta in place instead of re-reading the full table.
+
+    ``ids`` must be sorted ascending and unique (delta files store
+    ``np.flatnonzero`` output, which is both by construction).  Logical
+    rows sharing a physical tile row occupy DISJOINT lane ranges, so a
+    segment-SUM of per-occurrence (mask, payload) lane images merges them
+    exactly; untouched neighbor lanes keep their current values through
+    the mask.  One wide gather + one wide scatter (unique + sorted
+    indices by construction — the round-5 declaration that skips XLA's
+    sort-based scatter dedup)."""
+    p = rows_per_tile(d)
+    vp = packed.shape[0]
+    flat = ids.reshape(-1).astype(jnp.int32)
+    m = flat.shape[0]
+    r = rows.reshape(m, d).astype(packed.dtype)
+    slot = (flat % p).astype(jnp.int32)
+    phys = jnp.minimum((flat // p).astype(jnp.int32), vp)
+    pay128 = lane_spread(r, slot, p, d)
+    mask128 = lane_spread(jnp.ones_like(r), slot, p, d)
+    # Segment per physical row (ids sorted ⇒ phys sorted): disjoint-lane
+    # sums merge the row's occupants; representatives get unique ascending
+    # uphys exactly as packed_sparse_adagrad_update builds them.
+    is_new = jnp.concatenate([jnp.ones((1,), bool), phys[1:] != phys[:-1]])
+    seg = jnp.cumsum(is_new) - 1
+    paysum = jax.ops.segment_sum(pay128, seg, num_segments=m)
+    masksum = jax.ops.segment_sum(mask128, seg, num_segments=m)
+    uphys = (jnp.int32(vp) + jnp.arange(m, dtype=jnp.int32)).at[seg].set(phys)
+    cur = packed[jnp.minimum(uphys, vp - 1)]
+    new = cur * (1 - masksum) + paysum
+    return packed.at[uphys].set(
+        new, mode="drop", unique_indices=True, indices_are_sorted=True
+    )
 
 
 def lane_spread(row_grads: jax.Array, slot: jax.Array, p: int, d: int) -> jax.Array:

@@ -77,7 +77,9 @@ def load_scoring_state(cfg: Config, log=print):
     state = init_state(
         model, jax.random.key(0), cfg.init_accumulator_value, cfg.adagrad_accumulator
     )
-    state = restore_checkpoint(cfg.model_file, state)
+    state = restore_checkpoint(
+        cfg.model_file, state, chunk_bytes=cfg.checkpoint_chunk_mb << 20
+    )
     log(f"restored {cfg.model_file} at step {int(state.step)}")
     if cfg.table_layout == "packed":
         from fast_tffm_tpu.trainer import pack_state
@@ -289,6 +291,7 @@ def dist_predict(cfg: Config, log=print, mesh=None) -> str:
                 padded_model, mesh, jax.random.key(0),
                 cfg.init_accumulator_value, cfg.adagrad_accumulator,
             ),
+            chunk_bytes=cfg.checkpoint_chunk_mb << 20,
         )
         state = pack_sharded_on_device(
             logical, model, mesh, cfg.init_accumulator_value, fused=fused_acc
@@ -298,7 +301,9 @@ def dist_predict(cfg: Config, log=print, mesh=None) -> str:
             model, mesh, jax.random.key(0), cfg.init_accumulator_value,
             cfg.adagrad_accumulator,
         )
-        state = restore_checkpoint(cfg.model_file, state)
+        state = restore_checkpoint(
+            cfg.model_file, state, chunk_bytes=cfg.checkpoint_chunk_mb << 20
+        )
     return _run_predict(
         cfg,
         state,

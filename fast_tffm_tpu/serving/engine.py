@@ -38,7 +38,12 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from fast_tffm_tpu.checkpoint import checkpoint_signature
+from fast_tffm_tpu.checkpoint import (
+    checkpoint_save_id,
+    checkpoint_signature,
+    load_delta,
+    read_delta_chain,
+)
 from fast_tffm_tpu.config import Config
 from fast_tffm_tpu.data.libsvm import parse_lines
 from fast_tffm_tpu.serving.buckets import BucketLadder
@@ -94,12 +99,17 @@ class ServingEngine:
             # "new" to the watcher, not as already-loaded — worst case it
             # redundantly reloads the checkpoint we started from.
             self._loaded_sig = checkpoint_signature(cfg.model_file)
+            # Delta bookkeeping, also PRE-restore (under-counting is the
+            # safe direction: re-applying an already-applied delta suffix
+            # in order is idempotent; skipping one is not).
+            self._loaded_save_id, self._applied_deltas = self._chain_baseline()
             model, state = load_scoring_state(cfg, log)
         else:
             # Injected state: the on-disk checkpoint was NEVER loaded, so
             # no signature is "already loaded" — whatever model_file holds
             # (even something older than this baseline) is news to us.
             self._loaded_sig = None
+            self._loaded_save_id, self._applied_deltas = None, 0
         self._state = state
         self._score = make_score_fn(cfg, state, max_nnz, model=model)
         if (
@@ -160,6 +170,7 @@ class ServingEngine:
         self._reload_lock = threading.Lock()
         self._staged_state = None
         self._staged_step = None
+        self._staged_is_delta = False
 
         n = self._ladder.warmup(self._state)
         # Attribute every startup compile (ladder rungs + unpackers) to
@@ -377,9 +388,14 @@ class ServingEngine:
         with self._reload_lock:
             staged, self._staged_state = self._staged_state, None
             staged_step = self._staged_step
+            staged_is_delta = self._staged_is_delta
         if staged is not None:
             self._state = staged
-            self.metrics.on_reload(ok=True)
+            if not staged_is_delta:
+                # Delta swaps are already counted (per FILE) by
+                # on_delta_reload — keeping them out of `reloads` keeps
+                # the two counters independent: reloads = full re-reads.
+                self.metrics.on_reload(ok=True)
             try:
                 self._log(f"serving: swapped in checkpoint step {staged_step}")
             except Exception:
@@ -441,28 +457,139 @@ class ServingEngine:
 
     # -- hot reload ------------------------------------------------------
 
+    def _chain_baseline(self) -> tuple[str | None, int]:
+        """(base save_id, delta-chain length) of the on-disk checkpoint,
+        tolerant of anything unreadable (None/0 just means the in-place
+        delta path stays off until the next full reload)."""
+        import os as _os
+
+        path = self._cfg.model_file
+        if _os.path.isdir(path):
+            return None, 0
+        try:
+            sid = checkpoint_save_id(path)
+            _, chain = read_delta_chain(path)
+            return sid, len(chain)
+        except (ValueError, OSError):
+            return checkpoint_save_id(path), 0
+
+    def _apply_delta_state(self, state, delta):
+        """Functional in-place apply of ONE delta to a serving state:
+        scatter the logical rows into the (rows or plain-packed) table,
+        swap the dense leaves, advance the step.  Never donates — the
+        collector may be mid-flush on the current buffers.  (Optimizer
+        accumulators are not updated: scoring never reads them, and the
+        next full reload replaces them.)"""
+        import jax
+        import jax.numpy as jnp
+
+        idx = delta["idx"]
+        table = state.table
+        if idx.size:
+            i32 = jnp.asarray(idx.astype(np.int32))
+            rows = jnp.asarray(delta["table_rows"])
+            if self._cfg.table_layout == "packed":
+                from fast_tffm_tpu.ops.packed_table import scatter_logical_rows
+
+                table = scatter_logical_rows(
+                    table, i32, rows, self._score.model.row_dim
+                )
+            else:
+                table = table.at[i32].set(rows, mode="drop")
+        dense = state.dense
+        leaves, ddef = jax.tree.flatten(state.dense)
+        if leaves:
+            dense = jax.tree.unflatten(
+                ddef, [jnp.asarray(x) for x in delta["dense"]]
+            )
+        return state._replace(
+            table=table, dense=dense, step=jnp.asarray(delta["step"])
+        )
+
+    def _try_apply_deltas(self):
+        """In-place incremental reload: when the on-disk base is STILL the
+        one this engine loaded and only new delta files landed, apply the
+        unapplied suffix to the current state and return (staged_state,
+        n_applied) — no full-table re-read.  Returns None when the base
+        changed (full reload required) and (None, 0) when nothing new."""
+        import jax
+
+        base_sig, chain = read_delta_chain(self._cfg.model_file)
+        if (
+            self._loaded_save_id is None
+            or base_sig != self._loaded_save_id
+        ):
+            return None  # new (or unsigned) base: take the full-reload path
+        new = chain[self._applied_deltas :]
+        if not new:
+            return (None, 0)
+        state = self._state
+        n_dense = len(jax.tree.leaves(state.dense))
+        for meta in new:
+            state = self._apply_delta_state(
+                state, load_delta(meta["path"], n_dense)
+            )
+        return (state, len(new))
+
     def _watch(self) -> None:
+        import os as _os
+
         from fast_tffm_tpu.prediction import load_scoring_state
 
         while not self._stop.wait(self._cfg.serve_reload_interval_s):
+            with self._reload_lock:
+                pending = self._staged_state is not None
+            if pending:
+                # The collector hasn't swapped the previous stage yet;
+                # applying deltas onto _state now would drop that stage.
+                continue
             sig = checkpoint_signature(self._cfg.model_file)
             if sig is None or sig == self._loaded_sig:
                 continue
-            try:
+            state = None
+            applied = 0
+            if not _os.path.isdir(self._cfg.model_file):
+                try:
+                    got = self._try_apply_deltas()
+                except Exception as e:
+                    # Torn/mid-write delta: count, keep serving, retry next
+                    # tick (signature not advanced, so a complete write
+                    # still reloads).
+                    self.metrics.on_reload(ok=False)
+                    self._log(
+                        f"serving: delta reload of {self._cfg.model_file} failed: {e!r}"
+                    )
+                    continue
+                if got == (None, 0):
+                    # Signature moved without new chain content (e.g. a
+                    # same-base rewrite mid-observation) — nothing to do.
+                    self._loaded_sig = sig
+                    continue
+                if got is not None:
+                    state, applied = got
+            if state is None:
                 # Full restore OFF the hot path: the collector keeps
-                # serving the old state while this loads.
-                _, state = load_scoring_state(self._cfg, log=lambda *_: None)
-            except Exception as e:
-                # Torn write (non-atomic writer, or a checkpoint mid-copy):
-                # count it, keep serving, retry next tick.  The signature
-                # is NOT advanced, so a later complete write reloads.
-                self.metrics.on_reload(ok=False)
-                self._log(f"serving: reload of {self._cfg.model_file} failed: {e!r}")
-                continue
+                # serving the old state while this loads.  Chain baseline
+                # is read PRE-restore (under-count = safe, see above).
+                new_sid, new_applied = self._chain_baseline()
+                try:
+                    _, state = load_scoring_state(self._cfg, log=lambda *_: None)
+                except Exception as e:
+                    # Torn write (non-atomic writer, or a checkpoint
+                    # mid-copy): count it, keep serving, retry next tick.
+                    self.metrics.on_reload(ok=False)
+                    self._log(f"serving: reload of {self._cfg.model_file} failed: {e!r}")
+                    continue
+                self._loaded_save_id = new_sid
+                self._applied_deltas = new_applied
+            else:
+                self._applied_deltas += applied
+                self.metrics.on_delta_reload(applied)
             self._loaded_sig = sig
             with self._reload_lock:
                 self._staged_state = state
                 self._staged_step = int(state.step)
+                self._staged_is_delta = applied > 0
 
     # -- shutdown --------------------------------------------------------
 

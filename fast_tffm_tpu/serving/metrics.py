@@ -106,8 +106,10 @@ class ServingMetrics:
         self.flushes_full = 0  # max_batch filled before the timer
         self.rows = 0  # real rows scored (excl. bucket padding)
         self.padded_rows = 0  # bucket-padding rows scored and discarded
-        self.reloads = 0
+        self.reloads = 0  # FULL checkpoint re-reads swapped in
         self.reload_failures = 0  # watcher restore attempts that raised
+        self.delta_reloads = 0  # delta FILES applied in place (a delta
+        #   swap does NOT also bump `reloads` — the counters are disjoint)
         self.bucket_rows: dict[int, int] = {}  # bucket size -> real rows
 
     def on_submit(self, accepted: bool) -> None:
@@ -147,6 +149,13 @@ class ServingMetrics:
             else:
                 self.reload_failures += 1
 
+    def on_delta_reload(self, n_deltas: int) -> None:
+        """The watcher applied ``n_deltas`` incremental checkpoint files in
+        place (no full-table re-read) — counted separately from full
+        reloads so a dashboard can see the cheap path is the one firing."""
+        with self._lock:
+            self.delta_reloads += n_deltas
+
     def snapshot(self) -> dict:
         """One flat dict (JSONL-ready).  Latencies in ms, keyed per stage;
         occupancy in [0, 1]; bucket_rows keyed by stringified bucket size
@@ -164,6 +173,7 @@ class ServingMetrics:
                 "batch_occupancy": round(self.rows / scored, 4) if scored else None,
                 "reloads": self.reloads,
                 "reload_failures": self.reload_failures,
+                "delta_reloads": self.delta_reloads,
                 "bucket_rows": {str(k): v for k, v in sorted(self.bucket_rows.items())},
                 "queue_ms": self.queue.snapshot(),
                 "compute_ms": self.compute.snapshot(),

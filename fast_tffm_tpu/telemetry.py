@@ -66,6 +66,8 @@ __all__ = [
     "classify_stall",
     "first_nonfinite_leaf",
     "arm_hang_exit",
+    "enable_compilation_cache",
+    "global_cache_hit_count",
 ]
 
 SCHEMA_VERSION = 1
@@ -99,6 +101,16 @@ SCHEMAS: dict[str, tuple[str, ...]] = {
         "stacks",
     ),
     "anomaly": ("event", "loss"),
+    "ckpt": (
+        "mode",  # full (async) | delta | sync
+        "snapshot_ms",
+        "convert_ms",
+        "d2h_ms",
+        "write_ms",
+        "bytes",
+        "rows_written",
+        "train_stall_ms",
+    ),
     "summary": ("total_compiles", "steady_compiles", "stalls", "anomalies"),
 }
 
@@ -116,9 +128,15 @@ def new_run_id() -> str:
 # counter instead.
 _compile_lock = threading.Lock()
 _compile_count = 0
+_cache_hit_count = 0
 _listener_state = [None]  # None = not tried, True/False = outcome
 
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+# Fired by jax's persistent compilation cache on every read hit.  Counted
+# separately so a kind=compile record can say "this 'compile' was served
+# from the on-disk cache" — a cold serving warmup with a warm cache shows
+# compiles=N cache_hits=N instead of looking like N real XLA compiles.
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
 
 
 def _on_duration_event(event: str, duration: float, **kw) -> None:
@@ -126,6 +144,13 @@ def _on_duration_event(event: str, duration: float, **kw) -> None:
     if event == _COMPILE_EVENT:
         with _compile_lock:
             _compile_count += 1
+
+
+def _on_event(event: str, **kw) -> None:
+    global _cache_hit_count
+    if event == _CACHE_HIT_EVENT:
+        with _compile_lock:
+            _cache_hit_count += 1
 
 
 def _ensure_compile_listener() -> bool:
@@ -137,7 +162,42 @@ def _ensure_compile_listener() -> bool:
             _listener_state[0] = True
         except Exception:
             _listener_state[0] = False
+        try:
+            import jax.monitoring
+
+            jax.monitoring.register_event_listener(_on_event)
+        except Exception:
+            pass  # hit counting is additive; the compile count stands alone
     return _listener_state[0]
+
+
+def global_cache_hit_count() -> int:
+    """Persistent-compilation-cache read hits observed process-wide."""
+    with _compile_lock:
+        return _cache_hit_count
+
+
+def enable_compilation_cache(path: str) -> bool:
+    """Point jax's persistent XLA compilation cache at ``path`` (config
+    key ``[Telemetry] compilation_cache_dir``): repeated bench runs and
+    serving cold-start warmups skip recompiles across processes.  The
+    thresholds drop to zero so even the small CPU-test programs cache —
+    the sentinel (cache_hits on kind=compile records) is how a run proves
+    the cache worked.  Returns False (with no side effects) when this jax
+    lacks the knobs."""
+    try:
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        try:
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        except Exception:
+            pass  # older jax: dir alone still caches the big programs
+        return True
+    except Exception:
+        return False
 
 
 def global_compile_count() -> int:
@@ -159,6 +219,7 @@ class CompileSentinel:
     def __init__(self):
         self._ok = _ensure_compile_listener()
         self._seen = global_compile_count()
+        self._seen_hits = global_cache_hit_count()
 
     @property
     def available(self) -> bool:
@@ -170,6 +231,15 @@ class CompileSentinel:
         n = global_compile_count()
         delta = n - self._seen
         self._seen = n
+        return delta
+
+    def drain_cache_hits(self) -> int:
+        """Persistent-cache hits since the last drain — programs that
+        LOOKED like cold compiles but were served from the on-disk cache
+        (no backend_compile fires for them)."""
+        n = global_cache_hit_count()
+        delta = n - self._seen_hits
+        self._seen_hits = n
         return delta
 
 
@@ -448,8 +518,12 @@ class RunMonitor:
         are separable from the priced-in ones."""
         self.heartbeat(step)
         delta = self._sentinel.drain()
+        hits = self._sentinel.drain_cache_hits()
         self._last_warmup = bool(warmup)
-        if delta:
+        if delta or hits:
+            # Persistent-cache hits ride the record distinctly: they are
+            # programs that would have compiled but were read back from
+            # the on-disk cache — never counted as steady recompiles.
             with self._lock:
                 self.compiles_total += delta
                 if not warmup:
@@ -461,6 +535,7 @@ class RunMonitor:
                 compiles=delta,
                 total_compiles=self.compiles_total,
                 warmup=bool(warmup),
+                cache_hits=hits,
             )
         if self._mem_every_s > 0:
             now = time.monotonic()
@@ -558,7 +633,8 @@ class RunMonitor:
         if self._watchdog is not None:
             self._watchdog.join(timeout=2.0)
         delta = self._sentinel.drain()
-        if delta:
+        hits = self._sentinel.drain_cache_hits()
+        if delta or hits:
             # Compiles landing between the last dispatch and close (e.g.
             # the prefetch thread mid-compiling an unpack program when a
             # SIGTERM stopped the loop) inherit the last dispatch's
@@ -575,6 +651,7 @@ class RunMonitor:
                 compiles=delta,
                 total_compiles=self.compiles_total,
                 warmup=warm,
+                cache_hits=hits,
             )
         self.emit_mem()
         self.emit(

@@ -23,7 +23,7 @@ import time
 import jax
 import numpy as np
 
-from fast_tffm_tpu.checkpoint import restore_checkpoint, save_checkpoint
+from fast_tffm_tpu.checkpoint import restore_checkpoint
 from fast_tffm_tpu.config import Config, build_model
 from fast_tffm_tpu.data.native import best_parser
 from fast_tffm_tpu.data.pipeline import batch_stream
@@ -305,6 +305,8 @@ def _run_training(
     extra_metrics=None,
     saveable=None,
     step_hook=None,
+    row_dim=0,
+    mark_touched=None,
 ):
     """Shared step loop.  ``train_stream(epoch)`` overrides the per-epoch
     input stream, ``to_batch(parsed, w)`` the host→device batch assembly,
@@ -331,7 +333,11 @@ def _run_training(
     it to report alltoall overflow-fallback step counts).  ``saveable``
     (optional) converts the live state to its checkpoint form before
     every save — the packed table layout uses it to store LOGICAL [V, D]
-    arrays, keeping packed and rows checkpoints interchangeable."""
+    arrays, keeping packed and rows checkpoints interchangeable.
+    ``row_dim`` (the model's logical row width) and ``mark_touched`` (an
+    optional custom touched-row bitmap marker — the device-cache drivers
+    mark from their resident id arrays) parameterize the async/delta
+    checkpoint subsystem (checkpoint_async.AsyncCheckpointer)."""
     if saveable is None:
         saveable = lambda st: st
     if train_stream is None:
@@ -384,6 +390,38 @@ def _run_training(
         stall_timeout_s=cfg.telemetry_stall_timeout_s,
         mem_every_s=cfg.telemetry_mem_every_s,
         log=log,
+    )
+    # Save boundaries (full + delta) go through ONE owner: async full saves
+    # snapshot on device and hand the convert/D2H/write to a writer thread
+    # (at most one in flight, back-pressure counted); delta saves ship only
+    # the touched-row window; every save emits a kind=ckpt record.  The
+    # SIGTERM/final paths below stay synchronous (sync=True), so the
+    # last-good-state guarantee is exactly the old one.
+    from fast_tffm_tpu.checkpoint_async import AsyncCheckpointer
+
+    if cfg.delta_every_steps > 0 and ckpt_format != "npz":
+        raise ValueError(
+            "delta_every_steps > 0 requires npz checkpoints — this run "
+            "resolved checkpoint_format to orbax (multi-host pod, or "
+            "model_file already holds an orbax dir); disable delta saves "
+            "or point model_file at a fresh npz path"
+        )
+    if cfg.async_save and ckpt_format != "npz":
+        log("note: async_save applies to npz checkpoints — orbax saves stay synchronous")
+    ckpt = AsyncCheckpointer(
+        cfg.model_file,
+        ckpt_format,
+        monitor=monitor,
+        log=log,
+        chunk_bytes=cfg.checkpoint_chunk_mb << 20,
+        async_save=cfg.async_save,
+        delta_every_steps=cfg.delta_every_steps,
+        delta_chain_max=cfg.delta_chain_max,
+        vocab=cfg.vocabulary_size,
+        table_layout=cfg.table_layout,
+        row_dim=row_dim,
+        mark_fn=mark_touched,
+        start_step=start_step,
     )
     # Preemption-safe shutdown (the reference's only recovery story was
     # Supervisor restart-from-checkpoint; cloud TPU maintenance sends
@@ -439,6 +477,14 @@ def _run_training(
                 # thing the serving bucket ladder pins to zero, now
                 # visible on the train path too.
                 monitor.on_dispatch(step_num, warmup=(epoch == 0))
+                if ckpt.delta_enabled:
+                    # OR this batch's rows into the device bitmap; at a
+                    # delta boundary, ship the touched window (writer
+                    # thread) and resume immediately.
+                    ckpt.note_batch(b)
+                    if ckpt.delta_due(step_num) and not stop_requested.is_set():
+                        with monitor.suspended():
+                            ckpt.delta_boundary(state, saveable, step_num)
                 losses.append(loss)  # device value(s); only sync at log points
                 pending_steps += k
                 if examples_per_step is not None:
@@ -530,8 +576,10 @@ def _run_training(
                 # again is a genuine steady-state recompile.
                 monitor.on_dispatch(int(state.step), warmup=(epoch == 0))
             if cfg.save_every_epochs and (epoch + 1) % cfg.save_every_epochs == 0:
-                with monitor.suspended():  # saves dispatch nothing either
-                    save_checkpoint(cfg.model_file, saveable(state), ckpt_format)
+                with monitor.suspended():  # the loop dispatches nothing here
+                    # Async mode: snapshot + hand off to the writer; the
+                    # loop resumes while the save converts/transfers/writes.
+                    ckpt.save_boundary(state, saveable, int(state.step))
                 log(f"epoch {epoch} checkpoint -> {cfg.model_file}")
     finally:
         summary_extra = {}
@@ -540,6 +588,10 @@ def _run_training(
             # SIGTERM stop, abort) — a skew burst at the end must still
             # reach the metrics file; it rides the kind=summary record.
             summary_extra = {k: v for k, v in extra_metrics().items() if v}
+        # Join any in-flight async write BEFORE the final sync save below:
+        # an older publish must never land after (and clobber) a newer one.
+        ckpt.finalize()
+        summary_extra.update(ckpt.summary())
         tracer.close()
         monitor.close(**summary_extra)
         for sig, handler in restore_handlers.items():
@@ -547,7 +599,9 @@ def _run_training(
                 signal.signal(sig, handler)
             except (ValueError, TypeError):
                 pass
-    save_checkpoint(cfg.model_file, saveable(state), ckpt_format)
+    # The last save is SYNCHRONOUS regardless of async_save: SIGTERM stop,
+    # run end — when this returns, the state on disk IS the state returned.
+    ckpt.save_boundary(state, saveable, int(state.step), sync=True, emit=False)
     if stop_requested.is_set():
         log(
             f"stopped on signal at step {int(state.step)}, model -> {cfg.model_file} "
@@ -619,6 +673,7 @@ def train(cfg: Config, *, resume: bool = False, log=print, step_hook=None):
                     model, jax.random.key(0), cfg.init_accumulator_value,
                     cfg.adagrad_accumulator,
                 ),
+                chunk_bytes=cfg.checkpoint_chunk_mb << 20,
             )
             state = pack_state(logical, cfg.init_accumulator_value, fused=fused)
             log(f"resumed from {cfg.model_file} at step {int(state.step)} (packed)")
@@ -640,7 +695,9 @@ def train(cfg: Config, *, resume: bool = False, log=print, step_hook=None):
             model, jax.random.key(0), cfg.init_accumulator_value, cfg.adagrad_accumulator
         )
         if resume:
-            state = restore_checkpoint(cfg.model_file, state)
+            state = restore_checkpoint(
+                cfg.model_file, state, chunk_bytes=cfg.checkpoint_chunk_mb << 20
+            )
             log(f"resumed from {cfg.model_file} at step {int(state.step)}")
         predict_step = make_predict_step(model)
         step_body = None
@@ -654,18 +711,19 @@ def train(cfg: Config, *, resume: bool = False, log=print, step_hook=None):
         step_fn = make_scanned_train_step(model, cfg.learning_rate, body=step_body)
     to_batch = _batch_converter(model.uses_fields)
     if cfg.device_cache:
-        step_fn, train_stream, examples_per_step = _device_cached_input(
+        step_fn, train_stream, examples_per_step, mark_touched = _device_cached_input(
             cfg, model, max_nnz, log, body=step_body
         )
         return _run_training(
             cfg, state, step_fn, predict_step, max_nnz, log,
             train_stream=train_stream, to_batch=to_batch,
             examples_per_step=examples_per_step, saveable=saveable,
-            step_hook=step_hook,
+            step_hook=step_hook, row_dim=model.row_dim,
+            mark_touched=mark_touched,
         )
     return _run_training(
         cfg, state, step_fn, predict_step, max_nnz, log, to_batch=to_batch,
-        saveable=saveable, step_hook=step_hook,
+        saveable=saveable, step_hook=step_hook, row_dim=model.row_dim,
     )
 
 
@@ -685,6 +743,7 @@ def _device_cached_input(cfg: Config, model, max_nnz: int, log, body=None):
         full_epoch_perm,
         load_device_dataset,
         make_cached_scan_train_step,
+        make_cached_touched_marker,
         make_cached_train_step,
     )
 
@@ -725,6 +784,17 @@ def _device_cached_input(cfg: Config, model, max_nnz: int, log, body=None):
                 full_epoch_perm(data, cfg.shuffle_seed, epoch)
             )
 
+    # Delta-checkpoint touched-row marking: the per-step "batch" here is a
+    # resident index (scalar or [K] chunk), so the marker slices the ids
+    # ON DEVICE (through the epoch permutation when shuffled) — handles
+    # both the per-step and the scan-fused stream shapes.
+    _mark, _mark_shuffled = make_cached_touched_marker(data)
+
+    def mark_touched(bitmap, i):
+        if perm_ref[0] is not None:
+            return _mark_shuffled(bitmap, perm_ref[0], i)
+        return _mark(bitmap, i)
+
     if cfg.steps_per_call > 1:
         # Scan-fused epochs: the per-call "input" is a pre-placed [K]
         # index vector (remainder-tail vector included), so an epoch is
@@ -744,7 +814,7 @@ def _device_cached_input(cfg: Config, model, max_nnz: int, log, body=None):
                 return stepk_shuffled(state, perm_ref[0], idxs)
             return stepk(state, idxs)
 
-        return step_fn, train_stream, cfg.batch_size
+        return step_fn, train_stream, cfg.batch_size, mark_touched
 
     cached_step, cached_step_shuffled = make_cached_train_step(
         model, cfg.learning_rate, data, body=body
@@ -762,7 +832,7 @@ def _device_cached_input(cfg: Config, model, max_nnz: int, log, body=None):
             return cached_step_shuffled(state, perm_ref[0], i)
         return cached_step(state, i)
 
-    return step_fn, train_stream, cfg.batch_size
+    return step_fn, train_stream, cfg.batch_size, mark_touched
 
 
 def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None, step_hook=None):
@@ -836,6 +906,7 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None, step_
                 padded_model, mesh, jax.random.key(0), cfg.init_accumulator_value,
                 cfg.adagrad_accumulator,
             ),
+            chunk_bytes=cfg.checkpoint_chunk_mb << 20,
         )
         state = pack_sharded_on_device(
             logical, model, mesh, cfg.init_accumulator_value, fused=fused_acc
@@ -847,7 +918,9 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None, step_
             cfg.adagrad_accumulator, table_layout=cfg.table_layout,
         )
         if resume:
-            state = restore_checkpoint(cfg.model_file, state)
+            state = restore_checkpoint(
+                cfg.model_file, state, chunk_bytes=cfg.checkpoint_chunk_mb << 20
+            )
             log(f"resumed from {cfg.model_file} at step {int(state.step)}")
     step_fn = make_sharded_train_step(
         model, cfg.learning_rate, mesh,
@@ -937,6 +1010,15 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None, step_
                 cfg.lookup == "alltoall" and cfg.lookup_overflow == "fallback"
             ),
         )
+
+    mark_touched = None
+    if cached_data is not None and cfg.delta_every_steps > 0:
+        # Delta checkpoints on the resident path mark touched rows from
+        # the sharded id arrays on device (dist_train disallows shuffle,
+        # so the plain sequential-slice marker is the only one needed).
+        from fast_tffm_tpu.data.device_cache import make_cached_touched_marker
+
+        mark_touched, _ = make_cached_touched_marker(cached_data)
 
     extra_metrics = None
     if cfg.lookup == "alltoall" and cfg.lookup_overflow == "fallback":
@@ -1094,4 +1176,6 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None, step_
         extra_metrics=extra_metrics,
         saveable=dist_saveable,
         step_hook=step_hook,
+        row_dim=model.row_dim,
+        mark_touched=mark_touched,
     )

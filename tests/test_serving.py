@@ -383,6 +383,47 @@ def test_hot_reload_picks_up_new_step_mid_stream(tmp_path):
     )
 
 
+@pytest.mark.parametrize("layout", ["rows", "packed"])
+def test_hot_reload_applies_delta_in_place(tmp_path, layout):
+    """A trainer appending a delta file to the loaded base must be picked
+    up WITHOUT a full-table re-read: the watcher applies the touched rows
+    in place (scatter_logical_rows on the packed layout), counted as
+    delta_reloads, and the post-apply scores equal an offline restore of
+    base+chain (restore_checkpoint replays it)."""
+    from fast_tffm_tpu.checkpoint import checkpoint_save_id, save_delta
+
+    cfg = _cfg(tmp_path, serve_reload_interval_s=0.05, table_layout=layout)
+    _checkpoint(cfg, shift=0.5, step=3)
+    line = "1 3:1.0 9:1.0 40:1.0"
+    with ServingEngine(cfg, log=lambda *_: None) as eng:
+        before = eng.submit_line(line).result(timeout=10)
+        assert eng.step == 3
+        idx = np.array([3, 9])
+        rows = np.full((2, 5), 2.5, np.float32)
+        save_delta(
+            cfg.model_file, 1,
+            idx=idx, table_rows=rows, accum_rows=np.ones((2, 5), np.float32),
+            dense_leaves=[], dense_accum_leaves=[],
+            step=np.int32(11), parent_sig=checkpoint_save_id(cfg.model_file),
+        )
+        deadline = time.perf_counter() + 10.0
+        while time.perf_counter() < deadline:
+            eng.submit_line(line).result(timeout=10)
+            if eng.step == 11:
+                break
+            time.sleep(0.02)
+        assert eng.step == 11, "watcher never applied the delta"
+        after = eng.submit_line(line).result(timeout=10)
+        snap = eng.metrics_snapshot()
+    assert snap["delta_reloads"] == 1
+    assert snap["reload_failures"] == 0
+    assert after != before
+    # The in-place apply equals a full offline restore of base+chain.
+    np.testing.assert_array_equal(
+        np.float32(after), _offline_scores(cfg, [line]).astype(np.float32)[0]
+    )
+
+
 def test_reload_survives_torn_checkpoint(tmp_path):
     """A garbage model_file mid-stream must not kill serving: the stage
     fails (counted), the old state keeps serving, and a later good

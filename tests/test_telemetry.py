@@ -60,6 +60,10 @@ _DRIVER_PAYLOADS = {
     "serving": dict(
         requests=10, flushes=3, rows=10, queue_ms={}, compute_ms={}, total_ms={}
     ),
+    "ckpt": dict(
+        mode="full", snapshot_ms=1.0, convert_ms=2.0, d2h_ms=3.0,
+        write_ms=4.0, bytes=1024, rows_written=7, train_stall_ms=1.0,
+    ),
 }
 
 

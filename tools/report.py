@@ -210,6 +210,33 @@ def summarize(records: list[dict]) -> dict:
         for r in kinds.get("anomaly", [])
     ]
 
+    ckpts = kinds.get("ckpt", [])
+    s["ckpt_saves"] = len(ckpts)
+    s["ckpt_modes"] = {}
+    for r in ckpts:
+        mode = r.get("mode") or "?"
+        s["ckpt_modes"][mode] = s["ckpt_modes"].get(mode, 0) + 1
+    stall_ms = sum(
+        r["train_stall_ms"]
+        for r in ckpts
+        if isinstance(r.get("train_stall_ms"), (int, float))
+    )
+    s["ckpt_stall_ms_total"] = round(stall_ms, 1) if ckpts else None
+    s["ckpt_bytes_total"] = (
+        sum(r.get("bytes") or 0 for r in ckpts) if ckpts else None
+    )
+    s["ckpt_rows_written"] = (
+        sum(max(0, r.get("rows_written") or 0) for r in ckpts) if ckpts else None
+    )
+    # Checkpoint stall as a share of wall clock — the companion number to
+    # input_time_share: together they say where the loop's non-compute
+    # time went (feeding the chip vs saving the model).
+    s["ckpt_stall_share"] = (
+        round(stall_ms / 1e3 / s["duration_s"], 4)
+        if ckpts and s["duration_s"]
+        else (0.0 if s["duration_s"] else None)
+    )
+
     mems = kinds.get("mem", [])
     s["host_rss_peak_bytes"] = max(
         (r["host_rss_peak_bytes"] for r in mems if r.get("host_rss_peak_bytes")),
@@ -282,6 +309,28 @@ def render(s: dict, title: str = "run") -> str:
                 f"- host input time ≈ {100 * s['input_time_share']:.1f}% of wall "
                 "clock (overlapped via prefetch)"
             )
+        if s.get("ckpt_stall_share") is not None:
+            L.append(
+                f"- checkpoint stall ≈ {100 * s['ckpt_stall_share']:.1f}% of wall "
+                "clock (train-loop time blocked on saves)"
+            )
+        L.append("")
+    if s.get("ckpt_saves"):
+        L += ["## Checkpointing", ""]
+        modes = ", ".join(f"{m}={n}" for m, n in sorted(s["ckpt_modes"].items()))
+        L.append(
+            f"- {s['ckpt_saves']} save(s) ({modes}), "
+            f"{_fmt_bytes(s['ckpt_bytes_total'])} written, "
+            f"{_fmt(s['ckpt_rows_written'], 0)} rows"
+        )
+        L.append(
+            f"- train-loop stall {_fmt(s['ckpt_stall_ms_total'])} ms total"
+            + (
+                f" ({100 * s['ckpt_stall_share']:.1f}% of wall clock)"
+                if s.get("ckpt_stall_share") is not None
+                else ""
+            )
+        )
         L.append("")
     L += ["## Events", ""]
     L.append(
@@ -354,6 +403,7 @@ _GATE_METRICS = [
     ("anomalies", "anomalies", False),
     ("host_rss_peak_bytes", "host RSS peak", False),
     ("device_peak_bytes", "device mem peak", False),
+    ("ckpt_stall_share", "ckpt stall share", False),
 ]
 
 
@@ -406,6 +456,16 @@ def compare(run: dict, base: dict, threshold: float, strict: bool = False):
                 regressions.append(
                     f"new {label}: {base.get(key) or 0} -> {run.get(key) or 0}"
                 )
+        # Checkpoint stall share regression: the run spends a meaningfully
+        # larger fraction of wall clock blocked on saves than the base did.
+        # The 1% absolute floor keeps end-of-run sync saves (every run has
+        # one) from flagging noise on short runs.
+        rs = run.get("ckpt_stall_share") or 0.0
+        bs = base.get("ckpt_stall_share") or 0.0
+        if rs > 0.01 and rs > bs * (1 + threshold) + 0.002:
+            regressions.append(
+                f"ckpt stall share regressed: {bs:.3f} -> {rs:.3f} of wall clock"
+            )
     if regressions:
         L.append("**REGRESSED:**")
         L += [f"- {r}" for r in regressions]
