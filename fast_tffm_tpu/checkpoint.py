@@ -24,6 +24,7 @@ with the ``like`` state's init rows.  Format is auto-detected on restore
 from __future__ import annotations
 
 import glob as _glob_mod
+import json
 import os
 import re
 import time
@@ -46,6 +47,7 @@ __all__ = [
     "read_delta_chain",
     "load_delta",
     "delta_paths",
+    "read_input_cursor",
     "DEFAULT_CHUNK_BYTES",
 ]
 
@@ -224,6 +226,12 @@ def _chunked_device_place(path: str, name: str, target, chunk_bytes: int):
     return buf
 
 
+def _cursor_entry(cursor: dict) -> np.ndarray:
+    """The input-position cursor as an npz member: canonical JSON bytes
+    (sort_keys so identical cursors are byte-identical members)."""
+    return np.frombuffer(json.dumps(cursor, sort_keys=True).encode(), np.uint8)
+
+
 def _save_npz(
     path: str,
     state: TrainState,
@@ -231,14 +239,17 @@ def _save_npz(
     chunk_bytes: int = DEFAULT_CHUNK_BYTES,
     save_id: str | None = None,
     timings: dict | None = None,
+    cursor: dict | None = None,
 ) -> int:
     """Atomic full npz save.  Arrays stream to disk in bounded chunks
     (device arrays fetch chunk-by-chunk — never 2x table bytes on host).
-    Embeds ``save_id`` (content identity for the delta chain) and resets
-    the chain: any sibling delta files are unlinked BEFORE the publish, so
-    a crash between the two leaves the OLD base + OLD chain (or the old
-    base alone) — always a complete, loadable checkpoint.  Returns bytes
-    written."""
+    Embeds ``save_id`` (content identity for the delta chain), the
+    optional ``cursor`` (the exact input position this state corresponds
+    to — epoch, batch offset, shuffle identity; see training.py), and
+    resets the chain: any sibling delta files are unlinked BEFORE the
+    publish, so a crash between the two leaves the OLD base + OLD chain
+    (or the old base alone) — always a complete, loadable checkpoint.
+    Returns bytes written."""
     entries = {
         "table": state.table,
         "table_accum": state.table_opt.accum,
@@ -247,6 +258,8 @@ def _save_npz(
             (save_id or uuid.uuid4().hex).encode(), np.uint8
         ),
     }
+    if cursor is not None:
+        entries["input_cursor"] = _cursor_entry(cursor)
     dense_leaves, _dense_def = jax.tree.flatten(state.dense)
     acc_leaves, _ = jax.tree.flatten(state.dense_opt.accum)
     for i, (p, a) in enumerate(zip(dense_leaves, acc_leaves)):
@@ -339,8 +352,11 @@ def save_delta(
     save_id: str | None = None,
     chunk_bytes: int = DEFAULT_CHUNK_BYTES,
     timings: dict | None = None,
+    cursor: dict | None = None,
 ) -> tuple[str, str, int]:
-    """Atomically write delta file ``seq`` for base ``path``.  Returns
+    """Atomically write delta file ``seq`` for base ``path``.  Carries
+    the optional input ``cursor`` so the CHAIN HEAD always names the
+    exact input position of the state it restores to.  Returns
     (delta_path, save_id, bytes_written)."""
     sid = save_id or uuid.uuid4().hex
     entries = {
@@ -351,6 +367,8 @@ def save_delta(
         "parent_sig": np.frombuffer(parent_sig.encode(), np.uint8),
         "save_id": np.frombuffer(sid.encode(), np.uint8),
     }
+    if cursor is not None:
+        entries["input_cursor"] = _cursor_entry(cursor)
     for i, (p, a) in enumerate(zip(dense_leaves, dense_accum_leaves)):
         entries[f"dense_{i}"] = p
         entries[f"dense_accum_{i}"] = a
@@ -576,24 +594,81 @@ def save_checkpoint(
     chunk_bytes: int = DEFAULT_CHUNK_BYTES,
     save_id: str | None = None,
     timings: dict | None = None,
+    cursor: dict | None = None,
 ) -> int | None:
     """Write ``state`` to ``path``; returns payload bytes for npz saves.
 
     format: 'npz' | 'orbax' | 'auto' (auto = orbax when the path looks like
     a directory target — trailing slash or '.orbax' suffix — else npz).
     npz saves stream arrays to disk in ``chunk_bytes`` host slices, embed
-    ``save_id`` (the delta chain's content anchor), and reset any existing
-    delta chain.
+    ``save_id`` (the delta chain's content anchor) and the optional input
+    ``cursor`` (exact-position resume — training.py), and reset any
+    existing delta chain.  Orbax saves carry the cursor in a tiny JSON
+    sidecar next to the directory (orbax owns the directory's contents).
     """
     if format == "auto":
         format = "orbax" if path.endswith((".orbax", "/")) or os.path.isdir(path) else "npz"
     if format == "orbax":
         _save_orbax(path.rstrip("/"), state)
+        _write_cursor_sidecar(path.rstrip("/"), cursor)
         return None
     elif format == "npz":
-        return _save_npz(path, state, chunk_bytes=chunk_bytes, save_id=save_id, timings=timings)
+        return _save_npz(
+            path, state, chunk_bytes=chunk_bytes, save_id=save_id,
+            timings=timings, cursor=cursor,
+        )
     else:
         raise ValueError(f"unknown checkpoint format {format!r}")
+
+
+_CURSOR_SIDECAR = "INPUT_CURSOR"
+
+
+def _write_cursor_sidecar(path: str, cursor: dict | None) -> None:
+    """Cursor sidecar for orbax directories (process 0 only — the same
+    single-writer rule as the step sidecar).  A save WITHOUT a cursor
+    removes any stale sidecar: a cursor must never outlive the state it
+    described."""
+    if jax.process_index() != 0:
+        return
+    sidecar = path + "." + _CURSOR_SIDECAR
+    if cursor is None:
+        try:
+            os.remove(sidecar)
+        except OSError:
+            pass
+        return
+    tmp = sidecar + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(cursor, f, sort_keys=True)
+    os.replace(tmp, sidecar)
+
+
+def read_input_cursor(path: str) -> dict | None:
+    """The input-position cursor of ``path``'s CHAIN HEAD (the newest
+    delta when incremental files extend the base, else the base itself;
+    the sidecar for orbax directories).  None when absent or unreadable —
+    pre-cursor checkpoints restore with the legacy start-of-data
+    behavior, never an error (forward compatibility)."""
+    path = path.rstrip("/")
+    if os.path.isdir(path):
+        try:
+            with open(path + "." + _CURSOR_SIDECAR) as f:
+                out = json.load(f)
+            return out if isinstance(out, dict) else None
+        except (OSError, ValueError):
+            return None
+    if not os.path.isfile(path):
+        return None
+    deltas = delta_paths(path)
+    head = deltas[-1] if deltas else path
+    try:
+        with _open_npz(head) as z:
+            raw = _npz_string(z, "input_cursor")
+        out = json.loads(raw) if raw else None
+        return out if isinstance(out, dict) else None
+    except (ValueError, OSError, json.JSONDecodeError):
+        return None
 
 
 def _npz_member_meta(path: str, name: str):

@@ -151,6 +151,7 @@ class AsyncCheckpointer:
         row_dim: int = 0,
         mark_fn=None,
         start_step: int = 0,
+        cursor_fn=None,
     ):
         self._path = path
         self._fmt = fmt
@@ -164,6 +165,11 @@ class AsyncCheckpointer:
         self._lock = threading.Lock()
         self._thread: threading.Thread | None = None
         self._last_boundary_step = int(start_step)
+        # Exact-position resume: ``cursor_fn()`` (supplied by the driver)
+        # names the input position matching the state at a boundary; the
+        # dict is captured ON THE LOOP SIDE at each boundary — the writer
+        # thread must never read the (moving) live cursor.
+        self._cursor_fn = cursor_fn
         self._bitmap = None
         self._mark = None
         self._gather = None
@@ -231,6 +237,14 @@ class AsyncCheckpointer:
 
         return jnp.zeros((self._vocab,), bool)
 
+    def _cursor(self) -> dict | None:
+        if self._cursor_fn is None:
+            return None
+        try:
+            return self._cursor_fn()
+        except Exception:
+            return None  # a cursor bug must never cost the checkpoint
+
     # -- boundaries -------------------------------------------------------
 
     def save_boundary(self, state, saveable, step: int, *, sync: bool = False, emit: bool = True):
@@ -242,6 +256,7 @@ class AsyncCheckpointer:
             # A full save supersedes the accumulated window either way.
             self._bitmap = self._fresh_bitmap() if self._bitmap is not None else None
             self._last_boundary_step = int(step)
+        cursor = self._cursor()
         if sync or not self._async:
             sid = uuid.uuid4().hex
             timings: dict = {}
@@ -251,6 +266,7 @@ class AsyncCheckpointer:
                 nbytes = save_checkpoint(
                     self._path, logical, self._fmt,
                     chunk_bytes=self._chunk, save_id=sid, timings=timings,
+                    cursor=cursor,
                 )
             except Exception:
                 self.write_failures += 1
@@ -272,7 +288,7 @@ class AsyncCheckpointer:
         sid = uuid.uuid4().hex
         stall_ms = (time.perf_counter() - t0) * 1e3
         self._spawn(
-            self._write_full, (snap, saveable, int(step), sid, stall_ms, emit)
+            self._write_full, (snap, saveable, int(step), sid, stall_ms, emit, cursor)
         )
 
     def delta_boundary(self, state, saveable, step: int):
@@ -309,10 +325,12 @@ class AsyncCheckpointer:
         dacc = [_device_copy(x) for x in jax.tree.leaves(state.dense_opt.accum)]
         step_arr = _device_copy(state.step)
         seq, parent = self._next_seq, self._parent_sig
+        cursor = self._cursor()
         stall_ms = (time.perf_counter() - t0) * 1e3
         self._spawn(
             self._write_delta,
-            (seq, parent, idx, n, trows, arows, dense, dacc, step_arr, int(step), stall_ms),
+            (seq, parent, idx, n, trows, arows, dense, dacc, step_arr, int(step),
+             stall_ms, cursor),
         )
 
     # -- writer thread ----------------------------------------------------
@@ -346,7 +364,7 @@ class AsyncCheckpointer:
         save so an older async publish can never clobber a newer one."""
         self._drain()
 
-    def _write_full(self, snap, saveable, step, sid, stall_ms, emit) -> None:
+    def _write_full(self, snap, saveable, step, sid, stall_ms, emit, cursor=None) -> None:
         import jax
 
         try:
@@ -360,6 +378,7 @@ class AsyncCheckpointer:
             nbytes = save_checkpoint(
                 self._path, snap, "npz",
                 chunk_bytes=self._chunk, save_id=sid, timings=timings,
+                cursor=cursor,
             )
             self._on_full_published(sid)
             self.full_saves += 1
@@ -379,7 +398,8 @@ class AsyncCheckpointer:
                 pass
 
     def _write_delta(
-        self, seq, parent, idx, n, trows, arows, dense, dacc, step_arr, step, stall_ms
+        self, seq, parent, idx, n, trows, arows, dense, dacc, step_arr, step,
+        stall_ms, cursor=None,
     ) -> None:
         import jax
 
@@ -395,13 +415,20 @@ class AsyncCheckpointer:
             step_h = np.asarray(step_arr)
             d2h_ms = (time.perf_counter() - t1) * 1e3
             timings: dict = {}
-            _, sid, nbytes = save_delta(
+            out_path, sid, nbytes = save_delta(
                 self._path, seq,
                 idx=idx, table_rows=trows_h, accum_rows=arows_h,
                 dense_leaves=dense_h, dense_accum_leaves=dacc_h,
                 step=step_h, parent_sig=parent,
-                chunk_bytes=self._chunk, timings=timings,
+                chunk_bytes=self._chunk, timings=timings, cursor=cursor,
             )
+            # Chaos injection point: a planned torn_delta fault truncates
+            # the file just published — simulating the torn write a crash
+            # (or dying disk) leaves on a non-atomic filesystem, so the
+            # repair/restart path is testable deterministically.
+            from fast_tffm_tpu.resilience import maybe_torn_delta
+
+            maybe_torn_delta(out_path)
             with self._lock:
                 self._parent_sig = sid
                 self._next_seq = seq + 1

@@ -40,6 +40,40 @@ def main(argv: list[str] | None = None) -> int:
         help="telemetry run id stamped on every record; overrides "
         "[Telemetry] run_id (default: auto-generated per run)",
     )
+    ap.add_argument(
+        "--supervised",
+        action="store_true",
+        help="train/dist_train only: run the trainer as a SUPERVISED child "
+        "process — a crash relaunches it with bounded retries and "
+        "exponential backoff ([Resilience] restart_* keys), resuming from "
+        "the latest full+delta checkpoint chain; kind=fault/restart "
+        "telemetry (incl. MTTR) goes to metrics_path",
+    )
+    ap.add_argument(
+        "--max-restarts",
+        type=int,
+        default=None,
+        metavar="N",
+        help="override [Resilience] restart_max for --supervised",
+    )
+    ap.add_argument(
+        "--fault-plan",
+        default=None,
+        metavar="SPEC",
+        help="arm a deterministic fault plan (chaos testing): "
+        "'kill@120,io_error@45,nan@200:210,torn_delta@1' or "
+        "'random:kill=2,io_error=3' drawn from --fault-seed; under "
+        "--supervised the plan applies to the FIRST launch only (restarts "
+        "run clean)",
+    )
+    ap.add_argument(
+        "--fault-seed", type=int, default=0, metavar="N",
+        help="seed for random: fault plans (same seed = same schedule)",
+    )
+    ap.add_argument(
+        "--fault-horizon", type=int, default=1000, metavar="STEPS",
+        help="step horizon random: fault plans draw positions from",
+    )
     args = ap.parse_args(argv)
 
     from fast_tffm_tpu.utils.platform import apply_platform_env
@@ -66,14 +100,90 @@ def main(argv: list[str] | None = None) -> int:
             file=sys.stderr,
         )
 
+    if args.supervised:
+        if args.mode not in ("train", "dist_train"):
+            ap.error("--supervised applies to train / dist_train only")
+        # The supervisor process stays device-free: it re-execs THIS CLI
+        # as a child (without --supervised), watches it, and relaunches
+        # on crash with --resume so the child restores the latest
+        # full+delta chain at the exact saved input position.
+        import os
+
+        from fast_tffm_tpu.resilience import Supervisor
+
+        # ONE run id for the whole supervised run: the supervisor's
+        # fault/restart records and every child's train/ckpt/input
+        # records must share it, or tools/report.py (which summarizes
+        # one run_id per file) would drop the crash history and the
+        # Resilience section from a supervised run's report.
+        if not cfg.telemetry_run_id:
+            from fast_tffm_tpu.telemetry import new_run_id
+
+            cfg.telemetry_run_id = new_run_id()
+        base = [sys.executable, "-m", "fast_tffm_tpu.cli", args.mode, args.config]
+        if args.metrics_path is not None:
+            base += ["--metrics-path", args.metrics_path]
+        base += ["--run-id", cfg.telemetry_run_id]
+        # The child resolves the package the same way THIS process did —
+        # works for pip installs and straight-from-checkout runs alike.
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        child_env = dict(os.environ)
+        child_env["PYTHONPATH"] = (
+            pkg_root + os.pathsep + child_env["PYTHONPATH"]
+            if child_env.get("PYTHONPATH")
+            else pkg_root
+        )
+
+        def build_cmd(attempt: int, resume_flag: bool) -> list[str]:
+            cmd = list(base)
+            if resume_flag:
+                cmd += ["--resume"]
+            if args.fault_plan and attempt == 0:
+                # Chaos plans arm the FIRST launch only: a kill fault that
+                # re-armed on every relaunch would crash-loop forever.
+                cmd += [
+                    "--fault-plan", args.fault_plan,
+                    "--fault-seed", str(args.fault_seed),
+                    "--fault-horizon", str(args.fault_horizon),
+                ]
+            return cmd
+
+        sup = Supervisor(
+            build_cmd,
+            model_file=cfg.model_file,
+            max_restarts=(
+                args.max_restarts if args.max_restarts is not None else cfg.restart_max
+            ),
+            backoff_s=cfg.restart_backoff_s,
+            backoff_max_s=cfg.restart_backoff_max_s,
+            metrics_path=cfg.metrics_path or None,
+            run_id=cfg.telemetry_run_id,
+            log=lambda *a: print(*a, file=sys.stderr),
+            child_log=print,
+            env=child_env,
+        )
+        return sup.run(resume=args.resume)
+
+    step_hook = None
+    if args.fault_plan:
+        from fast_tffm_tpu.resilience import FaultPlan, install_faults
+
+        inj = install_faults(
+            FaultPlan.parse(
+                args.fault_plan, seed=args.fault_seed, horizon=args.fault_horizon
+            )
+        )
+        print(f"fault plan armed: {inj.plan.to_json()}", file=sys.stderr)
+        step_hook = inj.step_hook
+
     if args.mode == "train":
         from fast_tffm_tpu.training import train
 
-        train(cfg, resume=args.resume)
+        train(cfg, resume=args.resume, step_hook=step_hook)
     elif args.mode == "dist_train":
         from fast_tffm_tpu.training import dist_train
 
-        dist_train(cfg, resume=args.resume)
+        dist_train(cfg, resume=args.resume, step_hook=step_hook)
     elif args.mode == "predict":
         from fast_tffm_tpu.prediction import predict
 

@@ -133,6 +133,21 @@ class Config:
     #   and the collector swaps them in between flushes (0 = no watcher)
     serve_metrics_every_s: float = 10.0  # serving-metrics JSONL cadence
     #   (written to metrics_path, tagged kind=serving; 0 = final record only)
+    serve_reload_max_retries: int = 8  # consecutive reload failures on ONE
+    #   checkpoint signature before the watcher gives up on it (counted as
+    #   reload_giveups + a kind=anomaly record; retries back off
+    #   exponentially from reload_interval_s; a NEW write resets)
+    # [Resilience] — crash recovery + fault handling (resilience.py)
+    on_nan: str = "abort"  # non-finite loss policy: abort (raise before the
+    #   next save overwrites good state — the historical behavior) |
+    #   rollback (restore the last checkpoint, SKIP the diverged window's
+    #   input via the saved cursor, continue; local train only)
+    max_rollbacks: int = 2  # rollback budget per run; exhausted -> abort
+    io_retries: int = 3  # FMB reader: transient-OSError retries per read op
+    io_retry_backoff_s: float = 0.05  # first retry backoff (doubles per try)
+    restart_max: int = 5  # supervisor (train --supervised): bounded restarts
+    restart_backoff_s: float = 1.0  # supervisor backoff base (doubles)
+    restart_backoff_max_s: float = 30.0  # supervisor backoff cap
     # [Distributed]
     data_parallel: int = 0  # 0 = all devices / row_parallel
     row_parallel: int = 0  # 0 = vocabulary_block_num
@@ -269,6 +284,27 @@ class Config:
         if self.serve_reload_interval_s < 0 or self.serve_metrics_every_s < 0:
             raise ValueError(
                 "serve_reload_interval_s and serve_metrics_every_s must be >= 0"
+            )
+        if self.serve_reload_max_retries < 1:
+            raise ValueError(
+                f"serve_reload_max_retries must be >= 1, got "
+                f"{self.serve_reload_max_retries}"
+            )
+        if self.on_nan not in ("abort", "rollback"):
+            raise ValueError(f"unknown on_nan {self.on_nan!r} (abort | rollback)")
+        if self.max_rollbacks < 0:
+            raise ValueError(f"max_rollbacks must be >= 0, got {self.max_rollbacks}")
+        if self.io_retries < 0:
+            raise ValueError(f"io_retries must be >= 0, got {self.io_retries}")
+        if self.io_retry_backoff_s < 0:
+            raise ValueError(
+                f"io_retry_backoff_s must be >= 0, got {self.io_retry_backoff_s}"
+            )
+        if self.restart_max < 0:
+            raise ValueError(f"restart_max must be >= 0, got {self.restart_max}")
+        if self.restart_backoff_s < 0 or self.restart_backoff_max_s < 0:
+            raise ValueError(
+                "restart_backoff_s and restart_backoff_max_s must be >= 0"
             )
         if self.telemetry_mem_every_s < 0 or self.telemetry_stall_timeout_s < 0:
             raise ValueError(
@@ -446,6 +482,22 @@ def load_config(path: str) -> Config:
     )
     cfg.serve_metrics_every_s = get(
         s, "metrics_every_s", float, cfg.serve_metrics_every_s
+    )
+    cfg.serve_reload_max_retries = get(
+        s, "reload_max_retries", int, cfg.serve_reload_max_retries
+    )
+
+    r = "Resilience"
+    cfg.on_nan = get(r, "on_nan", str, cfg.on_nan).lower()
+    cfg.max_rollbacks = get(r, "max_rollbacks", int, cfg.max_rollbacks)
+    cfg.io_retries = get(r, "io_retries", int, cfg.io_retries)
+    cfg.io_retry_backoff_s = get(
+        r, "io_retry_backoff_s", float, cfg.io_retry_backoff_s
+    )
+    cfg.restart_max = get(r, "restart_max", int, cfg.restart_max)
+    cfg.restart_backoff_s = get(r, "restart_backoff_s", float, cfg.restart_backoff_s)
+    cfg.restart_backoff_max_s = get(
+        r, "restart_backoff_max_s", float, cfg.restart_backoff_max_s
     )
 
     d = "Distributed"

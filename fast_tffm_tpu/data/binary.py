@@ -36,11 +36,13 @@ import dataclasses
 import os
 import socket
 import struct
+import time
 import uuid
 from collections.abc import Iterator, Sequence
 
 import numpy as np
 
+from fast_tffm_tpu import resilience
 from fast_tffm_tpu.data.libsvm import ParsedBatch
 
 __all__ = [
@@ -375,6 +377,34 @@ def fmb_stats(path, chunk: int = 1 << 16) -> dict:
     }
 
 
+def _io_retry(fn, *, what: str, attempts: int = 3, backoff_s: float = 0.05):
+    """Run ``fn`` retrying transient OSErrors with exponential backoff.
+
+    The FMB read path sits on memmapped (possibly network) files; a
+    transient hiccup mid-epoch used to kill the whole run even though
+    the read is idempotent (the copies overwrite the same destination
+    slice, so a retry can never lose or duplicate rows).  Each absorbed
+    retry is recorded through resilience.note_io_retry so the run's
+    telemetry shows the near-miss; attempts exhausted re-raises the last
+    error.  ``resilience.maybe_io_fault`` inside the try is the
+    deterministic chaos injection point — an injected fault is absorbed
+    exactly like a real one.
+    """
+    delay = max(0.0, float(backoff_s))
+    attempts = max(0, int(attempts))
+    for attempt in range(attempts + 1):
+        try:
+            resilience.maybe_io_fault(what)
+            return fn()
+        except OSError as e:
+            if attempt >= attempts:
+                raise
+            resilience.note_io_retry(what, e, attempt=attempt + 1)
+            if delay:
+                time.sleep(delay)
+                delay *= 2
+
+
 def fold_epoch_seed(shuffle_seed: int, epoch: int) -> int:
     """THE per-epoch seed fold shared by every shuffling surface (the
     streamed driver creates one single-epoch stream per training epoch and
@@ -432,6 +462,9 @@ def fmb_batch_stream(
     drop_remainder: bool = False,
     pad_to_batches: int | None = None,
     shuffle_seed: int | None = None,
+    skip_rows: int = 0,
+    io_retries: int = 3,
+    io_retry_backoff_s: float = 0.05,
 ) -> Iterator[tuple[ParsedBatch, np.ndarray]]:
     """Stream (ParsedBatch, example_weights) from FMB files.
 
@@ -454,6 +487,19 @@ def fmb_batch_stream(
     O(8 bytes × total rows) per process for the permutation — fine into
     the hundreds of millions of rows; beyond that, pre-shuffle at convert
     time instead.
+
+    ``skip_rows`` is the exact-position-resume seek: skip that many rows
+    of THIS SHARD'S selection (in slot order when shuffled) before
+    emitting the first batch — a memmap-cheap mid-epoch reopen, no
+    parsing or copying of the skipped rows.  Must be a whole number of
+    batches (resume cursors count batches); ``pad_to_batches``
+    accounting starts at the skipped count, so a resumed multi-host
+    stream emits exactly the REMAINING steps of the epoch.
+
+    Reads go through retry-with-backoff (``io_retries`` transient-OSError
+    retries per read op, backoff doubling from ``io_retry_backoff_s``):
+    the copies are idempotent (each retry overwrites the same destination
+    slice), so an absorbed retry can never lose or duplicate a batch.
     """
     if weights is not None and len(weights) != len(files):
         raise ValueError(f"weights has {len(weights)} entries for {len(files)} files")
@@ -462,7 +508,21 @@ def fmb_batch_stream(
             "shard_block > 1 requires epochs == 1 (batch-aligned sharding "
             "does not survive epoch boundaries); create one stream per epoch"
         )
-    fs = [open_fmb(p) for p in files]
+    if skip_rows < 0 or skip_rows % batch_size:
+        raise ValueError(
+            f"skip_rows must be a non-negative whole number of batches "
+            f"(batch_size {batch_size}), got {skip_rows}"
+        )
+
+    def _retry(fn, what):
+        return _io_retry(
+            fn, what=what, attempts=io_retries, backoff_s=io_retry_backoff_s
+        )
+
+    fs = [_retry(lambda p=p: open_fmb(p), f"fmb-open:{p}") for p in files]
+    # Per-file retry labels, formatted ONCE: the copy loops below run per
+    # batch segment on the hot streaming path.
+    read_what = [f"fmb-read:{f.path}" for f in fs]
     for f in fs:
         if f.hashed != bool(hash_feature_id):
             raise ValueError(
@@ -506,7 +566,11 @@ def fmb_batch_stream(
 
     labels, ids, vals, flds, nnz, w = alloc()
     filled = 0
-    emitted = 0
+    # Skipped batches COUNT as emitted: the pad_to_batches contract is
+    # "this epoch has exactly N steps", and a resumed stream owes only
+    # the remaining N - skipped of them.
+    emitted = skip_rows // batch_size
+    skip_left = skip_rows
 
     def cycle_buffers():
         """Emit the full batch and start fresh buffers — the one place the
@@ -538,6 +602,13 @@ def fmb_batch_stream(
             mine = ((slot_base + slots) // block) % shard_count == shard_index
             rows = perm[mine]  # source row per owned slot, in slot order
             slot_base += total
+            if skip_left:
+                # Mid-epoch reopen: drop the already-consumed slot prefix
+                # (the permutation is redrawn identically from the seed,
+                # so slot K of a resumed epoch IS slot K of the original).
+                adv = min(skip_left, len(rows))
+                rows = rows[adv:]
+                skip_left -= adv
             pos = 0
             while pos < len(rows):
                 take = min(len(rows) - pos, batch_size - filled)
@@ -550,12 +621,16 @@ def fmb_batch_stream(
                     li = local[m]
                     dst = np.flatnonzero(m) + filled
                     cw = min(f.width, width)  # clamp generous padding off
-                    labels[dst] = f.labels[li]
-                    nnz[dst] = f.nnz[li]
-                    ids[dst, :cw] = f.ids[li, :cw]
-                    vals[dst, :cw] = f.vals[li, :cw]
-                    flds[dst, :cw] = f.fields[li, :cw]
-                    w[dst] = fweights[fi]
+
+                    def copy(f=f, li=li, dst=dst, cw=cw, fi=fi):
+                        labels[dst] = f.labels[li]
+                        nnz[dst] = f.nnz[li]
+                        ids[dst, :cw] = f.ids[li, :cw]
+                        vals[dst, :cw] = f.vals[li, :cw]
+                        flds[dst, :cw] = f.fields[li, :cw]
+                        w[dst] = fweights[fi]
+
+                    _retry(copy, read_what[fi])
                 filled += take
                 pos += take
                 if filled == batch_size:
@@ -577,15 +652,26 @@ def fmb_batch_stream(
             cw = min(f.width, width)  # clamp generous padding off
             for lo, hi in _shard_runs(counter, f.n_rows, shard_index, shard_count, shard_block):
                 while lo < hi:
+                    if skip_left:
+                        # Mid-epoch reopen: advance past already-consumed
+                        # rows of this shard's selection without copying.
+                        adv = min(skip_left, hi - lo)
+                        lo += adv
+                        skip_left -= adv
+                        continue
                     take = min(hi - lo, batch_size - filled)
                     sl = slice(lo, lo + take)
                     out = slice(filled, filled + take)
-                    labels[out] = f.labels[sl]
-                    nnz[out] = f.nnz[sl]
-                    ids[out, :cw] = f.ids[sl, :cw]
-                    vals[out, :cw] = f.vals[sl, :cw]
-                    flds[out, :cw] = f.fields[sl, :cw]
-                    w[out] = fw
+
+                    def copy(f=f, sl=sl, out=out, cw=cw, fw=fw):
+                        labels[out] = f.labels[sl]
+                        nnz[out] = f.nnz[sl]
+                        ids[out, :cw] = f.ids[sl, :cw]
+                        vals[out, :cw] = f.vals[sl, :cw]
+                        flds[out, :cw] = f.fields[sl, :cw]
+                        w[out] = fw
+
+                    _retry(copy, read_what[fi])
                     filled += take
                     lo += take
                     if filled == batch_size:

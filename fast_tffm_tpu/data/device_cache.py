@@ -288,18 +288,28 @@ def make_cached_touched_marker(data: DeviceDataset):
     return mark, mark_shuffled
 
 
-def epoch_index_chunks(batches: int, k: int):
+def epoch_index_chunks(batches: int, k: int, start: int = 0):
     """Pre-placed device index vectors for one scan-fused epoch: [K]-long
     chunks of the batch indices, plus one [batches % K] remainder — the
     per-call "input" of the scanned cached step.  Placed on device ONCE
     (the same vectors serve every epoch), so an epoch is ``ceil(batches/K)``
     dispatches with zero host involvement in between.  At most two distinct
     lengths exist (K and the remainder), so the scanned step compiles at
-    most twice."""
-    return [
-        jax.device_put(np.arange(lo, min(lo + k, batches), dtype=np.int32))
-        for lo in range(0, batches, k)
-    ]
+    most twice.
+
+    ``start`` > 0 is the exact-position-resume seek: chunks stay aligned
+    to the SAME K-grid an uninterrupted epoch uses (so every full chunk
+    re-hits the already-compiled shapes) and the first chunk is clipped
+    to begin at ``start`` — at most one extra compiled length when a
+    resume lands mid-chunk (save boundaries are K-aligned, so normally
+    none)."""
+    lo0 = (max(0, start) // k) * k
+    out = []
+    for lo in range(lo0, batches, k):
+        a, b = max(lo, start), min(lo + k, batches)
+        if a < b:
+            out.append(jax.device_put(np.arange(a, b, dtype=np.int32)))
+    return out
 
 
 def make_cached_scan_train_step(model, learning_rate: float, data: DeviceDataset, body=None):

@@ -119,8 +119,20 @@ def batch_stream(
     parser=None,
     binary_cache: bool = False,
     shuffle_seed: int | None = None,
+    skip_rows: int = 0,
+    io_retries: int = 3,
+    io_retry_backoff_s: float = 0.05,
 ) -> Iterator[tuple[ParsedBatch, np.ndarray]]:
     """Yield (ParsedBatch, example_weights[batch]) with static shapes.
+
+    ``skip_rows`` (a whole number of batches) reopens the stream
+    mid-epoch — the exact-position resume seek.  FMB streams seek at
+    memmap cost (no copying of skipped rows); text streams skip raw
+    lines before parsing (read-speed) or discard whole already-parsed
+    batches on the native path.  ``pad_to_batches`` accounting starts at
+    the skipped count either way, so a resumed stream emits exactly the
+    remaining steps.  ``io_retries``/``io_retry_backoff_s`` bound the
+    FMB reader's transient-IO retry (data/binary.py).
 
     A short final batch is zero-padded up to ``batch_size`` (padded rows get
     weight 0 so the loss ignores them) unless ``drop_remainder``.
@@ -153,6 +165,12 @@ def batch_stream(
             "pad_to_batches requires max_nnz (pad batches must share the "
             "data batches' static feature width)"
         )
+    if skip_rows < 0 or skip_rows % batch_size:
+        raise ValueError(
+            f"skip_rows must be a non-negative whole number of batches "
+            f"(batch_size {batch_size}), got {skip_rows}"
+        )
+    skip_batches = skip_rows // batch_size
 
     if binary_cache:
         files = ensure_fmb_cache(
@@ -189,6 +207,9 @@ def batch_stream(
             drop_remainder=drop_remainder,
             pad_to_batches=pad_to_batches,
             shuffle_seed=shuffle_seed,
+            skip_rows=skip_rows,
+            io_retries=io_retries,
+            io_retry_backoff_s=io_retry_backoff_s,
         )
         return
     if shuffle_seed is not None:
@@ -211,7 +232,11 @@ def batch_stream(
     if isinstance(parser, NativeParser) and max_nnz is not None:
         # Full-native path: file reads, sharding, and parsing all in C++
         # (the Python per-line loop below costs as much as the parse).
-        yield from native_batch_stream(
+        # A resume seek discards whole parsed batches here (parse-speed —
+        # the native stream has no random access); the islice keeps the
+        # pad_to_batches total honest (N emitted underneath, first
+        # skip_batches dropped = N - skip yielded, the remaining steps).
+        gen = native_batch_stream(
             parser,
             files,
             batch_size=batch_size,
@@ -226,6 +251,9 @@ def batch_stream(
             drop_remainder=drop_remainder,
             pad_to_batches=pad_to_batches,
         )
+        yield from (
+            itertools.islice(gen, skip_batches, None) if skip_batches else gen
+        )
         return
 
     parse = parser if parser is not None else parse_lines
@@ -237,7 +265,12 @@ def batch_stream(
         shard_block=shard_block,
         weights=weights,
     )
-    emitted = 0
+    if skip_rows:
+        # Resume seek on the text path: skip raw lines BEFORE parsing
+        # (read-speed, not parse-speed); skipped batches count as emitted
+        # so pad_to_batches still means "this epoch has exactly N steps".
+        stream = itertools.islice(stream, skip_rows, None)
+    emitted = skip_batches
     while True:
         chunk = list(itertools.islice(stream, batch_size))
         if not chunk:

@@ -390,6 +390,7 @@ class InputStats:
 
         self._lock = threading.Lock()
         self._queue = None  # bound by prefetch(); live-depth probe
+        self._producer = None  # bound by prefetch(); liveness probe
         self.last_depth = None  # most recent consumer-pop sample
         self._reset()
 
@@ -398,6 +399,16 @@ class InputStats:
         LIVE occupancy (the stall watchdog asks from another thread,
         exactly when the consumer has stopped sampling)."""
         self._queue = q
+
+    def bind_producer(self, thread) -> None:
+        """prefetch() hands over its producer thread so the stall
+        watchdog can distinguish 'input-starved because the producer is
+        slow' from 'input-starved because the producer is DEAD'."""
+        self._producer = thread
+
+    def producer_alive(self) -> bool | None:
+        t = self._producer
+        return t.is_alive() if t is not None else None
 
     def queue_depth(self) -> int | None:
         q = self._queue

@@ -1,0 +1,653 @@
+"""Fault-tolerant training runtime: supervised restart + fault injection.
+
+The reference's only recovery story was TF Supervisor restart-from-
+checkpoint (SURVEY.md §5), and until this module a crashed trainer here
+was strictly worse: a restart silently re-trained on already-seen data
+(the step counter restored, the input stream restarted from file zero),
+a dead prefetch thread wedged the loop, and NaN divergence could only
+abort.  Three pieces close that:
+
+  * **Supervisor** — relaunches a crashed trainer subprocess with
+    bounded retries and exponential backoff, resuming from the latest
+    full+delta checkpoint chain (quarantining a torn chain TAIL first —
+    ``repair_delta_chain``).  Every crash emits a ``kind=fault`` record
+    and every relaunch a ``kind=restart`` record carrying the measured
+    MTTR (crash → first new training progress in the child's output).
+    The CLI front end is ``train --supervised`` (cli.py) and the probe
+    driver is tools/chaos.py.
+
+  * **FaultPlan / FaultInjector** — a seeded, reproducible fault
+    schedule (``kill@N``, ``io_error@N``, ``nan@A[:B]``,
+    ``torn_delta@K``, or ``random:kill=2,...`` drawn from a seed) whose
+    injection points thread through machinery that already exists: kill
+    faults ride the driver ``step_hook``, IO faults raise inside the FMB
+    reader's retry loop (data/binary.py), NaN faults poison the loss the
+    driver's finite-check reads, torn-delta faults truncate a published
+    delta file (checkpoint_async.py).  Same seed ⇒ byte-identical
+    schedule (``FaultPlan.to_json``), so chaos tests replay exactly.
+    Every fault is ONE-SHOT: it fires at most once per process, so a
+    supervised restart does not re-crash on the same planned fault.
+
+  * **fault event/counter sink** — module-level, so the reader and
+    checkpoint threads can note retries/faults without owning a
+    RunMonitor; the training loop drains them into ``kind=fault``
+    records at log points and the run summary.
+
+This module must import WITHOUT jax (the Supervisor runs in a process
+that never touches a device); everything heavier is imported lazily.
+"""
+
+from __future__ import annotations
+
+import glob as _glob_mod
+import json
+import os
+import re
+import signal
+import statistics
+import subprocess
+import sys
+import threading
+import time
+
+__all__ = [
+    "FAULT_KINDS",
+    "FaultPlan",
+    "FaultInjector",
+    "install_faults",
+    "active_faults",
+    "clear_faults",
+    "maybe_io_fault",
+    "maybe_torn_delta",
+    "note_io_retry",
+    "drain_fault_events",
+    "drain_fault_counters",
+    "NonFiniteLossError",
+    "repair_delta_chain",
+    "Supervisor",
+]
+
+
+class NonFiniteLossError(RuntimeError):
+    """A non-finite training loss, carrying the input-position cursor at
+    detection time so ``on_nan = rollback`` can restore the last
+    checkpoint and SKIP the offending window (resume input at the
+    detection cursor instead of replaying the data that diverged)."""
+
+    def __init__(self, message: str, *, step: int = 0, loss=None, cursor=None):
+        super().__init__(message)
+        self.step = int(step)
+        self.loss = loss
+        self.cursor = cursor
+
+
+# ---------------------------------------------------------------------------
+# fault event / counter sink (module-level: writers live in reader and
+# checkpoint threads that own no RunMonitor)
+# ---------------------------------------------------------------------------
+
+_sink_lock = threading.Lock()
+_EVENTS: list[dict] = []
+_COUNTERS: dict[str, int] = {}
+_MAX_EVENTS = 256  # bounded: a pathological retry storm must not eat RAM
+
+
+def _record(event: dict) -> None:
+    with _sink_lock:
+        _COUNTERS[event["event"]] = _COUNTERS.get(event["event"], 0) + 1
+        if len(_EVENTS) < _MAX_EVENTS:
+            _EVENTS.append(event)
+
+
+def drain_fault_events() -> list[dict]:
+    """Pop all pending fault events (dicts with an ``event`` key and
+    detail fields — never ``step``, which the emitter's envelope owns)."""
+    with _sink_lock:
+        out, _EVENTS[:] = list(_EVENTS), []
+        return out
+
+
+def drain_fault_counters() -> dict[str, int]:
+    """Snapshot-and-clear the per-event counters (run summary fields)."""
+    with _sink_lock:
+        out = dict(_COUNTERS)
+        _COUNTERS.clear()
+        return out
+
+
+def note_io_retry(what: str, exc: Exception, attempt: int = 1) -> None:
+    """A transient IO error was absorbed by retry (data/binary.py's FMB
+    reader) — recorded so the run's telemetry shows the near-miss."""
+    _record(
+        {"event": "io_retry", "what": what, "error": repr(exc), "attempt": attempt}
+    )
+
+
+# ---------------------------------------------------------------------------
+# fault plan / injector
+# ---------------------------------------------------------------------------
+
+FAULT_KINDS = ("kill", "io_error", "nan", "torn_delta")
+
+# Which ordinal each kind's ``@N`` counts (documented here, enforced by
+# the injection points): kill/nan = absolute training step; io_error =
+# Nth FMB read operation; torn_delta = Kth delta-file write.
+
+
+class FaultPlan:
+    """A concrete, ordered fault schedule.  Byte-identical across runs
+    for the same (spec, seed, horizon) — ``to_json`` is the pin."""
+
+    def __init__(self, events: list[dict], *, spec: str = "", seed: int = 0):
+        for e in events:
+            if e.get("kind") not in FAULT_KINDS:
+                raise ValueError(
+                    f"unknown fault kind {e.get('kind')!r} (one of {FAULT_KINDS})"
+                )
+            if int(e.get("at", 0)) < 1:
+                raise ValueError(f"fault position must be >= 1: {e}")
+        self.events = sorted(
+            (
+                {k: int(v) if k in ("at", "until") else v for k, v in e.items()}
+                for e in events
+            ),
+            key=lambda e: (e["at"], e["kind"]),
+        )
+        self.spec = spec
+        self.seed = int(seed)
+
+    @classmethod
+    def parse(cls, spec: str, *, seed: int = 0, horizon: int = 1000) -> "FaultPlan":
+        """Two grammars:
+
+        * explicit — ``"kill@120,io_error@45,nan@200:210,torn_delta@1"``
+          (``nan@A:B`` poisons the first checked step in [A, B));
+        * seeded — ``"random:kill=2,io_error=3,nan=1"`` draws that many
+          positions per kind in [1, horizon) from ``random.Random(seed)``
+          (torn_delta positions draw in [1, max(2, horizon // 50))).
+
+        Same (spec, seed, horizon) ⇒ the same schedule, byte for byte.
+        """
+        import random
+
+        spec = spec.strip()
+        if not spec:
+            raise ValueError("empty fault plan spec")
+        if spec.startswith("random:"):
+            rng = random.Random(int(seed))
+            counts: dict[str, int] = {}
+            for tok in spec[len("random:") :].split(","):
+                tok = tok.strip()
+                if not tok:
+                    continue
+                kind, _, n = tok.partition("=")
+                kind = kind.strip()
+                if kind not in FAULT_KINDS:
+                    raise ValueError(
+                        f"unknown fault kind {kind!r} in {spec!r} (one of {FAULT_KINDS})"
+                    )
+                counts[kind] = int(n or 1)
+            events = []
+            # Fixed kind order: the draw sequence (and thus the schedule)
+            # must not depend on dict/spec ordering.
+            for kind in FAULT_KINDS:
+                for _ in range(counts.get(kind, 0)):
+                    hi = max(2, horizon // 50) if kind == "torn_delta" else max(2, horizon)
+                    events.append({"kind": kind, "at": rng.randrange(1, hi)})
+            return cls(events, spec=spec, seed=seed)
+        events = []
+        for tok in spec.split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            kind, _, pos = tok.partition("@")
+            kind = kind.strip()
+            if kind not in FAULT_KINDS or not pos:
+                raise ValueError(
+                    f"bad fault token {tok!r} (want kind@pos, kind one of {FAULT_KINDS})"
+                )
+            at, _, until = pos.partition(":")
+            e = {"kind": kind, "at": int(at)}
+            if until:
+                if kind != "nan":
+                    raise ValueError(f"only nan faults take a window: {tok!r}")
+                e["until"] = int(until)
+                if e["until"] <= e["at"]:
+                    # An inverted/empty window would parse fine and then
+                    # never fire — a chaos run that silently tested nothing.
+                    raise ValueError(
+                        f"empty nan window {tok!r}: until must be > at"
+                    )
+            events.append(e)
+        return cls(events, spec=spec, seed=seed)
+
+    def to_json(self) -> str:
+        """Canonical serialization — the byte-identity acceptance pin."""
+        return json.dumps(
+            {"seed": self.seed, "spec": self.spec, "events": self.events},
+            sort_keys=True,
+            separators=(",", ":"),
+        )
+
+
+class FaultInjector:
+    """Executes a FaultPlan through the runtime's injection points.
+
+    Thread-safe (the IO faults fire in the prefetch thread, torn-delta
+    faults in the checkpoint writer thread, kill/nan in the loop
+    thread).  Every fault is one-shot.
+    """
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self._lock = threading.Lock()
+        self._kills = sorted(
+            e["at"] for e in plan.events if e["kind"] == "kill"
+        )
+        self._nans = sorted(
+            (e["at"], e.get("until", e["at"] + 1))
+            for e in plan.events
+            if e["kind"] == "nan"
+        )
+        self._io = {e["at"] for e in plan.events if e["kind"] == "io_error"}
+        self._torn = {e["at"] for e in plan.events if e["kind"] == "torn_delta"}
+        self._io_ops = 0
+        self._delta_writes = 0
+
+    # -- step-hook faults (loop thread) -----------------------------------
+
+    def step_hook(self, step: int) -> None:
+        """Driver ``step_hook``: SIGKILL the process at the first hooked
+        step >= each planned kill (hooks fire K-step-aligned under step
+        fusion, so >= not ==)."""
+        fire = False
+        with self._lock:
+            while self._kills and step >= self._kills[0]:
+                self._kills.pop(0)
+                fire = True
+        if fire:
+            # No cleanup, no flush — SIGKILL is the point (the checkpoint
+            # chain's crash-consistency is what the chaos test exercises).
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    def nan_due(self, step: int) -> bool:
+        """True exactly once for the first checked step inside a planned
+        nan window (the driver then poisons that step's loss)."""
+        with self._lock:
+            while self._nans:
+                at, until = self._nans[0]
+                if step >= until:
+                    self._nans.pop(0)  # window missed entirely (K-alignment)
+                    continue
+                if step >= at:
+                    self._nans.pop(0)
+                    _record({"event": "injected_nan", "at": step, "planned_at": at})
+                    return True
+                return False
+        return False
+
+    # -- reader faults (prefetch thread) ----------------------------------
+
+    def on_io_op(self, what: str) -> None:
+        """Called per FMB read operation; raises a synthetic transient
+        OSError on planned ordinals (the reader's retry absorbs it)."""
+        with self._lock:
+            self._io_ops += 1
+            n = self._io_ops
+            due = n in self._io
+            if due:
+                self._io.discard(n)
+        if due:
+            _record({"event": "injected_io_error", "op": n, "what": what})
+            raise OSError(f"injected transient IO fault (op #{n}, {what})")
+
+    # -- checkpoint faults (writer thread) --------------------------------
+
+    def on_delta_write(self, path: str) -> None:
+        """Called after each delta-file publish; truncates the Kth one to
+        simulate a torn write (what a crash mid-copy on a non-atomic
+        filesystem leaves behind)."""
+        with self._lock:
+            self._delta_writes += 1
+            n = self._delta_writes
+            due = n in self._torn
+            if due:
+                self._torn.discard(n)
+        if not due:
+            return
+        try:
+            size = os.path.getsize(path)
+            with open(path, "r+b") as f:
+                f.truncate(max(1, size // 3))
+            _record({"event": "injected_torn_delta", "path": path, "write": n})
+        except OSError:
+            pass
+
+
+_active_lock = threading.Lock()
+_ACTIVE: FaultInjector | None = None
+
+
+def install_faults(plan: FaultPlan) -> FaultInjector:
+    """Arm ``plan`` process-wide; returns the injector (its
+    ``step_hook`` is what the CLI passes to the driver)."""
+    global _ACTIVE
+    inj = FaultInjector(plan)
+    with _active_lock:
+        _ACTIVE = inj
+    return inj
+
+
+def active_faults() -> FaultInjector | None:
+    return _ACTIVE
+
+
+def clear_faults() -> None:
+    global _ACTIVE
+    with _active_lock:
+        _ACTIVE = None
+
+
+def maybe_io_fault(what: str) -> None:
+    """FMB-reader injection point (no-op unless a plan is armed)."""
+    inj = _ACTIVE
+    if inj is not None:
+        inj.on_io_op(what)
+
+
+def maybe_torn_delta(path: str) -> None:
+    """Delta-writer injection point (no-op unless a plan is armed)."""
+    inj = _ACTIVE
+    if inj is not None:
+        inj.on_delta_write(path)
+
+
+# ---------------------------------------------------------------------------
+# delta-chain repair (crash recovery for torn tails)
+# ---------------------------------------------------------------------------
+
+_DELTA_RE = re.compile(r"\.delta-(\d{4})\.npz$")
+
+
+def _delta_files(path: str) -> list[str]:
+    out = []
+    for p in _glob_mod.glob(_glob_mod.escape(path) + ".delta-*.npz"):
+        m = _DELTA_RE.search(p)
+        if m:
+            out.append((int(m.group(1)), p))
+    return [p for _, p in sorted(out)]
+
+
+def _npz_str(z, key) -> str | None:
+    import numpy as np
+
+    if key not in getattr(z, "files", ()):
+        return None
+    return bytes(np.asarray(z[key]).tobytes()).decode()
+
+
+def repair_delta_chain(path: str, log=print) -> list[str]:
+    """Quarantine a torn/unchained delta-chain TAIL so resume can land on
+    the last good link.
+
+    ``restore_checkpoint`` is strict on purpose (a torn delta fails
+    loudly naming the file); the SUPERVISOR calls this before each
+    relaunch because a crash mid-delta-write legitimately leaves a
+    truncated tail file behind on non-atomic filesystems (the npz
+    publish is tmp+rename, but the chaos torn-delta fault — and a dying
+    disk — are exactly what this guards).  Every delta from the first
+    unreadable/unchained link ONWARD is renamed ``*.corrupt`` (later
+    links chain from the bad one, so none of them can apply either);
+    the input cursor stored in the new chain head keeps resumed
+    training consistent — the quarantined windows' data simply
+    re-trains.  Returns the quarantined paths (empty = chain healthy).
+
+    numpy-only on purpose: the Supervisor process never imports jax.
+    """
+    import numpy as np
+
+    if not os.path.isfile(path):
+        return []
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            expect = _npz_str(z, "save_id")
+    except Exception:
+        return []  # base unreadable: nothing a tail repair can fix
+    deltas = _delta_files(path)
+    bad_from, reason = None, ""
+    for i, dp in enumerate(deltas):
+        try:
+            with np.load(dp, allow_pickle=False) as z:
+                for name in z.files:  # full read = CRC/truncation check
+                    np.asarray(z[name])
+                parent = _npz_str(z, "parent_sig")
+                sid = _npz_str(z, "save_id")
+        except Exception as e:
+            bad_from, reason = i, f"unreadable ({type(e).__name__})"
+            break
+        if expect is None or parent != expect:
+            bad_from, reason = i, "chain break (parent_sig mismatch)"
+            break
+        expect = sid
+    if bad_from is None:
+        return []
+    quarantined = []
+    for dp in deltas[bad_from:]:
+        try:
+            os.replace(dp, dp + ".corrupt")
+            quarantined.append(dp + ".corrupt")
+        except OSError:
+            pass
+    log(
+        f"resilience: quarantined {len(quarantined)} delta file(s) from "
+        f"{os.path.basename(deltas[bad_from])!r} on — {reason}; resuming "
+        "from the last good chain link"
+    )
+    _record({"event": "chain_repair", "quarantined": len(quarantined), "reason": reason})
+    return quarantined
+
+
+# ---------------------------------------------------------------------------
+# supervisor
+# ---------------------------------------------------------------------------
+
+# Child-output lines that count as "training made new progress" — the
+# MTTR clock (crash → first new step) stops at the first match AFTER a
+# relaunch.  Step lines are the precise signal (resolution = the child's
+# log_every); the checkpoint/done lines cover runs shorter than one log
+# window.  "resumed from" is deliberately NOT here: restore completing
+# is not yet a new step.
+_STEP_RE = re.compile(r"^step (\d+) ")
+_PROGRESS_MARKERS = ("checkpoint ->", "training done:", "stopped on signal")
+
+
+class Supervisor:
+    """Relaunch a crashed trainer with bounded retries + exponential
+    backoff (the TF-Supervisor capability, process-level).
+
+    ``build_cmd(attempt, resume)`` returns the child argv for launch
+    ``attempt`` (0 = first); ``resume`` is True when a checkpoint exists
+    to continue from (the caller appends ``--resume``).  Telemetry goes
+    to ``metrics_path`` via a RunMonitor with ``source="supervisor"``:
+    one ``kind=fault`` (event=crash) per child death, one
+    ``kind=restart`` per relaunch carrying the backoff used and the
+    measured MTTR; the close summary totals restarts and the MTTR
+    median.  Exit code: the child's final rc (0 on eventual success).
+    """
+
+    def __init__(
+        self,
+        build_cmd,
+        *,
+        model_file: str,
+        max_restarts: int = 5,
+        backoff_s: float = 1.0,
+        backoff_max_s: float = 30.0,
+        metrics_path: str | None = None,
+        run_id: str = "",
+        log=print,
+        child_log=None,
+        sleep=time.sleep,
+        repair: bool = True,
+        env: dict | None = None,
+    ):
+        self._build_cmd = build_cmd
+        self._model_file = model_file
+        self._max_restarts = max(0, int(max_restarts))
+        self._backoff_s = float(backoff_s)
+        self._backoff_max_s = float(backoff_max_s)
+        self._metrics_path = metrics_path
+        self._run_id = run_id
+        self._log = log
+        self._child_log = child_log
+        self._sleep = sleep
+        self._repair = repair
+        self._env = env
+        self.restarts = 0
+        self.mttr_s: list[float] = []
+        self.last_rc: int | None = None
+
+    def _tail(self, proc, first_progress_t, last_step, on_progress=None) -> None:
+        try:
+            for line in proc.stdout:
+                line = line.rstrip("\n")
+                m = _STEP_RE.match(line)
+                if m:
+                    last_step[0] = int(m.group(1))
+                if first_progress_t[0] is None and (
+                    m or any(p in line for p in _PROGRESS_MARKERS)
+                ):
+                    first_progress_t[0] = time.monotonic()
+                    if on_progress is not None:
+                        try:
+                            on_progress()
+                        except Exception:
+                            pass  # telemetry must never kill the tail
+                if self._child_log is not None:
+                    try:
+                        self._child_log(line)
+                    except Exception:
+                        pass
+        except Exception:
+            pass  # a closed pipe on kill is expected, not an error
+
+    def run(self, resume: bool = False) -> int:
+        from fast_tffm_tpu.telemetry import RunMonitor
+
+        monitor = RunMonitor(
+            self._metrics_path, run_id=self._run_id, source="supervisor",
+            log=self._log,
+        )
+        attempt = 0
+        crash_t = None
+        prev_rc = None
+        used_backoff = 0.0
+        try:
+            while True:
+                do_resume = resume if attempt == 0 else os.path.exists(self._model_file)
+                cmd = self._build_cmd(attempt, do_resume)
+                self._log(
+                    f"supervisor: launch attempt {attempt}"
+                    f"{' (resume)' if do_resume else ''}: {' '.join(cmd)}"
+                )
+                first_progress_t = [None]
+                last_step = [0]
+                # The kind=restart record (and its MTTR) is emitted the
+                # moment the relaunched child makes new progress — a
+                # recovered trainer may then run for days, and a record
+                # deferred to its exit would leave the crash unmatched in
+                # the metrics stream that whole time.  A child that dies
+                # again before ANY progress gets the record post-mortem
+                # (mttr_s null) from the loop below.
+                restart_lock = threading.Lock()
+                restart_emitted = [False]
+
+                def emit_restart(attempt=attempt, prev_rc=prev_rc,
+                                 backoff=used_backoff, crash_t=crash_t):
+                    with restart_lock:
+                        if restart_emitted[0]:
+                            return
+                        restart_emitted[0] = True
+                    # MTTR: previous crash -> this child's first new
+                    # progress (includes the backoff sleep — that IS
+                    # recovery time the fleet pays).
+                    mttr = None
+                    if first_progress_t[0] is not None and crash_t is not None:
+                        mttr = round(first_progress_t[0] - crash_t, 3)
+                        self.mttr_s.append(mttr)
+                    monitor.emit(
+                        "restart",
+                        step=last_step[0],
+                        attempt=attempt,
+                        exit_code=prev_rc,
+                        backoff_s=round(backoff, 3),
+                        mttr_s=mttr,
+                    )
+
+                proc = subprocess.Popen(
+                    cmd,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT,
+                    text=True,
+                    env=self._env,
+                )
+                reader = threading.Thread(
+                    target=self._tail,
+                    args=(proc, first_progress_t, last_step,
+                          emit_restart if attempt > 0 else None),
+                    name="supervisor-tail",
+                    daemon=True,
+                )
+                reader.start()
+                rc = proc.wait()
+                reader.join(timeout=10.0)
+                self.last_rc = rc
+                if attempt > 0:
+                    emit_restart()  # no-op when first progress already did
+                if rc == 0:
+                    self._log(
+                        f"supervisor: trainer completed cleanly after "
+                        f"{attempt} restart(s)"
+                    )
+                    return 0
+                crash_t = time.monotonic()
+                sig = -rc if rc < 0 else None
+                monitor.emit(
+                    "fault",
+                    step=last_step[0],
+                    event="crash",
+                    exit_code=rc,
+                    signal=sig,
+                    attempt=attempt,
+                )
+                self._log(
+                    f"supervisor: trainer died (rc={rc}"
+                    + (f", signal {sig}" if sig else "")
+                    + f") around step {last_step[0]}"
+                )
+                if attempt >= self._max_restarts:
+                    self._log(
+                        f"supervisor: giving up after {attempt} restart(s) "
+                        f"(restart_max = {self._max_restarts})"
+                    )
+                    return rc
+                if self._repair:
+                    try:
+                        repair_delta_chain(self._model_file, log=self._log)
+                    except Exception as e:
+                        self._log(f"supervisor: chain repair failed: {e!r}")
+                used_backoff = min(
+                    self._backoff_s * (2.0 ** attempt), self._backoff_max_s
+                )
+                if used_backoff > 0:
+                    self._log(f"supervisor: backing off {used_backoff:.1f}s before relaunch")
+                    self._sleep(used_backoff)
+                prev_rc = rc
+                attempt += 1
+                self.restarts = attempt
+        finally:
+            summary: dict = {"supervisor_restarts": self.restarts}
+            if self.mttr_s:
+                summary["mttr_s_median"] = round(statistics.median(self.mttr_s), 3)
+                summary["mttr_s_max"] = round(max(self.mttr_s), 3)
+            monitor.close(**summary)

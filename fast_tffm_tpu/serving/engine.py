@@ -536,6 +536,52 @@ class ServingEngine:
 
         from fast_tffm_tpu.prediction import load_scoring_state
 
+        # Failure discipline for ONE observed signature: retries back off
+        # exponentially from the poll interval, and after
+        # serve_reload_max_retries consecutive failures the watcher GIVES
+        # UP on that signature (reload_giveups counter + kind=anomaly
+        # record) instead of hot-spinning reload_failures forever on a
+        # persistently corrupt file.  Any NEW write (signature change)
+        # resets the state and retries immediately.
+        fail_sig = None
+        fail_count = 0
+        gave_up = False
+        next_retry_t = 0.0
+
+        def note_failure(sig, what, exc):
+            nonlocal fail_sig, fail_count, gave_up, next_retry_t
+            self.metrics.on_reload(ok=False)
+            if sig != fail_sig:
+                fail_sig, fail_count, gave_up = sig, 0, False
+            fail_count += 1
+            backoff = min(
+                max(self._cfg.serve_reload_interval_s, 0.01) * (2.0 ** fail_count),
+                60.0,
+            )
+            next_retry_t = time.monotonic() + backoff
+            self._log(
+                f"serving: {what} of {self._cfg.model_file} failed "
+                f"(attempt {fail_count}/{self._cfg.serve_reload_max_retries}, "
+                f"next retry in {backoff:.2f}s): {exc!r}"
+            )
+            if fail_count >= self._cfg.serve_reload_max_retries:
+                gave_up = True
+                self.metrics.on_reload_giveup()
+                try:
+                    self._monitor.emit_anomaly(
+                        self.step, None, event="reload_giveup",
+                        path=self._cfg.model_file, error=repr(exc),
+                        attempts=fail_count,
+                    )
+                except Exception:
+                    pass  # a full metrics disk must not kill the watcher
+                self._log(
+                    f"serving: giving up on this checkpoint write after "
+                    f"{fail_count} failed reloads — persistently corrupt? "
+                    "serving continues on the loaded state; a NEW write "
+                    "will be retried"
+                )
+
         while not self._stop.wait(self._cfg.serve_reload_interval_s):
             with self._reload_lock:
                 pending = self._staged_state is not None
@@ -546,19 +592,21 @@ class ServingEngine:
             sig = checkpoint_signature(self._cfg.model_file)
             if sig is None or sig == self._loaded_sig:
                 continue
+            if sig == fail_sig:
+                if gave_up or time.monotonic() < next_retry_t:
+                    continue  # backing off / abandoned until a new write
+            else:
+                fail_sig, fail_count, gave_up = None, 0, False
             state = None
             applied = 0
             if not _os.path.isdir(self._cfg.model_file):
                 try:
                     got = self._try_apply_deltas()
                 except Exception as e:
-                    # Torn/mid-write delta: count, keep serving, retry next
-                    # tick (signature not advanced, so a complete write
-                    # still reloads).
-                    self.metrics.on_reload(ok=False)
-                    self._log(
-                        f"serving: delta reload of {self._cfg.model_file} failed: {e!r}"
-                    )
+                    # Torn/mid-write delta: count, keep serving, retry
+                    # with backoff (signature not advanced, so a complete
+                    # write still reloads).
+                    note_failure(sig, "delta reload", e)
                     continue
                 if got == (None, 0):
                     # Signature moved without new chain content (e.g. a
@@ -576,15 +624,15 @@ class ServingEngine:
                     _, state = load_scoring_state(self._cfg, log=lambda *_: None)
                 except Exception as e:
                     # Torn write (non-atomic writer, or a checkpoint
-                    # mid-copy): count it, keep serving, retry next tick.
-                    self.metrics.on_reload(ok=False)
-                    self._log(f"serving: reload of {self._cfg.model_file} failed: {e!r}")
+                    # mid-copy): count it, keep serving, back off.
+                    note_failure(sig, "reload", e)
                     continue
                 self._loaded_save_id = new_sid
                 self._applied_deltas = new_applied
             else:
                 self._applied_deltas += applied
                 self.metrics.on_delta_reload(applied)
+            fail_sig, fail_count, gave_up = None, 0, False
             self._loaded_sig = sig
             with self._reload_lock:
                 self._staged_state = state

@@ -108,6 +108,9 @@ class ServingMetrics:
         self.padded_rows = 0  # bucket-padding rows scored and discarded
         self.reloads = 0  # FULL checkpoint re-reads swapped in
         self.reload_failures = 0  # watcher restore attempts that raised
+        self.reload_giveups = 0  # checkpoint signatures abandoned after
+        #   reload_max_retries consecutive failures (a persistently corrupt
+        #   file; the watcher stops retrying it until a NEW write lands)
         self.delta_reloads = 0  # delta FILES applied in place (a delta
         #   swap does NOT also bump `reloads` — the counters are disjoint)
         self.bucket_rows: dict[int, int] = {}  # bucket size -> real rows
@@ -149,6 +152,10 @@ class ServingMetrics:
             else:
                 self.reload_failures += 1
 
+    def on_reload_giveup(self) -> None:
+        with self._lock:
+            self.reload_giveups += 1
+
     def on_delta_reload(self, n_deltas: int) -> None:
         """The watcher applied ``n_deltas`` incremental checkpoint files in
         place (no full-table re-read) — counted separately from full
@@ -173,6 +180,7 @@ class ServingMetrics:
                 "batch_occupancy": round(self.rows / scored, 4) if scored else None,
                 "reloads": self.reloads,
                 "reload_failures": self.reload_failures,
+                "reload_giveups": self.reload_giveups,
                 "delta_reloads": self.delta_reloads,
                 "bucket_rows": {str(k): v for k, v in sorted(self.bucket_rows.items())},
                 "queue_ms": self.queue.snapshot(),

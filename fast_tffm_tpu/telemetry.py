@@ -101,6 +101,12 @@ SCHEMAS: dict[str, tuple[str, ...]] = {
         "stacks",
     ),
     "anomaly": ("event", "loss"),
+    # Resilience layer (resilience.py): injected/observed faults (crash,
+    # io_retry, injected_*, chain_repair) and supervised relaunches
+    # (attempt ordinal, crashed child's exit code, backoff slept, MTTR =
+    # crash -> first new training progress; null until measurable).
+    "fault": ("event",),
+    "restart": ("attempt", "exit_code", "backoff_s", "mttr_s"),
     "ckpt": (
         "mode",  # full (async) | delta | sync
         "snapshot_ms",
@@ -360,12 +366,19 @@ def compiling_now(stacks: dict[str, str]) -> bool:
     return any(m in blob for m in _COMPILING_MARKERS)
 
 
-def classify_stall(queue_depth: int | None, stacks: dict[str, str]) -> str:
+def classify_stall(
+    queue_depth: int | None, stacks: dict[str, str], producer_alive=None
+) -> str:
     """input-starved: the prefetch queue is empty, so the producer (parse
-    / disk / conversion) is what everyone is waiting on.  device-bound:
-    data is ready (or there is no input queue) and a thread is inside the
-    device runtime — the dispatch/compile/transfer is what's wedged."""
+    / disk / conversion) is what everyone is waiting on — and when the
+    producer THREAD is known dead, the classification says so (a dead
+    producer is a fault to restart from, not a slow parse to wait out).
+    device-bound: data is ready (or there is no input queue) and a thread
+    is inside the device runtime — the dispatch/compile/transfer is
+    what's wedged."""
     if queue_depth == 0:
+        if producer_alive is False:
+            return "input-starved (producer-thread dead)"
         return "input-starved"
     blob = "\n".join(stacks.values())
     if any(m in blob for m in _DEVICE_MARKERS):
@@ -444,6 +457,7 @@ class RunMonitor:
         self.anomalies = 0
         self._stall_timeout = float(stall_timeout_s)
         self._queue_depth_fn = queue_depth_fn
+        self._producer_alive_fn = None
         # Armed by the FIRST heartbeat: the gap before dispatch 1 is
         # dominated by XLA compile (legitimately >> any stall deadline),
         # and startup hangs are arm_hang_exit's department.
@@ -467,6 +481,12 @@ class RunMonitor:
         """Swap the prefetch-depth probe (drivers rebuild streams per
         epoch; the watchdog should read the CURRENT one)."""
         self._queue_depth_fn = fn
+
+    def set_producer_alive_fn(self, fn) -> None:
+        """Swap the prefetch-producer liveness probe (same per-epoch
+        cadence as the depth probe): lets a stall classify as
+        'input-starved (producer-thread dead)' instead of merely depth 0."""
+        self._producer_alive_fn = fn
 
     # -- emission ---------------------------------------------------------
 
@@ -595,7 +615,15 @@ class RunMonitor:
                     depth = self._queue_depth_fn()
                 except Exception:
                     depth = None
-            cls = "compiling" if compiling else classify_stall(depth, stacks)
+            alive = None
+            if self._producer_alive_fn is not None:
+                try:
+                    alive = self._producer_alive_fn()
+                except Exception:
+                    alive = None
+            cls = (
+                "compiling" if compiling else classify_stall(depth, stacks, alive)
+            )
             try:
                 self.emit(
                     "stall",
@@ -604,6 +632,7 @@ class RunMonitor:
                     since_last_step_s=round(since, 3),
                     classification=cls,
                     prefetch_queue_depth=depth,
+                    producer_alive=alive,
                     stacks=stacks,
                 )
             except Exception:

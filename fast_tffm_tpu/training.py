@@ -23,15 +23,21 @@ import time
 import jax
 import numpy as np
 
-from fast_tffm_tpu.checkpoint import restore_checkpoint
+from fast_tffm_tpu.checkpoint import read_input_cursor, restore_checkpoint
 from fast_tffm_tpu.config import Config, build_model
 from fast_tffm_tpu.data.native import best_parser
 from fast_tffm_tpu.data.pipeline import batch_stream
 from fast_tffm_tpu.metrics import StreamingAUC, Throughput
 from fast_tffm_tpu.models.base import Batch
+from fast_tffm_tpu.resilience import (
+    NonFiniteLossError,
+    active_faults,
+    drain_fault_counters,
+    drain_fault_events,
+)
 from fast_tffm_tpu.telemetry import RunMonitor
 from fast_tffm_tpu.trainer import init_state, make_predict_step, make_train_step
-from fast_tffm_tpu.utils.prefetch import prefetch
+from fast_tffm_tpu.utils.prefetch import PrefetchError, prefetch
 from fast_tffm_tpu.utils.tracing import WindowTracer, step_trace
 
 __all__ = ["train", "dist_train", "scan_max_nnz"]
@@ -50,12 +56,19 @@ def scan_max_nnz(cfg: Config) -> int:
     return max(1, widest)
 
 
-def _check_finite(loss: float, cfg: Config, monitor=None, step=0, state=None) -> None:
+def _check_finite(
+    loss: float, cfg: Config, monitor=None, step=0, state=None, cursor=None
+) -> None:
     """Abort on a non-finite loss instead of training on (and eventually
     checkpointing) poisoned state.  With a ``monitor``, the divergence
     lands in the telemetry stream as a structured ``kind=anomaly`` record
     (step, loss, first non-finite tensor path) BEFORE the raise, so
-    tools/report.py can flag the run without log-grepping."""
+    tools/report.py can flag the run without log-grepping.
+
+    Raises ``NonFiniteLossError`` carrying the input ``cursor`` at
+    detection time: under ``on_nan = rollback`` the driver restores the
+    last checkpoint and resumes input AT this cursor — skipping the
+    window whose data diverged instead of replaying it."""
     if not np.isfinite(loss):
         if monitor is not None:
             monitor.emit_anomaly(step, loss, state=state)
@@ -68,9 +81,12 @@ def _check_finite(loss: float, cfg: Config, monitor=None, step=0, state=None) ->
             if cfg.lookup == "alltoall" and cfg.lookup_overflow == "abort"
             else "a diverged model — lower learning_rate"
         )
-        raise RuntimeError(
+        raise NonFiniteLossError(
             f"training loss is {loss}; likely {hint}.  Aborting before the "
-            "next checkpoint overwrites the last good state."
+            "next checkpoint overwrites the last good state.",
+            step=int(step),
+            loss=float(loss),
+            cursor=cursor,
         )
 
 
@@ -118,9 +134,16 @@ def _stream(
     to_batch=None,
     shuffle_epoch=None,
     steps_per_call=1,
+    skip_batches=0,
     **shard_kw,
 ):
     """Prefetched input stream yielding ``(batch_or_None, parsed, w)``.
+
+    ``skip_batches`` reopens the stream mid-epoch at that batch offset
+    (the exact-position resume seek — cursors count batches, and the
+    underlying streams seek in rows); with ``steps_per_call`` > 1 the
+    skip is applied BEFORE grouping, so a K-aligned resume reproduces
+    the uninterrupted run's superbatch boundaries exactly.
 
     With FMB-backed input and a ``to_batch``, the host→device conversion
     runs INSIDE the prefetch thread, overlapping the transfer with the
@@ -200,9 +223,10 @@ def _stream(
             stacklevel=2,
         )
         shuffle_seed = None
+    bs = batch_size if batch_size is not None else cfg.batch_size
     raw = batch_stream(
         files,
-        batch_size=batch_size if batch_size is not None else cfg.batch_size,
+        batch_size=bs,
         vocabulary_size=cfg.vocabulary_size,
         hash_feature_id=cfg.hash_feature_id,
         max_nnz=max_nnz,
@@ -210,6 +234,9 @@ def _stream(
         weights=weights,
         parser=parser,
         shuffle_seed=shuffle_seed,
+        skip_rows=skip_batches * bs,
+        io_retries=cfg.io_retries,
+        io_retry_backoff_s=cfg.io_retry_backoff_s,
         **shard_kw,
     )
     if steps_per_call > 1:
@@ -291,6 +318,78 @@ def _evaluate(
     return meter.value()
 
 
+def _files_fingerprint(files) -> str:
+    """Input-dataset identity for the resume cursor: the train file list
+    plus each file's size.  A cursor's batch offset only means something
+    against the exact data it was saved over — if the files changed (the
+    online-append scenario: rows landing between crash and resume shift
+    every later row, and a shuffled epoch's permutation is drawn over
+    the TOTAL row count), resuming at the old offset would silently
+    misalign data and weights.  Size is the cheap stat-only proxy:
+    append/truncate/replace all move it; a byte-for-byte same-size edit
+    does not, but that is not a failure mode a crash produces."""
+    import hashlib
+
+    h = hashlib.md5()
+    for p in files:
+        try:
+            size = os.path.getsize(p)
+        except OSError:
+            size = -1
+        h.update(f"{p}:{size}\n".encode())
+    return h.hexdigest()[:16]
+
+
+def _resolve_cursor(cfg: Config, cursor, log) -> tuple[int, int]:
+    """(start_epoch, start_batch) from a restored input cursor.
+
+    The cursor must describe THIS run's input identity (batch size,
+    shuffle settings, train-file fingerprint) — anything else falls
+    back, with a warning, to the legacy start-of-data behavior rather
+    than resuming at a position that means something different now.  A
+    cursor at or past ``epoch_num`` is a COMPLETED run: resume then
+    keeps its historical meaning of "train epoch_num more epochs"
+    (test-pinned), so it also starts at (0, 0)."""
+    if not cursor:
+        return 0, 0
+    exact = bool(cursor.pop("_exact", False))
+    if int(cursor.get("version", 0)) > 1:
+        log(
+            "warning: checkpoint input cursor has a newer version "
+            f"({cursor.get('version')}) than this build understands — "
+            "resuming at the start of the data (legacy behavior)"
+        )
+        return 0, 0
+    mismatched = [
+        f"{key} {cursor.get(key)!r} != {want!r}"
+        for key, want in (
+            ("batch_size", int(cfg.batch_size)),
+            ("shuffle", bool(cfg.shuffle)),
+            ("shuffle_seed", int(cfg.shuffle_seed) if cfg.shuffle else cursor.get("shuffle_seed")),
+            ("files", _files_fingerprint(cfg.train_files)),
+        )
+        if cursor.get(key) != want
+    ]
+    if mismatched:
+        log(
+            "warning: checkpoint input cursor does not match this config "
+            f"({'; '.join(mismatched)}) — resuming at the start of the "
+            "data (legacy behavior)"
+        )
+        return 0, 0
+    e = max(0, int(cursor.get("epoch", 0)))
+    b = max(0, int(cursor.get("batch_in_epoch", 0)))
+    if e >= cfg.epoch_num:
+        if exact:
+            # A rollback cursor is a literal position, never "train more
+            # epochs": at/past the end it means "no input left" (the run
+            # finishes with the final save alone).
+            return cfg.epoch_num, 0
+        return 0, 0
+    log(f"resuming input at epoch {e}, batch {b} (exact-position cursor)")
+    return e, b
+
+
 def _run_training(
     cfg: Config,
     state,
@@ -307,6 +406,8 @@ def _run_training(
     step_hook=None,
     row_dim=0,
     mark_touched=None,
+    start_cursor=None,
+    rollback=None,
 ):
     """Shared step loop.  ``train_stream(epoch)`` overrides the per-epoch
     input stream, ``to_batch(parsed, w)`` the host→device batch assembly,
@@ -337,13 +438,25 @@ def _run_training(
     ``row_dim`` (the model's logical row width) and ``mark_touched`` (an
     optional custom touched-row bitmap marker — the device-cache drivers
     mark from their resident id arrays) parameterize the async/delta
-    checkpoint subsystem (checkpoint_async.AsyncCheckpointer)."""
+    checkpoint subsystem (checkpoint_async.AsyncCheckpointer).
+
+    ``start_cursor`` (a dict from checkpoint.read_input_cursor) resumes
+    the INPUT at the exact saved position: the epoch loop starts at the
+    cursor's epoch and the first stream opens at its batch offset, so a
+    resumed run consumes precisely the batches an uninterrupted run
+    would have — its loss sequence matches (bit-identically when the
+    XLA program is the same).  Every save boundary embeds the live
+    cursor back into the checkpoint.  ``train_stream(epoch,
+    skip_batches)`` must honor the skip.  ``rollback`` (a note dict from
+    the on_nan=rollback driver loop) is recorded as a kind=anomaly
+    event=rollback at run start."""
     if saveable is None:
         saveable = lambda st: st
     if train_stream is None:
-        train_stream = lambda epoch: _stream(
+        train_stream = lambda epoch, skip_batches=0: _stream(
             cfg, cfg.train_files, max_nnz, epochs=1, to_batch=to_batch,
             shuffle_epoch=epoch, steps_per_call=cfg.steps_per_call,
+            skip_batches=skip_batches,
         )
     if to_batch is None:
         to_batch = Batch.from_parsed
@@ -391,6 +504,40 @@ def _run_training(
         mem_every_s=cfg.telemetry_mem_every_s,
         log=log,
     )
+    if rollback is not None:
+        # The failed attempt's monitor already recorded the non-finite
+        # loss; THIS record documents the recovery decision (restored
+        # step, skipped-to position, rollback ordinal) in the new run.
+        monitor.emit_anomaly(
+            int(rollback.get("step", 0)), rollback.get("loss"),
+            event="rollback", **{k: v for k, v in rollback.items()
+                                 if k not in ("step", "loss")},
+        )
+    # Deterministic fault injection (resilience.py): a CLI-armed plan
+    # kills via the step_hook the driver already passed; nan faults
+    # poison the loss below; io/torn faults fire inside the reader and
+    # checkpoint writer.  ``faults`` is None on every normal run.
+    faults = active_faults()
+    # Exact-position input cursor: tracked per dispatch, embedded in
+    # every checkpoint (full, delta, final) so a crash-resume reopens
+    # the input mid-epoch at the precise saved batch.
+    start_epoch, start_batch = _resolve_cursor(cfg, start_cursor, log)
+    cur = {"epoch": start_epoch, "batch": start_batch}
+    # Dataset identity, stamped once: cursors saved by this run describe
+    # THIS file set; a resume against changed files must not trust them.
+    files_fp = _files_fingerprint(cfg.train_files)
+
+    def input_cursor() -> dict:
+        return {
+            "version": 1,
+            "epoch": int(cur["epoch"]),
+            "batch_in_epoch": int(cur["batch"]),
+            "batch_size": int(cfg.batch_size),
+            "shuffle": bool(cfg.shuffle),
+            "shuffle_seed": int(cfg.shuffle_seed),
+            "steps_per_call": int(cfg.steps_per_call),
+            "files": files_fp,
+        }
     # Save boundaries (full + delta) go through ONE owner: async full saves
     # snapshot on device and hand the convert/D2H/write to a writer thread
     # (at most one in flight, back-pressure counted); delta saves ship only
@@ -422,6 +569,7 @@ def _run_training(
         row_dim=row_dim,
         mark_fn=mark_touched,
         start_step=start_step,
+        cursor_fn=input_cursor,
     )
     # Preemption-safe shutdown (the reference's only recovery story was
     # Supervisor restart-from-checkpoint; cloud TPU maintenance sends
@@ -438,18 +586,25 @@ def _run_training(
         for sig in (signal.SIGTERM, signal.SIGINT):
             restore_handlers[sig] = signal.signal(sig, _on_signal)
     try:
-        for epoch in range(cfg.epoch_num):
+        for epoch in range(start_epoch, cfg.epoch_num):
             if stop_requested.is_set():
                 break
-            epoch_stream = train_stream(epoch)
+            # A resumed first epoch reopens mid-stream at the cursor's
+            # batch offset; every later epoch starts at 0 as usual.
+            epoch_stream = train_stream(
+                epoch, cur["batch"] if epoch == start_epoch else 0
+            )
             # Streamed inputs carry per-stream InputStats (wire bytes,
             # parse/H2D ms, prefetch depth — data/wire.py); drained into
             # kind=input records at every log point.  Device-cached
             # streams are bare generators (no stats — no per-step wire).
             input_stats = getattr(epoch_stream, "stats", None)
             # Each epoch's stream owns a fresh prefetch queue; point the
-            # stall watchdog's depth probe at the current one.
+            # stall watchdog's depth + producer-liveness probes at it.
             monitor.set_queue_depth_fn(getattr(epoch_stream, "queue_depth", None))
+            monitor.set_producer_alive_fn(
+                getattr(epoch_stream, "producer_alive", None)
+            )
             for b, parsed, w in epoch_stream:
                 if b is None:
                     b = to_batch(parsed, w)
@@ -462,21 +617,25 @@ def _run_training(
                 k = int(loss.shape[0]) if getattr(loss, "ndim", 0) else 1
                 first_call = step_num == start_step
                 step_num += k
+                cur["batch"] += k  # cursor: k micro-batches consumed
                 if first_call:
                     # Call 1 paid the XLA compile; a meter window that
                     # includes it reads as a throughput collapse.
                     jax.block_until_ready(loss)
                     meter.reset()
                 # Heartbeat + compile-sentinel drain + due mem sample.
-                # Epoch 0 is the shape-discovery pass: the first dispatch
-                # AND the epoch-tail remainder shape (steps_per_call > 1
-                # ships a shorter [K', B, ...] superbatch) legitimately
-                # compile once — all priced in as warmup.  Every shape
-                # recurs identically from epoch 1 on, so any later
+                # The FIRST epoch this process runs (epoch 0, or the
+                # cursor's epoch on a resume — a fresh process pays its
+                # XLA compiles regardless of where the input reopens) is
+                # the shape-discovery pass: the first dispatch AND the
+                # epoch-tail remainder shape (steps_per_call > 1 ships a
+                # shorter [K', B, ...] superbatch) legitimately compile
+                # once — all priced in as warmup.  Every shape recurs
+                # identically from the next epoch on, so any later
                 # kind=compile event is a steady-state recompile — the
                 # thing the serving bucket ladder pins to zero, now
                 # visible on the train path too.
-                monitor.on_dispatch(step_num, warmup=(epoch == 0))
+                monitor.on_dispatch(step_num, warmup=(epoch == start_epoch))
                 if ckpt.delta_enabled:
                     # OR this batch's rows into the device bitmap; at a
                     # delta boundary, ship the touched window (writer
@@ -486,6 +645,10 @@ def _run_training(
                         with monitor.suspended():
                             ckpt.delta_boundary(state, saveable, step_num)
                 losses.append(loss)  # device value(s); only sync at log points
+                if faults is not None and faults.nan_due(step_num):
+                    # Chaos: poison this window's loss so the finite
+                    # check (and the on_nan policy) fire deterministically.
+                    losses[-1] = np.float32("nan")
                 pending_steps += k
                 if examples_per_step is not None:
                     meter.add(examples_per_step * k)
@@ -513,7 +676,10 @@ def _run_training(
                     _check_finite(
                         mean_loss, cfg, monitor=monitor,
                         step=int(state.step), state=state,
+                        cursor=input_cursor(),
                     )
+                    for ev in drain_fault_events():
+                        monitor.emit("fault", step=int(state.step), **ev)
                     extra = extra_metrics() if extra_metrics is not None else {}
                     extra_txt = "".join(f" {k} {v}" for k, v in extra.items() if v)
                     log(
@@ -541,6 +707,9 @@ def _run_training(
                     meter.reset()
             if stop_requested.is_set():
                 break
+            # Epoch complete: the cursor now names the NEXT epoch's start
+            # (the position the epoch-end save below must embed).
+            cur["epoch"], cur["batch"] = epoch + 1, 0
             if input_stats is not None:
                 # Epoch-tail drain: the stream (and its stats) dies here,
                 # and a run (or tail) shorter than log_every would
@@ -551,11 +720,21 @@ def _run_training(
             if losses:
                 # Epoch boundary syncs anyway (validation / checkpoint); a
                 # poisoned state must abort BEFORE the save below replaces
-                # the last good checkpoint.  The final entry may be a [K]
-                # fused-call vector — check its LAST micro-step.
+                # the last good checkpoint.  Check the whole unlogged
+                # tail window (it is at most log_every entries, once per
+                # epoch): a REAL NaN propagates into every later loss,
+                # but an INJECTED one poisons a single host-side entry —
+                # the last entry alone would miss it mid-window.
                 _check_finite(
-                    float(np.asarray(losses[-1]).reshape(-1)[-1]), cfg,
-                    monitor=monitor, step=int(state.step), state=state,
+                    float(
+                        np.mean(
+                            np.concatenate(
+                                [np.atleast_1d(np.asarray(l)) for l in losses]
+                            )
+                        )
+                    ),
+                    cfg, monitor=monitor, step=int(state.step), state=state,
+                    cursor=input_cursor(),
                 )
             if cfg.validation_files:
                 # No train dispatches complete during validation — a long
@@ -571,16 +750,25 @@ def _run_training(
                     epoch=epoch,
                     validation_auc=round(val_auc, 6),
                 )
-                # Drain the validation pass's compiles: epoch 0's predict
-                # compile is priced in (warmup); a LATER epoch compiling
-                # again is a genuine steady-state recompile.
-                monitor.on_dispatch(int(state.step), warmup=(epoch == 0))
+                # Drain the validation pass's compiles: this process's
+                # first epoch's predict compile is priced in (warmup); a
+                # LATER epoch compiling again is a genuine steady-state
+                # recompile.
+                monitor.on_dispatch(int(state.step), warmup=(epoch == start_epoch))
             if cfg.save_every_epochs and (epoch + 1) % cfg.save_every_epochs == 0:
                 with monitor.suspended():  # the loop dispatches nothing here
                     # Async mode: snapshot + hand off to the writer; the
                     # loop resumes while the save converts/transfers/writes.
                     ckpt.save_boundary(state, saveable, int(state.step))
                 log(f"epoch {epoch} checkpoint -> {cfg.model_file}")
+    except PrefetchError as e:
+        # The prefetch producer died: surface it as a structured anomaly
+        # (the supervisor's restart is the recovery path) — the loud,
+        # named failure the old silent wedge never produced.
+        monitor.emit_anomaly(
+            step_num, None, event="input_pipeline_failure", error=str(e)
+        )
+        raise
     finally:
         summary_extra = {}
         if extra_metrics is not None:
@@ -592,6 +780,16 @@ def _run_training(
         # an older publish must never land after (and clobber) a newer one.
         ckpt.finalize()
         summary_extra.update(ckpt.summary())
+        # Fault events from the final partial window (io retries, injected
+        # faults) + their per-run counter totals onto the summary record.
+        for ev in drain_fault_events():
+            try:
+                monitor.emit("fault", step=int(state.step), **ev)
+            except Exception:
+                pass
+        summary_extra.update(
+            {f"fault_{k}": v for k, v in drain_fault_counters().items() if v}
+        )
         tracer.close()
         monitor.close(**summary_extra)
         for sig, handler in restore_handlers.items():
@@ -627,6 +825,7 @@ def train(cfg: Config, *, resume: bool = False, log=print, step_hook=None):
     model = build_model(cfg)
     max_nnz = scan_max_nnz(cfg)
     packed = cfg.table_layout == "packed"
+    fused = cfg.adagrad_accumulator == "fused"
     saveable = None
     if packed:
         from fast_tffm_tpu.ops.packed_table import (
@@ -642,7 +841,6 @@ def train(cfg: Config, *, resume: bool = False, log=print, step_hook=None):
         )
 
         v, d = model.vocabulary_size, model.row_dim
-        fused = cfg.adagrad_accumulator == "fused"
 
         def saveable(st):
             # Checkpoints always hold the LOGICAL arrays ([V, D] table;
@@ -660,28 +858,54 @@ def train(cfg: Config, *, resume: bool = False, log=print, step_hook=None):
                 ),
             )
 
-        if resume:
-            # Branch BEFORE allocating: building the fresh packed state
-            # first would peak at packed + 2x logical on exactly the large
-            # vocabs where OOMs were measured (dist_train's packed resume
-            # is structured the same way).
-            from fast_tffm_tpu.trainer import pack_state
-
-            logical = restore_checkpoint(
-                cfg.model_file,
-                init_state(
-                    model, jax.random.key(0), cfg.init_accumulator_value,
-                    cfg.adagrad_accumulator,
-                ),
-                chunk_bytes=cfg.checkpoint_chunk_mb << 20,
-            )
-            state = pack_state(logical, cfg.init_accumulator_value, fused=fused)
-            log(f"resumed from {cfg.model_file} at step {int(state.step)} (packed)")
-        else:
-            state = init_packed_state(
+    def restore_state():
+        """model_file -> this run's live layout.  Shared by --resume and
+        the on_nan=rollback recovery below.  Packed runs restore the
+        LOGICAL checkpoint first and pack it — branching BEFORE
+        allocating a fresh packed state, which would peak at packed + 2x
+        logical on exactly the large vocabs where OOMs were measured
+        (dist_train's packed resume is structured the same way)."""
+        logical = restore_checkpoint(
+            cfg.model_file,
+            init_state(
                 model, jax.random.key(0), cfg.init_accumulator_value,
                 cfg.adagrad_accumulator,
+            ),
+            chunk_bytes=cfg.checkpoint_chunk_mb << 20,
+        )
+        if packed:
+            from fast_tffm_tpu.trainer import pack_state
+
+            return pack_state(logical, cfg.init_accumulator_value, fused=fused)
+        return logical
+
+    start_cursor = None
+    if resume:
+        state = restore_state()
+        log(
+            f"resumed from {cfg.model_file} at step {int(state.step)}"
+            + (" (packed)" if packed else "")
+        )
+        # Exact-position resume: the chain head's input cursor names the
+        # batch the restored state stopped at; without one (a pre-cursor
+        # checkpoint) the input restarts at the first file, as it always
+        # did — forward compatibility, warned about, never an error.
+        start_cursor = read_input_cursor(cfg.model_file)
+        if start_cursor is None:
+            log(
+                "note: checkpoint carries no input cursor (pre-resilience "
+                "format) — input restarts at the first file (legacy resume)"
             )
+    elif packed:
+        state = init_packed_state(
+            model, jax.random.key(0), cfg.init_accumulator_value,
+            cfg.adagrad_accumulator,
+        )
+    else:
+        state = init_state(
+            model, jax.random.key(0), cfg.init_accumulator_value, cfg.adagrad_accumulator
+        )
+    if packed:
         predict_step = make_packed_predict_step(model, fused=fused)
         step_body = lambda mdl, lr, st, b: packed_train_step_body(
             mdl, lr, st, b, cfg.packed_update, cfg.packed_compact_cap
@@ -691,14 +915,6 @@ def train(cfg: Config, *, resume: bool = False, log=print, step_hook=None):
             compact_cap=cfg.packed_compact_cap,
         )
     else:
-        state = init_state(
-            model, jax.random.key(0), cfg.init_accumulator_value, cfg.adagrad_accumulator
-        )
-        if resume:
-            state = restore_checkpoint(
-                cfg.model_file, state, chunk_bytes=cfg.checkpoint_chunk_mb << 20
-            )
-            log(f"resumed from {cfg.model_file} at step {int(state.step)}")
         predict_step = make_predict_step(model)
         step_body = None
         step_fn = make_train_step(model, cfg.learning_rate)
@@ -710,21 +926,59 @@ def train(cfg: Config, *, resume: bool = False, log=print, step_hook=None):
 
         step_fn = make_scanned_train_step(model, cfg.learning_rate, body=step_body)
     to_batch = _batch_converter(model.uses_fields)
+    run_kwargs = dict(
+        to_batch=to_batch, saveable=saveable, step_hook=step_hook,
+        row_dim=model.row_dim,
+    )
     if cfg.device_cache:
         step_fn, train_stream, examples_per_step, mark_touched = _device_cached_input(
             cfg, model, max_nnz, log, body=step_body
         )
-        return _run_training(
-            cfg, state, step_fn, predict_step, max_nnz, log,
-            train_stream=train_stream, to_batch=to_batch,
-            examples_per_step=examples_per_step, saveable=saveable,
-            step_hook=step_hook, row_dim=model.row_dim,
+        run_kwargs.update(
+            train_stream=train_stream, examples_per_step=examples_per_step,
             mark_touched=mark_touched,
         )
-    return _run_training(
-        cfg, state, step_fn, predict_step, max_nnz, log, to_batch=to_batch,
-        saveable=saveable, step_hook=step_hook, row_dim=model.row_dim,
-    )
+    # on_nan = rollback: a non-finite loss restores the last checkpoint
+    # and resumes input AT the detection cursor — the diverged window's
+    # data is skipped, not replayed (bounded by max_rollbacks; abort mode
+    # and a run with no checkpoint yet keep the loud-raise behavior).
+    rollbacks = 0
+    rollback_note = None
+    while True:
+        try:
+            return _run_training(
+                cfg, state, step_fn, predict_step, max_nnz, log,
+                start_cursor=start_cursor, rollback=rollback_note,
+                **run_kwargs,
+            )
+        except NonFiniteLossError as e:
+            from fast_tffm_tpu.checkpoint import latest_step
+
+            if (
+                cfg.on_nan != "rollback"
+                or rollbacks >= cfg.max_rollbacks
+                or e.cursor is None
+                or latest_step(cfg.model_file) is None
+            ):
+                raise
+            rollbacks += 1
+            state = restore_state()
+            start_cursor = dict(e.cursor, _exact=True)
+            rollback_note = {
+                "step": e.step,
+                "loss": e.loss,
+                "rollback_n": rollbacks,
+                "restored_step": int(state.step),
+                "skip_to_epoch": int(e.cursor.get("epoch", 0)),
+                "skip_to_batch": int(e.cursor.get("batch_in_epoch", 0)),
+            }
+            log(
+                f"on_nan = rollback: non-finite loss at step {e.step}; "
+                f"restored {cfg.model_file} (step {int(state.step)}), "
+                f"skipping input to epoch {rollback_note['skip_to_epoch']} "
+                f"batch {rollback_note['skip_to_batch']} "
+                f"(rollback {rollbacks}/{cfg.max_rollbacks})"
+            )
 
 
 def _device_cached_input(cfg: Config, model, max_nnz: int, log, body=None):
@@ -805,9 +1059,18 @@ def _device_cached_input(cfg: Config, model, max_nnz: int, log, body=None):
         )
         chunks = epoch_index_chunks(data.batches, cfg.steps_per_call)
 
-        def train_stream(epoch):
+        def train_stream(epoch, skip_batches=0):
             _maybe_draw_perm(epoch)
-            return ((c, None, None) for c in chunks)
+            # Resume seek: regenerate the chunk list from the cursor's
+            # batch (same K-grid, so full chunks re-hit compiled shapes).
+            use = (
+                chunks
+                if not skip_batches
+                else epoch_index_chunks(
+                    data.batches, cfg.steps_per_call, start=skip_batches
+                )
+            )
+            return ((c, None, None) for c in use)
 
         def step_fn(state, idxs):
             if perm_ref[0] is not None:
@@ -823,9 +1086,9 @@ def _device_cached_input(cfg: Config, model, max_nnz: int, log, body=None):
     # an index that is already on device — no per-step H2D at all.
     idx = [jax.device_put(np.int32(i)) for i in range(data.batches)]
 
-    def train_stream(epoch):
+    def train_stream(epoch, skip_batches=0):
         _maybe_draw_perm(epoch)
-        return ((idx[i], None, None) for i in range(data.batches))
+        return ((idx[i], None, None) for i in range(skip_batches, data.batches))
 
     def step_fn(state, i):
         if perm_ref[0] is not None:
@@ -870,6 +1133,17 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None, step_
             f"{len(cfg.train_files)} train_files (they align per-file)"
         )
     maybe_initialize_distributed(cfg.coordinator_address, cfg.num_processes, cfg.process_id)
+    if cfg.on_nan == "rollback":
+        # The rollback loop re-enters _run_training with a restored state;
+        # on a multi-process pod every process would have to make the same
+        # decision at the same boundary (a barrier this driver doesn't
+        # have yet).  Silently downgrading to abort would corrupt chaos
+        # A/Bs, so refuse loudly.
+        raise ValueError(
+            "on_nan = rollback is local-train only; dist_train keeps the "
+            "abort-before-overwrite behavior (restart under the supervisor "
+            "to recover)"
+        )
     if cfg.device_cache and cfg.shuffle:
         # A shuffled gather across the mesh-sharded batch dim would move
         # rows between chips every step — exactly the per-step traffic
@@ -922,6 +1196,16 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None, step_
                 cfg.model_file, state, chunk_bytes=cfg.checkpoint_chunk_mb << 20
             )
             log(f"resumed from {cfg.model_file} at step {int(state.step)}")
+    start_cursor = None
+    if resume:
+        # Exact-position resume (every process reads the same shared
+        # cursor, so all shards reopen at the same global batch).
+        start_cursor = read_input_cursor(cfg.model_file)
+        if start_cursor is None:
+            log(
+                "note: checkpoint carries no input cursor (pre-resilience "
+                "format) — input restarts at the first file (legacy resume)"
+            )
     step_fn = make_sharded_train_step(
         model, cfg.learning_rate, mesh,
         lookup=cfg.lookup, capacity_factor=cfg.lookup_capacity_factor,
@@ -1052,15 +1336,26 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None, step_
 
             chunks = epoch_index_chunks(cached_data.batches, cfg.steps_per_call)
 
-            def train_stream(epoch):
-                return ((c, None, None) for c in chunks)
+            def train_stream(epoch, skip_batches=0):
+                use = (
+                    chunks
+                    if not skip_batches
+                    else epoch_index_chunks(
+                        cached_data.batches, cfg.steps_per_call,
+                        start=skip_batches,
+                    )
+                )
+                return ((c, None, None) for c in use)
 
         else:
             # Per-step "input" is a pre-placed device index scalar.
             idx = [jax.device_put(np.int32(i)) for i in range(cached_data.batches)]
 
-            def train_stream(epoch):
-                return ((idx[i], None, None) for i in range(cached_data.batches))
+            def train_stream(epoch, skip_batches=0):
+                return (
+                    (idx[i], None, None)
+                    for i in range(skip_batches, cached_data.batches)
+                )
 
         examples_per_step = cfg.batch_size
     nproc = jax.process_count()
@@ -1088,7 +1383,7 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None, step_
                 f"{steps_per_epoch} steps/epoch, {local_bs} rows/process/step"
             )
 
-            def train_stream(epoch):
+            def train_stream(epoch, skip_batches=0):
                 return _stream(
                     cfg,
                     cfg.train_files,
@@ -1102,6 +1397,7 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None, step_
                     to_batch=to_batch,
                     shuffle_epoch=epoch,
                     steps_per_call=cfg.steps_per_call,
+                    skip_batches=skip_batches,
                 )
 
         def to_batch(parsed, w):
@@ -1178,4 +1474,5 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None, step_
         step_hook=step_hook,
         row_dim=model.row_dim,
         mark_touched=mark_touched,
+        start_cursor=start_cursor,
     )

@@ -16,17 +16,29 @@ import queue
 import threading
 from collections.abc import Iterable, Iterator
 
-__all__ = ["prefetch", "chunk", "InputStream"]
+__all__ = ["prefetch", "chunk", "InputStream", "PrefetchError"]
 
 _SENTINEL = object()
+
+
+class PrefetchError(RuntimeError):
+    """The prefetch producer thread failed (or died without signaling).
+
+    The loud, NAMED form of an input-pipeline death: before this, a
+    producer exception only surfaced after the queue's buffered items
+    drained, and a producer that died without its sentinel (interpreter
+    teardown, a kill landing mid-put) left the consumer blocked on
+    ``q.get()`` forever — a wedge the stall watchdog could only report,
+    not break.  The original exception rides as ``__cause__``."""
 
 
 class InputStream:
     """An input iterator plus its InputStats (data/wire.py): the driver
     iterates it like the bare generator it wraps, drains ``.stats`` into
     kind=input metrics records at log points, and hands
-    ``.queue_depth`` to the telemetry stall watchdog (live occupancy —
-    readable mid-stall, when the consumer loop itself is frozen)."""
+    ``.queue_depth`` / ``.producer_alive`` to the telemetry stall
+    watchdog (live occupancy + thread liveness — readable mid-stall,
+    when the consumer loop itself is frozen)."""
 
     def __init__(self, it: Iterable, stats):
         self._it = it
@@ -37,6 +49,13 @@ class InputStream:
 
     def queue_depth(self) -> int | None:
         return self.stats.queue_depth() if self.stats is not None else None
+
+    def producer_alive(self) -> bool | None:
+        """Liveness of the prefetch producer thread (None before the
+        first iteration binds one) — the watchdog's 'is input-starved
+        because the producer is DEAD' signal."""
+        fn = getattr(self.stats, "producer_alive", None)
+        return fn() if fn is not None else None
 
 
 def chunk(it: Iterable, k: int) -> Iterator[list]:
@@ -66,9 +85,17 @@ def prefetch(it: Iterable, depth: int = 8, stats=None) -> Iterator:
     ``stats`` (an object with ``on_queue_depth(int)``) samples the queue
     occupancy at every consumer pop — the overlap-efficiency signal the
     kind=input metrics records carry (depth ~0 = producer-bound, depth at
-    the cap = consumer-bound).  The queue itself is also bound onto
-    ``stats`` (``bind_queue``) so the telemetry watchdog can read the
-    LIVE depth from its own thread while the consumer is wedged."""
+    the cap = consumer-bound).  The queue — and the producer THREAD —
+    are also bound onto ``stats`` (``bind_queue`` / ``bind_producer``)
+    so the telemetry watchdog can read the LIVE depth and the thread's
+    liveness from its own thread while the consumer is wedged.
+
+    Failure contract: a producer exception surfaces in the consumer as a
+    ``PrefetchError`` (the original as ``__cause__``) naming the thread —
+    a loud, attributable input-pipeline death instead of a wedge.  The
+    consumer polls with a timeout, so even a producer that dies WITHOUT
+    reaching its sentinel (interpreter teardown, a signal mid-put) is
+    detected within ~1s rather than blocking ``q.get()`` forever."""
     q: queue.Queue = queue.Queue(maxsize=max(1, depth))
     if stats is not None and hasattr(stats, "bind_queue"):
         stats.bind_queue(q)
@@ -83,15 +110,44 @@ def prefetch(it: Iterable, depth: int = 8, stats=None) -> Iterator:
         finally:
             q.put(_SENTINEL)
 
-    t = threading.Thread(target=worker, daemon=True)
+    t = threading.Thread(target=worker, name="input-prefetch", daemon=True)
+    if stats is not None and hasattr(stats, "bind_producer"):
+        stats.bind_producer(t)
     t.start()
+
+    def fail(reason: str):
+        e = PrefetchError(
+            f"input pipeline failed: prefetch producer thread "
+            f"{t.name!r} {reason}"
+        )
+        e.__cause__ = err[0] if err else None
+        return e
+
+    need_sample = True
     while True:
-        if stats is not None:
+        if stats is not None and need_sample:
+            # ONE depth sample per consumer pop (the pre-pop occupancy
+            # the overlap metric is defined over) — not one per 1s
+            # timeout retry, which would flood the average with zeros
+            # exactly when the producer is slow and skew the
+            # producer-bound signal.
             stats.on_queue_depth(q.qsize())
-        item = q.get()
+            need_sample = False
+        try:
+            item = q.get(timeout=1.0)
+        except queue.Empty:
+            if not t.is_alive() and q.empty():
+                # Died without its sentinel: the finally was never
+                # reached (teardown/kill).  Without this check the
+                # consumer blocks forever — the wedge this fixes.
+                raise fail(
+                    f"raised {err[0]!r}" if err else "died without signaling"
+                )
+            continue
+        need_sample = True
         if item is _SENTINEL:
             if err:
-                raise err[0]
+                raise fail(f"raised {err[0]!r}")
             return
         yield item
 

@@ -1,0 +1,705 @@
+"""Resilience layer: exact-position resume, supervised restart, chaos.
+
+The deterministic (not-slow) chaos subset: every test here replays a
+seeded or explicit fault plan in-process or against jax-free subprocess
+stubs, so the tier-1 gate exercises crash-and-resume semantics without
+minutes-long trainer subprocesses (those live, slow-marked, in
+tests/test_failure_recovery.py).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from fast_tffm_tpu.checkpoint import (
+    latest_step,
+    read_input_cursor,
+    restore_checkpoint,
+    save_checkpoint,
+    save_delta,
+)
+from fast_tffm_tpu.config import Config
+from fast_tffm_tpu.models import FMModel
+from fast_tffm_tpu.resilience import (
+    FaultPlan,
+    NonFiniteLossError,
+    Supervisor,
+    clear_faults,
+    drain_fault_counters,
+    drain_fault_events,
+    install_faults,
+    repair_delta_chain,
+)
+from fast_tffm_tpu.trainer import init_state
+from fast_tffm_tpu.training import _files_fingerprint, _resolve_cursor, train
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    """Fault plans and the event sink are process-global; every test
+    starts (and leaves) them empty."""
+    clear_faults()
+    drain_fault_events()
+    drain_fault_counters()
+    yield
+    clear_faults()
+    drain_fault_events()
+    drain_fault_counters()
+
+
+def _write_dataset(path, n=320, vocab=64, seed=0):
+    rng = np.random.default_rng(seed)
+    lines = []
+    for _ in range(n):
+        ids = rng.choice(vocab, size=4, replace=False)
+        toks = " ".join(f"{i}:1.0" for i in ids)
+        lines.append(f"{rng.integers(0, 2)} {toks}")
+    path.write_text("\n".join(lines) + "\n")
+    return str(path)
+
+
+def _cfg(tmp_path, data, **kw):
+    d = dict(
+        model="fm",
+        factor_num=4,
+        vocabulary_size=64,
+        model_file=str(tmp_path / "m.ckpt"),
+        train_files=(data,),
+        epoch_num=2,
+        batch_size=32,
+        log_every=1,
+        metrics_path=str(tmp_path / "run.jsonl"),
+        binary_cache=True,
+    )
+    d.update(kw)
+    return Config(**d).validate()
+
+
+def _records(path, kind=None):
+    out = []
+    for line in open(path):
+        r = json.loads(line)
+        if kind is None or r.get("kind") == kind:
+            out.append(r)
+    return out
+
+
+def _losses_by_step(path):
+    """step -> LAST logged loss (a chaos run logs replayed steps twice;
+    the last occurrence is the one that fed the surviving state)."""
+    out = {}
+    for r in _records(path, "train"):
+        out[r["step"]] = r["loss"]
+    return out
+
+
+# -- fault plan ------------------------------------------------------------
+
+
+def test_fault_plan_seeded_schedule_byte_identical():
+    spec = "random:kill=2,io_error=3,nan=1"
+    a = FaultPlan.parse(spec, seed=7, horizon=400).to_json()
+    b = FaultPlan.parse(spec, seed=7, horizon=400).to_json()
+    c = FaultPlan.parse(spec, seed=8, horizon=400).to_json()
+    assert a == b  # the acceptance pin: byte-identical across runs
+    assert a != c
+    events = json.loads(a)["events"]
+    assert sum(e["kind"] == "kill" for e in events) == 2
+    assert sum(e["kind"] == "io_error" for e in events) == 3
+    assert all(1 <= e["at"] < 400 for e in events)
+
+
+def test_fault_plan_explicit_parse_and_errors():
+    p = FaultPlan.parse("kill@12, nan@30:40, io_error@2, torn_delta@1")
+    kinds = [(e["kind"], e["at"]) for e in p.events]
+    assert ("kill", 12) in kinds and ("torn_delta", 1) in kinds
+    (nan,) = [e for e in p.events if e["kind"] == "nan"]
+    assert nan["until"] == 40
+    with pytest.raises(ValueError, match="bad fault token"):
+        FaultPlan.parse("explode@3")
+    with pytest.raises(ValueError, match="window"):
+        FaultPlan.parse("kill@3:9")
+    with pytest.raises(ValueError, match="until must be > at"):
+        FaultPlan.parse("nan@210:200")  # inverted window would never fire
+    with pytest.raises(ValueError, match="empty"):
+        FaultPlan.parse("  ")
+
+
+# -- cursor plumbing -------------------------------------------------------
+
+
+def test_cursor_rides_full_and_delta_saves(tmp_path):
+    model = FMModel(vocabulary_size=64, factor_num=4)
+    state = init_state(model, __import__("jax").random.key(0))
+    path = str(tmp_path / "m.ckpt")
+    cur0 = {"version": 1, "epoch": 1, "batch_in_epoch": 3, "batch_size": 32,
+            "shuffle": False, "shuffle_seed": 0, "steps_per_call": 1}
+    save_checkpoint(path, state, save_id="base0", cursor=cur0)
+    assert read_input_cursor(path) == cur0
+    # A delta extends the chain; ITS cursor is the head's now.
+    cur1 = dict(cur0, batch_in_epoch=7)
+    save_delta(
+        path, 1, idx=np.array([1, 2]),
+        table_rows=np.zeros((2, model.row_dim), np.float32),
+        accum_rows=np.ones((2, model.row_dim), np.float32),
+        dense_leaves=[], dense_accum_leaves=[],
+        step=np.int32(7), parent_sig="base0", cursor=cur1,
+    )
+    assert read_input_cursor(path) == cur1
+    # Restore still replays base+chain fine with the extra member present.
+    restored = restore_checkpoint(path, init_state(model, __import__("jax").random.key(1)))
+    assert int(restored.step) == 7
+
+
+def test_pre_cursor_checkpoint_reads_none(tmp_path):
+    """PR-5-format checkpoints (no input_cursor member) read as None —
+    the forward-compat contract — and missing files too."""
+    model = FMModel(vocabulary_size=64, factor_num=4)
+    state = init_state(model, __import__("jax").random.key(0))
+    path = str(tmp_path / "m.ckpt")
+    save_checkpoint(path, state)  # cursor omitted = the PR-5 byte layout
+    assert read_input_cursor(path) is None
+    assert read_input_cursor(str(tmp_path / "nope.ckpt")) is None
+
+
+def test_resolve_cursor_mismatch_and_completed_run(tmp_path):
+    data = _write_dataset(tmp_path / "x.libsvm")
+    cfg = _cfg(tmp_path, data)
+    logs = []
+    cur = {"version": 1, "epoch": 1, "batch_in_epoch": 3, "batch_size": 32,
+           "shuffle": False, "shuffle_seed": 0,
+           "files": _files_fingerprint(cfg.train_files)}
+    assert _resolve_cursor(cfg, dict(cur), logs.append) == (1, 3)
+    # batch_size change: the position means something different now.
+    assert _resolve_cursor(cfg, dict(cur, batch_size=64), logs.append) == (0, 0)
+    assert any("does not match" in l for l in logs)
+    # Dataset change (the online-append scenario): a cursor's batch
+    # offset means nothing against different data — legacy fallback.
+    with open(data, "a") as f:
+        f.write("1 0:1.0 1:1.0 2:1.0 3:1.0\n")
+    logs.clear()
+    assert _resolve_cursor(cfg, dict(cur), logs.append) == (0, 0)
+    assert any("files" in l and "does not match" in l for l in logs)
+    cur["files"] = _files_fingerprint(cfg.train_files)  # re-pin post-append
+    # Completed run (epoch >= epoch_num): resume keeps its historical
+    # "train epoch_num more epochs" meaning...
+    assert _resolve_cursor(cfg, dict(cur, epoch=2), logs.append) == (0, 0)
+    # ...except for EXACT (rollback) cursors, which are literal positions.
+    assert _resolve_cursor(cfg, dict(cur, epoch=2, _exact=True), logs.append) == (2, 0)
+    # Unknown future version: legacy, with a warning.
+    logs.clear()
+    assert _resolve_cursor(cfg, dict(cur, version=9), logs.append) == (0, 0)
+    assert any("newer version" in l for l in logs)
+
+
+# -- resumed == uninterrupted (in-process, deterministic) ------------------
+
+
+def _run_till_sigterm(cfg, at_step):
+    import signal
+
+    fired = []
+
+    def hook(step):
+        if step >= at_step and not fired:
+            fired.append(step)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    state = train(cfg, log=lambda *_: None, step_hook=hook)
+    assert fired, "hook never fired — run too short for the kill step"
+    return state
+
+
+def test_resumed_equals_uninterrupted_streamed_shuffled(tmp_path):
+    """SIGTERM mid-epoch, resume via the cursor: the concatenated
+    per-step loss sequence is IDENTICAL to one uninterrupted run —
+    including the per-epoch shuffle permutation (redrawn from the seed)."""
+    a, b = tmp_path / "a", tmp_path / "b"
+    a.mkdir(), b.mkdir()
+    base_cfg = _cfg(a, _write_dataset(a / "t.libsvm"), shuffle=True, shuffle_seed=3)
+    train(base_cfg, log=lambda *_: None)
+    base = _losses_by_step(base_cfg.metrics_path)
+    assert len(base) == 20  # 320 rows / 32 = 10 batches x 2 epochs
+
+    cfg = _cfg(b, _write_dataset(b / "t.libsvm"), shuffle=True, shuffle_seed=3)
+    st = _run_till_sigterm(cfg, at_step=7)
+    cur = read_input_cursor(cfg.model_file)
+    assert cur == {
+        "version": 1, "epoch": 0, "batch_in_epoch": int(st.step),
+        "batch_size": 32, "shuffle": True, "shuffle_seed": 3,
+        "steps_per_call": 1, "files": _files_fingerprint(cfg.train_files),
+    }
+    st2 = train(cfg, resume=True, log=lambda *_: None)
+    assert int(st2.step) == 20
+    got = _losses_by_step(cfg.metrics_path)
+    # Bit-identical per step (same XLA program, same batches, same state).
+    for step, loss in base.items():
+        if step == int(st.step):
+            continue  # the killed step's loss was never logged pre-kill
+        assert got[step] == loss, f"step {step}: {got[step]} != {loss}"
+
+
+def test_resumed_equals_uninterrupted_device_cache_scanned(tmp_path):
+    """Same pin on the device-cached scan-fused path: the resume seek
+    regenerates K-grid-aligned index chunks from the cursor."""
+    a, b = tmp_path / "a", tmp_path / "b"
+    a.mkdir(), b.mkdir()
+    kw = dict(device_cache=True, steps_per_call=2, epoch_num=2)
+    base_cfg = _cfg(a, _write_dataset(a / "t.libsvm"), **kw)
+    train(base_cfg, log=lambda *_: None)
+    base = _losses_by_step(base_cfg.metrics_path)
+
+    cfg = _cfg(b, _write_dataset(b / "t.libsvm"), **kw)
+    st = _run_till_sigterm(cfg, at_step=5)  # lands on the next K boundary
+    assert int(st.step) % 2 == 0  # stop boundaries are K-step-aligned
+    st2 = train(cfg, resume=True, log=lambda *_: None)
+    assert int(st2.step) == 20
+    got = _losses_by_step(cfg.metrics_path)
+    for step, loss in base.items():
+        if step == int(st.step):
+            continue
+        assert got[step] == loss, f"step {step}: {got[step]} != {loss}"
+
+
+def test_pre_cursor_checkpoint_resumes_with_legacy_behavior(tmp_path):
+    """Forward compat: a PR-5-format checkpoint (round-tripped through
+    save_checkpoint with no cursor) resumes with a warning and the
+    legacy start-of-data behavior — epoch_num FULL epochs on top."""
+    import jax
+
+    cfg = _cfg(tmp_path, _write_dataset(tmp_path / "t.libsvm"))
+    st = _run_till_sigterm(cfg, at_step=3)
+    assert read_input_cursor(cfg.model_file) is not None
+    # Rewrite the checkpoint in the PR-5 byte layout (same members, no
+    # input_cursor) — exactly what a pre-PR-6 trainer produced.
+    model = FMModel(vocabulary_size=64, factor_num=4)
+    logical = restore_checkpoint(cfg.model_file, init_state(model, jax.random.key(0)))
+    save_checkpoint(cfg.model_file, logical)
+    assert read_input_cursor(cfg.model_file) is None
+
+    logs = []
+    st2 = train(cfg, resume=True, log=logs.append)
+    assert any("no input cursor" in l for l in logs)
+    # Legacy semantics: 2 full epochs (20 steps) on top of step 3 — a
+    # cursor resume would have finished at 20.
+    assert int(st2.step) == int(st.step) + 20
+
+
+# -- transient IO faults ---------------------------------------------------
+
+
+def test_io_retry_absorbs_injected_fault_zero_lost_or_duplicated(tmp_path):
+    from fast_tffm_tpu.data.binary import fmb_batch_stream, write_fmb
+
+    src = _write_dataset(tmp_path / "t.libsvm")
+    fmb = write_fmb(src, str(tmp_path / "t.fmb"), vocabulary_size=64)
+
+    def batches(**kw):
+        return [
+            (p.labels.copy(), p.ids.copy(), p.vals.copy(), p.nnz.copy(), w.copy())
+            for p, w in fmb_batch_stream(
+                [fmb], batch_size=32, vocabulary_size=64, max_nnz=4, **kw
+            )
+        ]
+
+    clean = batches()
+    install_faults(FaultPlan.parse("io_error@3,io_error@5"))
+    faulted = batches(io_retry_backoff_s=0.0)
+    assert len(faulted) == len(clean)
+    for (l0, i0, v0, n0, w0), (l1, i1, v1, n1, w1) in zip(clean, faulted):
+        np.testing.assert_array_equal(l0, l1)
+        np.testing.assert_array_equal(i0, i1)
+        np.testing.assert_array_equal(v0, v1)
+        np.testing.assert_array_equal(n0, n1)
+        np.testing.assert_array_equal(w0, w1)
+    counters = drain_fault_counters()
+    assert counters.get("injected_io_error") == 2
+    assert counters.get("io_retry") == 2  # each absorbed on the next try
+    events = {e["event"] for e in drain_fault_events()}
+    assert {"injected_io_error", "io_retry"} <= events
+
+
+def test_io_retry_exhausted_raises(tmp_path):
+    from fast_tffm_tpu.data.binary import fmb_batch_stream, write_fmb
+
+    src = _write_dataset(tmp_path / "t.libsvm")
+    fmb = write_fmb(src, str(tmp_path / "t.fmb"), vocabulary_size=64)
+    install_faults(FaultPlan.parse("io_error@1,io_error@2,io_error@3,io_error@4"))
+    with pytest.raises(OSError, match="injected transient IO fault"):
+        list(
+            fmb_batch_stream(
+                [fmb], batch_size=32, vocabulary_size=64, max_nnz=4,
+                io_retries=2, io_retry_backoff_s=0.0,
+            )
+        )
+
+
+def test_fmb_skip_rows_matches_stream_suffix(tmp_path):
+    from fast_tffm_tpu.data.binary import fmb_batch_stream, write_fmb
+
+    src = _write_dataset(tmp_path / "t.libsvm", n=200)
+    fmb = write_fmb(src, str(tmp_path / "t.fmb"), vocabulary_size=64)
+    for kw in ({}, {"shuffle_seed": 5}):
+        full = list(
+            fmb_batch_stream([fmb], batch_size=32, vocabulary_size=64, max_nnz=4, **kw)
+        )
+        part = list(
+            fmb_batch_stream(
+                [fmb], batch_size=32, vocabulary_size=64, max_nnz=4,
+                skip_rows=3 * 32, **kw,
+            )
+        )
+        assert len(part) == len(full) - 3
+        for (p0, w0), (p1, w1) in zip(full[3:], part):
+            np.testing.assert_array_equal(p0.ids, p1.ids)
+            np.testing.assert_array_equal(p0.labels, p1.labels)
+            np.testing.assert_array_equal(w0, w1)
+    with pytest.raises(ValueError, match="whole number of batches"):
+        next(
+            iter(
+                fmb_batch_stream(
+                    [fmb], batch_size=32, vocabulary_size=64, max_nnz=4, skip_rows=7
+                )
+            )
+        )
+
+
+# -- prefetch wedge --------------------------------------------------------
+
+
+def test_prefetch_producer_failure_is_loud_and_named():
+    from fast_tffm_tpu.utils.prefetch import PrefetchError, prefetch
+
+    def gen():
+        yield 1
+        yield 2
+        raise ValueError("disk on fire")
+
+    got = []
+    with pytest.raises(PrefetchError, match="input-prefetch") as exc_info:
+        for x in prefetch(gen(), depth=2):
+            got.append(x)
+    assert got == [1, 2]  # buffered good items still delivered first
+    assert isinstance(exc_info.value.__cause__, ValueError)
+
+
+def test_stall_classification_names_dead_producer():
+    from fast_tffm_tpu.telemetry import classify_stall
+
+    assert classify_stall(0, {}, producer_alive=False) == (
+        "input-starved (producer-thread dead)"
+    )
+    assert classify_stall(0, {}, producer_alive=True) == "input-starved"
+    assert classify_stall(0, {}) == "input-starved"  # liveness unknown
+    # A dead producer with data still queued is NOT input-starved yet.
+    assert classify_stall(3, {}, producer_alive=False) == "device-bound"
+
+
+def test_input_stream_exposes_producer_liveness():
+    import time
+
+    from fast_tffm_tpu.data.wire import InputStats
+    from fast_tffm_tpu.utils.prefetch import InputStream, prefetch
+
+    stats = InputStats()
+    stream = InputStream(prefetch(iter([("a", 1.0)]), depth=2, stats=stats), stats)
+    assert list(stream) == [("a", 1.0)]
+    for _ in range(50):  # the producer thread exits asynchronously
+        if stream.producer_alive() is False:
+            break
+        time.sleep(0.02)
+    assert stream.producer_alive() is False
+
+
+# -- supervisor ------------------------------------------------------------
+
+_FLAKY_CHILD = textwrap.dedent(
+    """
+    import os, sys
+    p = sys.argv[1]
+    n = int(open(p).read()) if os.path.exists(p) else 0
+    open(p, "w").write(str(n + 1))
+    print("step %d epoch 0 loss 0.5 examples/sec 10" % (n * 10 + 1), flush=True)
+    if n < 2:
+        os._exit(9)
+    print("training done: steps 0->30, model -> m.ckpt", flush=True)
+    """
+)
+
+
+def test_supervisor_restarts_until_success(tmp_path):
+    counter = str(tmp_path / "attempts")
+    metrics = str(tmp_path / "sup.jsonl")
+    cmds = []
+
+    def build_cmd(attempt, resume):
+        cmds.append((attempt, resume))
+        return [sys.executable, "-c", _FLAKY_CHILD, counter]
+
+    sup = Supervisor(
+        build_cmd, model_file=str(tmp_path / "m.ckpt"), max_restarts=5,
+        backoff_s=0.01, backoff_max_s=0.05, metrics_path=metrics,
+        log=lambda *_: None,
+    )
+    assert sup.run() == 0
+    assert sup.restarts == 2
+    assert cmds[0] == (0, False)
+    faults = _records(metrics, "fault")
+    restarts = _records(metrics, "restart")
+    assert [f["event"] for f in faults] == ["crash", "crash"]
+    assert all(f["exit_code"] == 9 for f in faults)
+    assert [r["attempt"] for r in restarts] == [1, 2]
+    # MTTR measured: crash -> the next child's first step line.
+    assert all(isinstance(r["mttr_s"], float) for r in restarts)
+    assert len(sup.mttr_s) == 2
+    # Exponential backoff: second restart waited longer than the first.
+    assert restarts[1]["backoff_s"] > restarts[0]["backoff_s"]
+    (summary,) = _records(metrics, "summary")
+    assert summary["supervisor_restarts"] == 2
+    assert summary["mttr_s_median"] > 0
+
+
+def test_supervisor_gives_up_after_bounded_restarts(tmp_path):
+    metrics = str(tmp_path / "sup.jsonl")
+    sup = Supervisor(
+        lambda attempt, resume: [sys.executable, "-c", "import os; os._exit(3)"],
+        model_file=str(tmp_path / "m.ckpt"), max_restarts=1,
+        backoff_s=0.01, metrics_path=metrics, log=lambda *_: None,
+    )
+    assert sup.run() == 3
+    assert sup.restarts == 1
+    assert len(_records(metrics, "fault")) == 2  # initial crash + retry crash
+    assert len(_records(metrics, "restart")) == 1
+
+
+# -- torn delta chain repair -----------------------------------------------
+
+
+def _chained_checkpoint(tmp_path, n_deltas=2):
+    import jax
+
+    model = FMModel(vocabulary_size=64, factor_num=4)
+    state = init_state(model, jax.random.key(0))
+    path = str(tmp_path / "m.ckpt")
+    save_checkpoint(path, state, save_id="base0")
+    parent = "base0"
+    for i in range(1, n_deltas + 1):
+        _, parent, _ = save_delta(
+            path, i, idx=np.array([i]),
+            table_rows=np.full((1, model.row_dim), float(i), np.float32),
+            accum_rows=np.ones((1, model.row_dim), np.float32),
+            dense_leaves=[], dense_accum_leaves=[],
+            step=np.int32(i * 5), parent_sig=parent,
+        )
+    return model, path
+
+
+def test_repair_quarantines_torn_tail_and_restore_succeeds(tmp_path):
+    import jax
+
+    model, path = _chained_checkpoint(tmp_path, n_deltas=2)
+    torn = f"{path}.delta-0002.npz"
+    size = os.path.getsize(torn)
+    with open(torn, "r+b") as f:
+        f.truncate(size // 3)
+    # Strict restore fails loudly, naming the torn file...
+    with pytest.raises(ValueError, match="delta-0002"):
+        restore_checkpoint(path, init_state(model, jax.random.key(1)))
+    # ...the repair quarantines exactly the torn tail...
+    quarantined = repair_delta_chain(path, log=lambda *_: None)
+    assert quarantined == [torn + ".corrupt"]
+    assert not os.path.exists(torn)
+    # ...and resume lands on the last good link.
+    restored = restore_checkpoint(path, init_state(model, jax.random.key(1)))
+    assert int(restored.step) == 5
+    assert latest_step(path) == 5
+    # Healthy chain: repair is a no-op.
+    assert repair_delta_chain(path, log=lambda *_: None) == []
+
+
+def test_repair_quarantines_everything_after_a_bad_link(tmp_path):
+    """A mid-chain break (delta 1 torn, delta 2 readable) must drop BOTH:
+    delta 2 chains from the bad link and can never apply."""
+    _, path = _chained_checkpoint(tmp_path, n_deltas=2)
+    with open(f"{path}.delta-0001.npz", "r+b") as f:
+        f.truncate(100)
+    quarantined = repair_delta_chain(path, log=lambda *_: None)
+    assert len(quarantined) == 2
+    assert latest_step(path) == 0  # back to the base
+
+
+# -- on_nan = rollback -----------------------------------------------------
+
+
+def test_nan_rollback_restores_and_skips_window(tmp_path):
+    cfg = _cfg(
+        tmp_path, _write_dataset(tmp_path / "t.libsvm"),
+        delta_every_steps=4, on_nan="rollback", max_rollbacks=2,
+    )
+    inj = install_faults(FaultPlan.parse("nan@6"))
+    logs = []
+    st = train(cfg, log=logs.append, step_hook=inj.step_hook)
+    # Rolled back to the step-4 delta, skipped batches 5-6 (the poisoned
+    # window): 20 planned steps - 2 skipped = 18.
+    assert int(st.step) == 18
+    assert any("on_nan = rollback" in l for l in logs)
+    anomalies = [(r["event"], r.get("rollback_n")) for r in _records(cfg.metrics_path, "anomaly")]
+    assert ("nonfinite_loss", None) in anomalies
+    assert ("rollback", 1) in anomalies
+    assert any(
+        r["event"] == "injected_nan" for r in _records(cfg.metrics_path, "fault")
+    )
+    assert latest_step(cfg.model_file) == 18
+
+
+def test_nan_abort_policy_still_raises(tmp_path):
+    cfg = _cfg(
+        tmp_path, _write_dataset(tmp_path / "t.libsvm"),
+        delta_every_steps=4, on_nan="abort",
+    )
+    inj = install_faults(FaultPlan.parse("nan@6"))
+    with pytest.raises(NonFiniteLossError, match="loss is nan"):
+        train(cfg, log=lambda *_: None, step_hook=inj.step_hook)
+    # The abort kept the last GOOD state: the step-4 delta, not a later
+    # save of poisoned weights.
+    assert latest_step(cfg.model_file) == 4
+
+
+def test_nan_injected_in_epoch_tail_window_still_detected(tmp_path):
+    """An injected nan poisons ONE host-side loss entry (state stays
+    finite, unlike a real NaN) — with log_every past the epoch length no
+    log-point check runs, so the epoch-tail check must scan the whole
+    unlogged window, not just the final entry."""
+    cfg = _cfg(
+        tmp_path, _write_dataset(tmp_path / "t.libsvm"),
+        delta_every_steps=4, on_nan="abort", log_every=100,
+    )
+    inj = install_faults(FaultPlan.parse("nan@6"))
+    with pytest.raises(NonFiniteLossError, match="loss is nan"):
+        train(cfg, log=lambda *_: None, step_hook=inj.step_hook)
+
+
+def test_rollback_budget_exhausted_aborts(tmp_path):
+    cfg = _cfg(
+        tmp_path, _write_dataset(tmp_path / "t.libsvm"),
+        delta_every_steps=4, on_nan="rollback", max_rollbacks=0,
+    )
+    inj = install_faults(FaultPlan.parse("nan@6"))
+    with pytest.raises(NonFiniteLossError):
+        train(cfg, log=lambda *_: None, step_hook=inj.step_hook)
+
+
+# -- serving watcher giveup ------------------------------------------------
+
+
+def test_serving_reload_gives_up_on_persistent_corruption(tmp_path):
+    import time
+
+    import jax
+
+    from fast_tffm_tpu.serving import ServingEngine
+
+    model = FMModel(vocabulary_size=64, factor_num=4)
+    state = init_state(model, jax.random.key(0))
+    path = str(tmp_path / "m.ckpt")
+    save_checkpoint(path, state)
+    cfg = Config(
+        model="fm", factor_num=4, vocabulary_size=64, max_nnz=4,
+        model_file=path, serve_buckets=(1, 4),
+        serve_reload_interval_s=0.02, serve_reload_max_retries=2,
+        metrics_path=str(tmp_path / "serve.jsonl"),
+    ).validate()
+    with ServingEngine(cfg, log=lambda *_: None) as engine:
+        # Persistently corrupt write whose SIGNATURE still reads (step
+        # member intact, table missing): the watcher must retry with
+        # backoff, then GIVE UP on it instead of hot-spinning.  (A write
+        # so torn the signature is unreadable never even triggers reload
+        # attempts — the watcher keeps serving and waits, by design.)
+        with open(path, "wb") as f:  # file object: savez must not append .npz
+            np.savez(f, step=np.int32(99))
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if engine.metrics.snapshot()["reload_giveups"] >= 1:
+                break
+            time.sleep(0.05)
+        snap = engine.metrics.snapshot()
+        assert snap["reload_giveups"] == 1
+        assert snap["reload_failures"] == 2  # capped, not hot-spinning
+        failures_at_giveup = snap["reload_failures"]
+        # Still serving on the loaded state the whole time.
+        assert engine.submit([1, 2], [1.0, 1.0]).result(timeout=10) > 0
+        # Abandoned signature: no further retries accumulate.
+        time.sleep(0.3)
+        assert engine.metrics.snapshot()["reload_failures"] == failures_at_giveup
+        # A NEW (good) write resets the giveup and reloads.
+        state2 = state._replace(step=state.step + 11)
+        save_checkpoint(path, state2)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            engine.submit([1], [1.0]).result(timeout=10)  # flushes swap stages
+            if engine.step == 11:
+                break
+            time.sleep(0.05)
+        assert engine.step == 11
+    giveups = [
+        r for r in _records(cfg.metrics_path, "anomaly")
+        if r.get("event") == "reload_giveup"
+    ]
+    assert len(giveups) == 1 and giveups[0]["attempts"] == 2
+
+
+# -- report tool -----------------------------------------------------------
+
+
+def _load_report_module():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "report_tool_resilience", os.path.join(REPO, "tools", "report.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_report_renders_and_gates_resilience_events(tmp_path):
+    report = _load_report_module()
+    base = [
+        {"run_id": "r0", "kind": "train", "step": s, "t": s * 1.0, "ts": 0,
+         "schema_version": 1, "epoch": 0, "loss": 0.5,
+         "examples_per_sec": 100.0, "examples_per_sec_per_chip": 100.0}
+        for s in range(1, 4)
+    ]
+    chaos = [dict(r, run_id="r1") for r in base] + [
+        {"run_id": "r1", "kind": "fault", "step": 2, "t": 2.5, "ts": 0,
+         "schema_version": 1, "event": "crash", "exit_code": -9, "signal": 9},
+        {"run_id": "r1", "kind": "restart", "step": 2, "t": 3.0, "ts": 0,
+         "schema_version": 1, "attempt": 1, "exit_code": -9,
+         "backoff_s": 0.5, "mttr_s": 2.25},
+        {"run_id": "r1", "kind": "anomaly", "step": 3, "t": 3.5, "ts": 0,
+         "schema_version": 1, "event": "rollback", "loss": None},
+    ]
+    bpath, cpath = str(tmp_path / "b.jsonl"), str(tmp_path / "c.jsonl")
+    with open(bpath, "w") as f:
+        f.writelines(json.dumps(r) + "\n" for r in base)
+    with open(cpath, "w") as f:
+        f.writelines(json.dumps(r) + "\n" for r in chaos)
+    s = report.summarize(report.load_run(cpath))
+    assert s["faults"] == 1 and s["restarts"] == 1 and s["rollbacks"] == 1
+    assert s["mttr_s_median"] == 2.25
+    text = report.render(s)
+    assert "## Resilience" in text and "MTTR" in text
+    # --compare --strict gates on NEW faults/restarts/rollbacks.
+    b = report.summarize(report.load_run(bpath))
+    _, regressions = report.compare(s, b, threshold=0.15, strict=True)
+    joined = " ".join(regressions)
+    assert "faults" in joined and "restarts" in joined and "rollbacks" in joined
+    _, regressions = report.compare(s, b, threshold=0.15, strict=False)
+    assert regressions == []

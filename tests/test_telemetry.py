@@ -64,6 +64,11 @@ _DRIVER_PAYLOADS = {
         mode="full", snapshot_ms=1.0, convert_ms=2.0, d2h_ms=3.0,
         write_ms=4.0, bytes=1024, rows_written=7, train_stall_ms=1.0,
     ),
+    # Resilience layer (resilience.py): the training loop emits fault
+    # records by splatting injector/retry event dicts; the supervisor
+    # emits restart records with the measured MTTR (null until a step).
+    "fault": dict(event="crash", exit_code=-9, signal=9),
+    "restart": dict(attempt=1, exit_code=-9, backoff_s=0.5, mttr_s=2.1),
 }
 
 

@@ -1,0 +1,238 @@
+#!/usr/bin/env python
+"""Chaos probe (ISSUE 6): supervised crash-and-resume MTTR + loss parity.
+
+Drives the resilience layer end to end with REAL trainer subprocesses:
+
+  baseline   one uninterrupted run per path (streamed, sharded) on a
+             seeded synthetic CTR set, per-step losses logged to JSONL.
+  chaos      the same run under ``train --supervised`` with a seeded
+             ``kill@N`` fault plan: the child SIGKILLs itself at step N,
+             the supervisor relaunches it with ``--resume``, and the
+             resumed child reopens the input at the checkpoint cursor.
+
+For every trial the probe checks the acceptance pin — each step of the
+uninterrupted run appears in the chaos run's concatenated log with a
+bit-identical loss — and records the supervisor's measured MTTR
+(crash → first new training progress in the relaunched child, backoff
+included: that IS recovery time the fleet pays).
+
+The kill steps are drawn from ``random.Random(seed)``, so a probe run is
+reproducible bit for bit (the fault plan's byte-identity is separately
+pinned by tests/test_resilience.py).
+
+Writes PROBE_MTTR_r06.json.  Usage:
+  python tools/chaos.py [--trials 3] [--seed 1106] [--sharded]
+                        [--out PROBE_MTTR_r06.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import statistics
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+ROWS = 320
+BATCH = 32
+EPOCHS = 2
+STEPS = ROWS // BATCH * EPOCHS  # 20
+DELTA_EVERY = 3
+
+
+def _write_dataset(path: str) -> None:
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    lines = []
+    for _ in range(ROWS):
+        ids = rng.choice(64, size=4, replace=False)
+        toks = " ".join(f"{i}:1.0" for i in ids)
+        lines.append(f"{rng.integers(0, 2)} {toks}")
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+def _write_cfg(d: str) -> str:
+    cfg = os.path.join(d, "run.cfg")
+    with open(cfg, "w") as f:
+        f.write(
+            f"""
+[General]
+model = fm
+factor_num = 4
+vocabulary_size = 64
+model_file = {d}/m.ckpt
+
+[Checkpoint]
+delta_every_steps = {DELTA_EVERY}
+
+[Train]
+train_files = {d}/t.libsvm
+epoch_num = {EPOCHS}
+batch_size = {BATCH}
+max_nnz = 4
+learning_rate = 0.1
+log_every = 1
+metrics_path = {d}/run.jsonl
+"""
+        )
+    return cfg
+
+
+def _env() -> dict:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    return env
+
+
+def _run(mode: str, cfg: str, *args, timeout: int = 600) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "fast_tffm.py"), mode, cfg, *args],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env=_env(),
+        cwd=REPO,
+        timeout=timeout,
+    )
+
+
+def _records(path: str, kind: str) -> list[dict]:
+    out = []
+    with open(path) as f:
+        for line in f:
+            r = json.loads(line)
+            if r.get("kind") == kind:
+                out.append(r)
+    return out
+
+
+def _losses(path: str) -> dict[int, float]:
+    """step -> LAST logged loss (replayed steps re-log; the last feeds
+    the surviving state)."""
+    return {r["step"]: r["loss"] for r in _records(path, "train")}
+
+
+def _trial(mode: str, kill_at: int, base_losses: dict[int, float]) -> dict:
+    """One supervised chaos run; returns the trial record."""
+    with tempfile.TemporaryDirectory(prefix="chaos-") as d:
+        _write_dataset(os.path.join(d, "t.libsvm"))
+        cfg = _write_cfg(d)
+        t0 = time.monotonic()
+        proc = _run(
+            mode, cfg, "--supervised", "--fault-plan", f"kill@{kill_at}",
+            "--max-restarts", "3",
+        )
+        wall_s = time.monotonic() - t0
+        metrics = os.path.join(d, "run.jsonl")
+        out: dict = {
+            "mode": mode,
+            "kill_at_step": kill_at,
+            "supervisor_rc": proc.returncode,
+            "wall_s": round(wall_s, 3),
+        }
+        if proc.returncode != 0:
+            out["error"] = proc.stdout[-2000:]
+            return out
+        got = _losses(metrics)
+        missing = sorted(set(base_losses) - set(got))
+        mismatched = sorted(
+            s for s, v in base_losses.items() if s in got and got[s] != v
+        )
+        faults = [
+            r for r in _records(metrics, "fault") if r.get("event") == "crash"
+        ]
+        restarts = _records(metrics, "restart")
+        # Save boundaries: every DELTA_EVERY steps plus the epoch ends —
+        # the resumed child replays kill_at minus the last one before it.
+        boundaries = set(range(DELTA_EVERY, STEPS + 1, DELTA_EVERY))
+        boundaries.update(range(STEPS // EPOCHS, STEPS + 1, STEPS // EPOCHS))
+        last_save = max((s for s in boundaries if s <= kill_at), default=0)
+        out.update(
+            losses_bit_identical=not missing and not mismatched,
+            missing_steps=missing,
+            mismatched_steps=mismatched,
+            crashes=len(faults),
+            restarts=len(restarts),
+            replayed_steps=max(0, kill_at - last_save),
+            mttr_s=[r.get("mttr_s") for r in restarts],
+        )
+        return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--trials", type=int, default=3, metavar="N",
+                    help="chaos trials per path (seeded kill steps)")
+    ap.add_argument("--seed", type=int, default=1106)
+    ap.add_argument("--sharded", action="store_true",
+                    help="also run the dist_train (8-device CPU mesh) path")
+    ap.add_argument("--out", default=os.path.join(REPO, "PROBE_MTTR_r06.json"))
+    args = ap.parse_args(argv)
+
+    rng = random.Random(args.seed)
+    modes = ["train"] + (["dist_train"] if args.sharded else [])
+    result: dict = {
+        "steps_total": STEPS,
+        "delta_every_steps": DELTA_EVERY,
+        "seed": args.seed,
+        "paths": {},
+    }
+    ok = True
+    for mode in modes:
+        with tempfile.TemporaryDirectory(prefix="chaos-base-") as d:
+            _write_dataset(os.path.join(d, "t.libsvm"))
+            t0 = time.monotonic()
+            proc = _run(mode, _write_cfg(d))
+            if proc.returncode != 0:
+                print(proc.stdout[-2000:], file=sys.stderr)
+                print(f"chaos: {mode} baseline failed rc={proc.returncode}",
+                      file=sys.stderr)
+                return 1
+            base_wall = time.monotonic() - t0
+            base_losses = _losses(os.path.join(d, "run.jsonl"))
+        assert len(base_losses) == STEPS, (
+            f"baseline logged {len(base_losses)} steps, wanted {STEPS}"
+        )
+        trials = []
+        for _ in range(max(1, args.trials)):
+            kill_at = rng.randrange(4, STEPS - 3)
+            print(f"chaos: {mode} kill@{kill_at} ...", flush=True)
+            trials.append(_trial(mode, kill_at, base_losses))
+        mttrs = [
+            m for t in trials for m in t.get("mttr_s", [])
+            if isinstance(m, (int, float))
+        ]
+        path_ok = all(
+            t.get("supervisor_rc") == 0 and t.get("losses_bit_identical")
+            for t in trials
+        )
+        ok = ok and path_ok
+        result["paths"][mode] = {
+            "baseline_wall_s": round(base_wall, 3),
+            "trials": trials,
+            "mttr_s_median": round(statistics.median(mttrs), 3) if mttrs else None,
+            "mttr_s_max": round(max(mttrs), 3) if mttrs else None,
+            "all_losses_bit_identical": path_ok,
+        }
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"chaos: wrote {args.out} (ok={ok})")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -209,6 +209,39 @@ def summarize(records: list[dict]) -> dict:
         }
         for r in kinds.get("anomaly", [])
     ]
+    # Resilience layer: fault / restart records (resilience.py) plus
+    # rollback anomalies (on_nan = rollback recovery decisions).
+    s["rollbacks"] = sum(
+        1 for r in kinds.get("anomaly", []) if r.get("event") == "rollback"
+    )
+    faults = kinds.get("fault", [])
+    s["faults"] = len(faults)
+    s["fault_events"] = [
+        {
+            "step": r.get("step"),
+            "event": r.get("event"),
+            "exit_code": r.get("exit_code"),
+            "signal": r.get("signal"),
+            "what": r.get("what"),
+        }
+        for r in faults[:50]  # bounded: a retry storm must not bloat the report
+    ]
+    restarts = kinds.get("restart", [])
+    s["restarts"] = len(restarts)
+    s["restart_events"] = [
+        {
+            "attempt": r.get("attempt"),
+            "exit_code": r.get("exit_code"),
+            "backoff_s": r.get("backoff_s"),
+            "mttr_s": r.get("mttr_s"),
+        }
+        for r in restarts
+    ]
+    mttrs = [
+        r["mttr_s"] for r in restarts if isinstance(r.get("mttr_s"), (int, float))
+    ]
+    s["mttr_s_median"] = round(statistics.median(mttrs), 3) if mttrs else None
+    s["mttr_s_max"] = round(max(mttrs), 3) if mttrs else None
 
     ckpts = kinds.get("ckpt", [])
     s["ckpt_saves"] = len(ckpts)
@@ -360,6 +393,33 @@ def render(s: dict, title: str = "run") -> str:
             )
         )
     L.append("")
+    if s.get("faults") or s.get("restarts") or s.get("rollbacks"):
+        L += ["## Resilience", ""]
+        L.append(
+            f"- faults: {s['faults']}, restarts: {s['restarts']}, "
+            f"rollbacks: {s['rollbacks']}"
+        )
+        for e in s["fault_events"]:
+            detail = ", ".join(
+                f"{k}={v}"
+                for k, v in e.items()
+                if k not in ("step", "event") and v is not None
+            )
+            L.append(
+                f"  - step {e['step']}: fault {e['event']}"
+                + (f" ({detail})" if detail else "")
+            )
+        for e in s["restart_events"]:
+            L.append(
+                f"  - restart #{e['attempt']}: child rc {e['exit_code']}, "
+                f"backoff {e['backoff_s']}s, MTTR {e['mttr_s']}s"
+            )
+        if s.get("mttr_s_median") is not None:
+            L.append(
+                f"- MTTR (crash → first new progress): median "
+                f"{s['mttr_s_median']}s, max {s['mttr_s_max']}s"
+            )
+        L.append("")
     L += ["## Memory", ""]
     L.append(f"- host RSS peak: {_fmt_bytes(s['host_rss_peak_bytes'])}")
     L.append(f"- device live-buffer peak: {_fmt_bytes(s['device_peak_bytes'])}")
@@ -401,6 +461,9 @@ _GATE_METRICS = [
     ("steady_compiles", "steady-state compiles", False),
     ("stalls", "stalls", False),
     ("anomalies", "anomalies", False),
+    ("faults", "faults", False),
+    ("restarts", "restarts", False),
+    ("rollbacks", "rollbacks", False),
     ("host_rss_peak_bytes", "host RSS peak", False),
     ("device_peak_bytes", "device mem peak", False),
     ("ckpt_stall_share", "ckpt stall share", False),
@@ -451,6 +514,9 @@ def compare(run: dict, base: dict, threshold: float, strict: bool = False):
             ("steady_compiles", "steady-state compiles"),
             ("stalls", "stalls"),
             ("anomalies", "anomalies"),
+            ("faults", "faults"),
+            ("restarts", "restarts"),
+            ("rollbacks", "rollbacks"),
         ):
             if (run.get(key) or 0) > (base.get(key) or 0):
                 regressions.append(
@@ -549,7 +615,8 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--strict",
         action="store_true",
-        help="also fail on NEW steady-state compiles / stalls / anomalies",
+        help="also fail on NEW steady-state compiles / stalls / anomalies / "
+        "faults / restarts / rollbacks",
     )
     ap.add_argument("--out", metavar="PATH", help="write the report here instead of stdout")
     args = ap.parse_args(argv)
