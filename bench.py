@@ -1154,9 +1154,163 @@ def main():
         print(f"bench report skipped: {e!r}", file=sys.stderr)
 
 
+_DIST_WORKER = '''
+import sys
+pid, nproc, port, tmp, files = (
+    int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4], sys.argv[5]
+)
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+jax.distributed.initialize(f"127.0.0.1:{{port}}", num_processes=nproc, process_id=pid)
+
+from fast_tffm_tpu.config import Config
+from fast_tffm_tpu.training import dist_train
+
+cfg = Config(
+    model="fm", factor_num=8, vocabulary_size={vocab},
+    model_file=f"{{tmp}}/m.ckpt",
+    train_files=tuple(files.split(",")),
+    epoch_num=1, batch_size={batch}, max_nnz={nnz}, learning_rate=0.01,
+    log_every=4, metrics_path=f"{{tmp}}/run.jsonl",
+    input_assignment="files",
+    barrier_timeout_s=120,
+    hash_feature_id=True,  # the synthetic FMB files are written hashed
+)
+cfg.validate()
+dist_train(cfg, log=lambda m: print(f"[{{pid}}] {{m}}", flush=True))
+print(f"[{{pid}}] BENCH DONE", flush=True)
+'''
+
+
+def bench_dist(processes: int = 2, out_path: str | None = None) -> dict:
+    """The ``processes`` lever (ROADMAP item 1): a REAL multi-process CPU
+    pod — N OS processes, gloo collectives, shard-disjoint FMB file
+    assignment, host-local packed wire — measured through the production
+    ``dist_train`` driver.  Reports the aggregate global examples/sec
+    (every host trains the same global batch, so the lead's meter IS the
+    pod rate), per-host medians, and the steady-recompile pin.  Writes
+    ``BENCH_DIST_rNN.json`` when ``out_path`` is given."""
+    import socket
+    import subprocess
+    import sys
+    import tempfile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    vocab, rows, batch = 1 << 16, 1 << 15, 2048
+    files = [
+        ensure_scale_fmb(vocab, rows=rows, seed=7 + p) for p in range(processes)
+    ]
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    result: dict = {
+        "metric": (
+            f"dist_train global examples/sec ({processes}-process CPU pod, "
+            f"gloo, shard-disjoint FMB files, packed wire, batch {batch}, "
+            f"vocab {vocab}, nnz {NNZ})"
+        ),
+        "processes": processes,
+        "rows_per_host": rows,
+    }
+    with tempfile.TemporaryDirectory(prefix="bench-dist-") as tmp:
+        script = os.path.join(tmp, "worker.py")
+        with open(script, "w") as f:
+            f.write(_DIST_WORKER.format(repo=repo, vocab=vocab, batch=batch, nnz=NNZ))
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        procs = [
+            subprocess.Popen(
+                [sys.executable, script, str(p), str(processes), str(port), tmp,
+                 ",".join(files)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+            )
+            for p in range(processes)
+        ]
+        outs = [p.communicate(timeout=900)[0] for p in procs]
+        failed = [
+            (p, out)
+            for p, (proc, out) in enumerate(zip(procs, outs))
+            if proc.returncode != 0
+        ]
+        if failed:
+            result["dist_error"] = failed[0][1][-800:]
+            result["value"] = None
+            if out_path:
+                with open(out_path, "w") as f:
+                    json.dump(result, f, indent=1, sort_keys=True)
+                    f.write("\n")
+            print(json.dumps(result))
+            return result
+        import json as _json
+
+        def _metrics(path):
+            recs = []
+            try:
+                with open(path) as f:
+                    recs = [_json.loads(line) for line in f]
+            except OSError:
+                pass
+            return recs
+
+        per_host = {}
+        for p in range(processes):
+            path = os.path.join(tmp, "run.jsonl" if p == 0 else f"run.p{p}.jsonl")
+            recs = _metrics(path)
+            rates = [
+                r["examples_per_sec"] for r in recs if r.get("kind") == "train"
+            ]
+            steady = sum(
+                r.get("compiles", 0)
+                for r in recs
+                if r.get("kind") == "compile" and not r.get("warmup")
+            )
+            wire = [
+                r["wire_bytes_per_step"]
+                for r in recs
+                if r.get("kind") == "input"
+                and isinstance(r.get("wire_bytes_per_step"), (int, float))
+            ]
+            per_host[str(p)] = {
+                "examples_per_sec_median": (
+                    round(float(np.median(rates)), 1) if rates else None
+                ),
+                "steady_recompiles": steady,
+                "wire_bytes_per_step": int(np.median(wire)) if wire else None,
+            }
+        lead = per_host.get("0", {})
+        result["value"] = lead.get("examples_per_sec_median")
+        result["unit"] = "examples/sec (global)"
+        result["per_host"] = per_host
+        result["steady_recompiles_total"] = sum(
+            h["steady_recompiles"] for h in per_host.values()
+        )
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=1, sort_keys=True)
+            f.write("\n")
+    print(json.dumps(result))
+    return result
+
+
 if __name__ == "__main__":
     import sys as _sys
 
     if len(_sys.argv) == 3 and _sys.argv[1] == "--probe-rung":
         _probe_rung(int(_sys.argv[2]))
+    if len(_sys.argv) >= 2 and _sys.argv[1] == "--dist":
+        # The processes lever runs standalone (it spawns its own pod and
+        # never touches this process's jax backend): `python bench.py
+        # --dist [N] [OUT.json]`.
+        _n = int(_sys.argv[2]) if len(_sys.argv) > 2 else int(
+            os.environ.get("BENCH_PROCESSES", "2")
+        )
+        _out = _sys.argv[3] if len(_sys.argv) > 3 else os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_DIST_r07.json"
+        )
+        _watchdog = arm_hang_exit(1200.0, what="bench --dist")
+        bench_dist(_n, _out)
+        _watchdog.cancel()
+        _sys.exit(0)
     main()

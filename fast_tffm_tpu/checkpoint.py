@@ -57,6 +57,15 @@ __all__ = [
 DEFAULT_CHUNK_BYTES = 64 << 20
 
 
+def _maybe_publish_fault(path: str) -> None:
+    """Chaos hook (resilience.FaultPlan ``kill_publish@K``): SIGKILL the
+    writer between finishing the tmp file and the atomic rename — the
+    torn-publish-under-kill window the pod failover tests exercise."""
+    from fast_tffm_tpu.resilience import maybe_publish_fault
+
+    maybe_publish_fault(path)
+
+
 def _torn_error(path: str, what: str, exc: Exception) -> ValueError:
     """Torn/truncated checkpoint files must fail LOUDLY with the file
     named — a partial npz that half-parses could otherwise restore
@@ -277,6 +286,12 @@ def _save_npz(
             os.remove(dp)
         except OSError:
             pass
+    # Chaos injection point: a planned kill_publish fault SIGKILLs the
+    # writer HERE — tmp fully written, rename not yet issued — the exact
+    # window a real crash-during-publish leaves behind.  The atomic
+    # os.replace below is why that window is safe: the old head (and the
+    # old chain, already unlinked above for fulls) stays loadable.
+    _maybe_publish_fault(path)
     os.replace(tmp, path)
     return nbytes
 
@@ -376,6 +391,9 @@ def save_delta(
     tmp = out + ".tmp"
     with open(tmp, "wb") as f:
         nbytes = _write_npz_streaming(f, entries, chunk_bytes, timings)
+    # Same crash window as the full save's: kill-before-rename leaves a
+    # tmp file and an unchanged chain head (see _save_npz).
+    _maybe_publish_fault(out)
     os.replace(tmp, out)
     return out, sid, nbytes
 

@@ -29,6 +29,14 @@ Every save emits a ``kind=ckpt`` telemetry record (snapshot/convert/D2H/
 write timings, bytes, rows, train-loop stall) through the RunMonitor, so
 ``tools/report.py`` can render checkpoint stall share next to the
 input-vs-compute split.
+
+On a multi-process pod (a ``distributed.DistributedRuntime`` supplied by
+the driver) the npz format runs the SINGLE-WRITER protocol: process 0
+alone publishes full+delta files and posts each publish's content
+signature to the pod KV store; every other host synchronizes on those
+signatures and mirrors the chain bookkeeping from the published
+outcomes (DESIGN.md "Distributed runtime", crash-consistency invariant
+6).  Orbax saves stay collective — every host writes its own shards.
 """
 
 from __future__ import annotations
@@ -152,6 +160,8 @@ class AsyncCheckpointer:
         mark_fn=None,
         start_step: int = 0,
         cursor_fn=None,
+        runtime=None,
+        mesh=None,
     ):
         self._path = path
         self._fmt = fmt
@@ -165,6 +175,30 @@ class AsyncCheckpointer:
         self._lock = threading.Lock()
         self._thread: threading.Thread | None = None
         self._last_boundary_step = int(start_step)
+        # Multi-host single-writer protocol (distributed.DistributedRuntime,
+        # npz format only — orbax saves stay collective, every host writes
+        # its own shards): process 0 is the SOLE publisher; after every
+        # publish it posts the content signature to the pod KV store, and
+        # every other host synchronizes on that signature — immediately
+        # for synchronous saves, at the NEXT boundary for async/delta ones
+        # (exactly mirroring the lead's own one-in-flight back-pressure).
+        # No host passes a save barrier before the signature it observed
+        # is durable (DESIGN.md crash-consistency invariant 6).  Boundary
+        # ordinals (_seq) advance identically on every host — boundaries
+        # are step-deterministic — so the KV keys line up by construction.
+        self._rt = runtime if (runtime is not None and runtime.active) else None
+        self._lead_writer = self._rt is not None and fmt == "npz"
+        self._is_writer = self._rt is None or self._rt.is_lead
+        self._seq = 0
+        self._pending_await: int | None = None
+        self._mesh = mesh
+        self._replicate = None
+        if self._lead_writer and mesh is not None:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            rep = NamedSharding(mesh, PartitionSpec())
+            self._replicate = jax.jit(lambda x: x, out_shardings=rep)
         # Exact-position resume: ``cursor_fn()`` (supplied by the driver)
         # names the input position matching the state at a boundary; the
         # dict is captured ON THE LOOP SIDE at each boundary — the writer
@@ -235,6 +269,17 @@ class AsyncCheckpointer:
     def _fresh_bitmap(self):
         import jax.numpy as jnp
 
+        if self._replicate is not None:
+            # Multi-host: the bitmap must be a GLOBAL replicated array so
+            # the mark dispatch (global sharded ids in) and the boundary
+            # fetch (host read) are well-defined on every pod host.
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            return jax.device_put(
+                np.zeros((self._vocab,), bool),
+                NamedSharding(self._mesh, PartitionSpec()),
+            )
         return jnp.zeros((self._vocab,), bool)
 
     def _cursor(self) -> dict | None:
@@ -245,23 +290,116 @@ class AsyncCheckpointer:
         except Exception:
             return None  # a cursor bug must never cost the checkpoint
 
+    # -- multi-host protocol ----------------------------------------------
+
+    def _bump_seq(self) -> int:
+        self._seq += 1
+        return self._seq
+
+    def _merged_cursor(self, bseq: int) -> dict | None:
+        """The cursor this boundary embeds.  Multi-host: every host posts
+        its own cursor to the pod KV store; the LEAD gathers the vector
+        and embeds it — ``hosts[p]`` names host p's exact input position,
+        travelling inside the same atomic publish as the state (the PR-6
+        invariant, now per host)."""
+        cursor = self._cursor()
+        if self._rt is None or self._cursor_fn is None:
+            return cursor
+        vec = self._rt.share_cursor(bseq, cursor)
+        if vec is None:  # non-lead: posted ours, nothing to embed
+            return cursor
+        merged = dict(cursor or {})
+        merged["process_count"] = self._rt.process_count
+        merged["hosts"] = [
+            {
+                "process": p,
+                "epoch": (c or {}).get("epoch"),
+                "batch_in_epoch": (c or {}).get("batch_in_epoch"),
+            }
+            for p, c in enumerate(vec)
+        ]
+        return merged
+
+    def _publish_outcome(self, bseq: int, sig: str | None, meta: str) -> None:
+        """Lead: post boundary ``bseq``'s publish outcome (sig durable on
+        disk, or meta="failed") so peers can synchronize + mirror the
+        chain state.  Runs in whatever thread published (writer thread
+        for async/delta)."""
+        if not self._lead_writer or not self._is_writer:
+            return
+        try:
+            self._rt.publish_signature(bseq, sig, meta)
+        except Exception as e:
+            # A dead KV store means the pod is coming apart; peers will
+            # surface it as PeerLostError — log, never kill the writer.
+            try:
+                self._log(f"checkpoint signature publish failed: {e!r}")
+            except Exception:
+                pass
+
+    def _apply_outcome(self, out: dict | None) -> None:
+        """Non-lead chain-state mirror: fold one awaited publish outcome
+        into (_parent_sig, _chain_len) so the promote-to-full decision —
+        which every host must take identically — tracks the lead's."""
+        if out is None:
+            return
+        sig, meta = out.get("sig"), out.get("meta")
+        with self._lock:
+            if meta == "full" and sig:
+                self._parent_sig = sig
+                self._next_seq = 1
+                self._chain_len = 0
+            elif meta == "delta" and sig:
+                self._parent_sig = sig
+                self._next_seq += 1
+                self._chain_len += 1
+            else:  # failed write: mirror the lead's promote-to-full reset
+                self._parent_sig = None
+
+    def _await_pending(self, count: bool = False) -> None:
+        """Non-lead back-pressure point: block until the previous
+        outstanding publish's signature is durable (the save barrier —
+        mirrors the lead's own one-writer-in-flight drain)."""
+        if self._pending_await is None:
+            return
+        t0 = time.perf_counter()
+        bseq, self._pending_await = self._pending_await, None
+        self._apply_outcome(self._rt.await_signature(bseq))
+        blocked = (time.perf_counter() - t0) * 1e3
+        if count and blocked > 1.0:
+            self.blocked_boundaries += 1
+            self.blocked_ms += blocked
+
     # -- boundaries -------------------------------------------------------
 
     def save_boundary(self, state, saveable, step: int, *, sync: bool = False, emit: bool = True):
         """Full save.  Async (snapshot + writer thread) unless ``sync`` or
-        the format/flags demand the blocking path."""
+        the format/flags demand the blocking path.  Multi-host npz: the
+        packed→logical conversion (a cross-host collective on sharded
+        states) is dispatched by EVERY host; only process 0 writes, then
+        posts the content signature every other host synchronizes on."""
         t0 = time.perf_counter()
         self._drain(count=True)
+        self._await_pending(count=True)
         if self._delta_every > 0:
             # A full save supersedes the accumulated window either way.
             self._bitmap = self._fresh_bitmap() if self._bitmap is not None else None
             self._last_boundary_step = int(step)
-        cursor = self._cursor()
+        bseq = self._bump_seq()
+        cursor = self._merged_cursor(bseq)
         if sync or not self._async:
             sid = uuid.uuid4().hex
             timings: dict = {}
+            # Every host dispatches the conversion (collective on
+            # multi-host sharded states; the lead's write below consumes
+            # the replicated result).
             logical = saveable(state)
             t1 = time.perf_counter()
+            if self._lead_writer and not self._is_writer:
+                # Save barrier: do not proceed until the signature the
+                # lead published is durable on the shared filesystem.
+                self._apply_outcome(self._rt.await_signature(bseq))
+                return
             try:
                 nbytes = save_checkpoint(
                     self._path, logical, self._fmt,
@@ -270,8 +408,10 @@ class AsyncCheckpointer:
                 )
             except Exception:
                 self.write_failures += 1
+                self._publish_outcome(bseq, None, "failed")
                 raise  # a SYNC save failing must surface — it is the last line
             self._on_full_published(sid)
+            self._publish_outcome(bseq, sid, "full")
             self.sync_saves += 1
             stall = (time.perf_counter() - t0) * 1e3
             if emit:
@@ -284,28 +424,51 @@ class AsyncCheckpointer:
                     train_stall_ms=stall,
                 )
             return
-        snap = device_snapshot(state)
+        if self._lead_writer:
+            # Multi-host async: snapshot + conversion dispatched loop-side
+            # by every host together (collectives cannot be issued from
+            # one host's writer thread alone); the writer thread only
+            # waits, fetches, and writes.
+            snap = saveable(device_snapshot(state))
+            convert = None
+        else:
+            snap = device_snapshot(state)
+            convert = saveable
+        if self._lead_writer and not self._is_writer:
+            del snap  # the collective still runs; the result is the lead's
+            self._pending_await = bseq
+            return
         sid = uuid.uuid4().hex
         stall_ms = (time.perf_counter() - t0) * 1e3
         self._spawn(
-            self._write_full, (snap, saveable, int(step), sid, stall_ms, emit, cursor)
+            self._write_full,
+            (snap, convert, int(step), sid, stall_ms, emit, cursor, bseq),
         )
 
     def delta_boundary(self, state, saveable, step: int):
         """Delta save of the touched window; promotes itself to a full
-        save when there is no signed base yet or the chain hit its cap."""
+        save when there is no signed base yet or the chain hit its cap.
+        Multi-host npz: the bitmap fetch and the row gather are global
+        computations every host dispatches; only the lead writes."""
         t0 = time.perf_counter()
         self._drain(count=True)
+        self._await_pending(count=True)
         if self._parent_sig is None or self._chain_len >= self._chain_max:
             return self.save_boundary(state, saveable, step)
         import jax.numpy as jnp
 
+        bseq = self._bump_seq()
         if self._bitmap is not None:
             # Pack to bits ON DEVICE before the fetch: the loop-side D2H
             # is V/8 bytes instead of one bool byte per vocab row (~25 MB
             # vs ~200 MB at the 201M rung — this transfer is train stall).
+            bm = self._bitmap
+            if self._replicate is not None:
+                # Normalize to a replicated (fully addressable) layout so
+                # the host fetch below works on every pod host.
+                bm = self._replicate(bm)
             host_bm = np.unpackbits(
-                np.asarray(jnp.packbits(self._bitmap)), count=self._vocab
+                np.asarray(jnp.packbits(bm)), count=self._vocab
             ).astype(bool)
         else:
             host_bm = np.zeros((self._vocab,), bool)
@@ -319,18 +482,25 @@ class AsyncCheckpointer:
         pad_idx = np.zeros((k,), np.int32)
         pad_idx[:n] = idx
         trows, arows = self._gather(state, jnp.asarray(pad_idx))
+        if self._replicate is not None:
+            trows, arows = self._replicate(trows), self._replicate(arows)
         import jax
 
         dense = [_device_copy(x) for x in jax.tree.leaves(state.dense)]
         dacc = [_device_copy(x) for x in jax.tree.leaves(state.dense_opt.accum)]
         step_arr = _device_copy(state.step)
         seq, parent = self._next_seq, self._parent_sig
-        cursor = self._cursor()
+        cursor = self._merged_cursor(bseq)
+        if self._lead_writer and not self._is_writer:
+            # The gather/copies above were this host's share of the
+            # collective dispatch; the write itself is the lead's.
+            self._pending_await = bseq
+            return
         stall_ms = (time.perf_counter() - t0) * 1e3
         self._spawn(
             self._write_delta,
             (seq, parent, idx, n, trows, arows, dense, dacc, step_arr, int(step),
-             stall_ms, cursor),
+             stall_ms, cursor, bseq),
         )
 
     # -- writer thread ----------------------------------------------------
@@ -361,17 +531,23 @@ class AsyncCheckpointer:
 
     def finalize(self) -> None:
         """Join any in-flight write — called before the final synchronous
-        save so an older async publish can never clobber a newer one."""
+        save so an older async publish can never clobber a newer one.
+        Non-lead pod hosts drain their outstanding signature wait the
+        same way."""
         self._drain()
+        self._await_pending()
 
-    def _write_full(self, snap, saveable, step, sid, stall_ms, emit, cursor=None) -> None:
+    def _write_full(self, snap, saveable, step, sid, stall_ms, emit, cursor=None, bseq=0) -> None:
         import jax
 
         try:
             t0 = time.perf_counter()
             # Packed->logical conversion runs HERE, against the snapshot,
-            # entirely off the train loop.
-            snap = saveable(snap)
+            # entirely off the train loop.  (Multi-host: the conversion is
+            # a collective, already dispatched loop-side by every host —
+            # saveable arrives as None and this thread only waits.)
+            if saveable is not None:
+                snap = saveable(snap)
             jax.block_until_ready(snap)
             convert_ms = (time.perf_counter() - t0) * 1e3
             timings: dict = {}
@@ -381,6 +557,7 @@ class AsyncCheckpointer:
                 cursor=cursor,
             )
             self._on_full_published(sid)
+            self._publish_outcome(bseq, sid, "full")
             self.full_saves += 1
             if emit:
                 self._emit(
@@ -392,6 +569,7 @@ class AsyncCheckpointer:
         except Exception as e:
             self.write_failures += 1
             self._on_write_failed()
+            self._publish_outcome(bseq, None, "failed")
             try:
                 self._log(f"async checkpoint write failed (previous checkpoint intact): {e!r}")
             except Exception:
@@ -399,7 +577,7 @@ class AsyncCheckpointer:
 
     def _write_delta(
         self, seq, parent, idx, n, trows, arows, dense, dacc, step_arr, step,
-        stall_ms, cursor=None,
+        stall_ms, cursor=None, bseq=0,
     ) -> None:
         import jax
 
@@ -433,6 +611,7 @@ class AsyncCheckpointer:
                 self._parent_sig = sid
                 self._next_seq = seq + 1
                 self._chain_len += 1
+            self._publish_outcome(bseq, sid, "delta")
             self.delta_saves += 1
             timings["d2h_ms"] = timings.get("d2h_ms", 0.0) + d2h_ms
             self._emit(
@@ -443,6 +622,7 @@ class AsyncCheckpointer:
         except Exception as e:
             self.write_failures += 1
             self._on_write_failed()
+            self._publish_outcome(bseq, None, "failed")
             try:
                 self._log(f"delta checkpoint write failed (chain intact): {e!r}")
             except Exception:

@@ -74,6 +74,13 @@ def main(argv: list[str] | None = None) -> int:
         "--fault-horizon", type=int, default=1000, metavar="STEPS",
         help="step horizon random: fault plans draw positions from",
     )
+    ap.add_argument(
+        "--fault-process", type=int, default=0, metavar="P",
+        help="pod-supervised dist_train only: arm --fault-plan on host P "
+        "(default 0, the checkpoint writer; -1 = every host — e.g. nan "
+        "faults, which each host must observe) — the writer-kill vs "
+        "survivor-kill axis of the pod chaos matrix",
+    )
     args = ap.parse_args(argv)
 
     from fast_tffm_tpu.utils.platform import apply_platform_env
@@ -134,6 +141,46 @@ def main(argv: list[str] | None = None) -> int:
             else pkg_root
         )
 
+        if args.mode == "dist_train" and cfg.num_processes > 1:
+            # POD supervision: one supervisor process owns N local trainer
+            # children (one per pod host), the shared generation file, and
+            # the single-host-relaunch recovery protocol (distributed.py).
+            # A chaos plan arms on --fault-process's first launch only.
+            runtime_dir = cfg.runtime_dir or (cfg.model_file + ".dist")
+
+            def build_pod_cmd(attempt: int, resume_flag: bool, proc: int) -> list[str]:
+                cmd = list(base)
+                if resume_flag:
+                    cmd += ["--resume"]
+                if args.fault_plan and attempt == 0 and (
+                    proc == args.fault_process or args.fault_process < 0
+                ):
+                    cmd += [
+                        "--fault-plan", args.fault_plan,
+                        "--fault-seed", str(args.fault_seed),
+                        "--fault-horizon", str(args.fault_horizon),
+                    ]
+                return cmd
+
+            sup = Supervisor(
+                build_pod_cmd,
+                model_file=cfg.model_file,
+                max_restarts=(
+                    args.max_restarts if args.max_restarts is not None else cfg.restart_max
+                ),
+                backoff_s=cfg.restart_backoff_s,
+                backoff_max_s=cfg.restart_backoff_max_s,
+                metrics_path=cfg.metrics_path or None,
+                run_id=cfg.telemetry_run_id,
+                log=lambda *a: print(*a, file=sys.stderr),
+                child_log=print,
+                env=child_env,
+                processes=cfg.num_processes,
+                runtime_dir=runtime_dir,
+                straggler_timeout_s=cfg.host_stall_timeout_s,
+            )
+            return sup.run(resume=args.resume)
+
         def build_cmd(attempt: int, resume_flag: bool) -> list[str]:
             cmd = list(base)
             if resume_flag:
@@ -183,7 +230,48 @@ def main(argv: list[str] | None = None) -> int:
     elif args.mode == "dist_train":
         from fast_tffm_tpu.training import dist_train
 
-        dist_train(cfg, resume=args.resume, step_hook=step_hook)
+        try:
+            dist_train(cfg, resume=args.resume, step_hook=step_hook)
+        except Exception as e:
+            from fast_tffm_tpu.resilience import NonFiniteLossError
+
+            if isinstance(e, NonFiniteLossError):
+                raise  # a shared, deterministic decision — never peer loss
+            import os
+            import time as _time
+
+            from fast_tffm_tpu.distributed import (
+                ENV_GENERATION,
+                ENV_RUNTIME_DIR,
+                PEER_LOST_EXIT,
+                read_generation,
+            )
+
+            gen_env = os.environ.get(ENV_GENERATION)
+            rdir = os.environ.get(ENV_RUNTIME_DIR)
+            if gen_env is None or not rdir:
+                raise
+            # Pod child: an escaping error here is USUALLY collateral of a
+            # peer dying (gloo/coordination errors surface as generic
+            # runtime errors).  Dying now would turn one host's crash into
+            # N relaunches, so PARK: the supervisor's generation bump
+            # re-execs this process via the watcher thread mid-sleep.  If
+            # no bump arrives, the failure was ours alone — re-raise it.
+            print(
+                f"dist_train failed ({e!r}); parking for a pod generation "
+                "bump (peer crash?) before giving up",
+                file=sys.stderr,
+            )
+            deadline = _time.monotonic() + min(30.0, cfg.barrier_timeout_s)
+            while _time.monotonic() < deadline:
+                _time.sleep(0.25)
+            info = read_generation(rdir)
+            if info is not None and int(info.get("generation", -1)) > int(gen_env):
+                # Bump landed but the watcher lost the exec race — die
+                # with the collateral code; the supervisor relaunches us
+                # into the current generation.
+                return PEER_LOST_EXIT
+            raise
     elif args.mode == "predict":
         from fast_tffm_tpu.prediction import predict
 

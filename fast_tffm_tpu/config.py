@@ -157,6 +157,22 @@ class Config:
     coordinator_address: str = ""  # multi-host: host:port of process 0
     num_processes: int = 0  # multi-host: total process count
     process_id: int = -1  # multi-host: this process's index
+    input_assignment: str = "rows"  # multi-host streamed input split: rows
+    #   (block-cyclic line sharding of every file — the historical mode) |
+    #   files (shard-disjoint file assignment: host p streams files
+    #   [p::P] whole, so each host touches only its own files; short
+    #   hosts pad the epoch tail with weight-0 batches)
+    runtime_dir: str = ""  # shared coordination dir for the pod runtime
+    #   (heartbeats, generation file, file-KV fallback); "" = off for
+    #   plain runs, defaults to <model_file>.dist under the pod
+    #   supervisor (dist_train --supervised with num_processes > 1)
+    heartbeat_s: float = 2.0  # per-host heartbeat cadence into runtime_dir
+    host_stall_timeout_s: float = 0.0  # peer-heartbeat staleness that
+    #   classifies a host-level kind=stall (host-heartbeat-lost); the pod
+    #   supervisor also uses it for straggler kills (0 = monitor off)
+    barrier_timeout_s: float = 120.0  # cross-process barrier / signature
+    #   / cursor-gather wait budget; a timeout means a peer is gone
+    #   (PeerLostError -> exit PEER_LOST_EXIT under the supervisor)
 
     def validate(self) -> "Config":
         if self.model not in ("fm", "ffm", "deepfm"):
@@ -305,6 +321,21 @@ class Config:
         if self.restart_backoff_s < 0 or self.restart_backoff_max_s < 0:
             raise ValueError(
                 "restart_backoff_s and restart_backoff_max_s must be >= 0"
+            )
+        if self.input_assignment not in ("rows", "files"):
+            raise ValueError(
+                f"unknown input_assignment {self.input_assignment!r} (rows | files)"
+            )
+        if self.heartbeat_s <= 0:
+            raise ValueError(f"heartbeat_s must be > 0, got {self.heartbeat_s}")
+        if self.host_stall_timeout_s < 0:
+            raise ValueError(
+                f"host_stall_timeout_s must be >= 0 (0 = off), got "
+                f"{self.host_stall_timeout_s}"
+            )
+        if self.barrier_timeout_s <= 0:
+            raise ValueError(
+                f"barrier_timeout_s must be > 0, got {self.barrier_timeout_s}"
             )
         if self.telemetry_mem_every_s < 0 or self.telemetry_stall_timeout_s < 0:
             raise ValueError(
@@ -511,6 +542,13 @@ def load_config(path: str) -> Config:
     cfg.coordinator_address = get(d, "coordinator_address", str, cfg.coordinator_address)
     cfg.num_processes = get(d, "num_processes", int, cfg.num_processes)
     cfg.process_id = get(d, "process_id", int, cfg.process_id)
+    cfg.input_assignment = get(d, "input_assignment", str, cfg.input_assignment).lower()
+    cfg.runtime_dir = get(d, "runtime_dir", str, cfg.runtime_dir)
+    cfg.heartbeat_s = get(d, "heartbeat_s", float, cfg.heartbeat_s)
+    cfg.host_stall_timeout_s = get(
+        d, "host_stall_timeout_s", float, cfg.host_stall_timeout_s
+    )
+    cfg.barrier_timeout_s = get(d, "barrier_timeout_s", float, cfg.barrier_timeout_s)
 
     return cfg.validate()
 

@@ -68,6 +68,9 @@ __all__ = [
     "make_sharded_predict_step",
     "make_global_batch",
     "make_global_superbatch",
+    "make_replicator",
+    "local_mesh_devices",
+    "WireGlobalConverter",
 ]
 
 
@@ -133,6 +136,116 @@ def make_global_superbatch(mesh: Mesh, parsed_seq, w_seq, *, with_fields: bool =
         fields=mk(mat, fields),
         weights=mk(vec, np.stack([np.asarray(w) for w in w_seq])),
     )
+
+
+def make_replicator(mesh: Mesh):
+    """Jitted identity gathering a (sharded) pytree to a fully-replicated
+    layout — every process ends up holding the complete arrays.  This is
+    what makes the npz single-writer checkpoint protocol possible on a
+    multi-host pod: the sharded state replicates (one collective), then
+    process 0 alone streams it to disk.  The memory bill is the full
+    logical table per host, so it is the MODEST-table path — orbax stays
+    the answer where the table exceeds one host (DESIGN §8)."""
+    rep = NamedSharding(mesh, P())
+    # ONE jitted identity per tree structure: a fresh jit per call would
+    # recompile at every save boundary (a steady-state recompile the
+    # telemetry sentinel rightly flags).
+    cache: dict = {}
+
+    def _replicate(tree):
+        leaves, treedef = jax.tree.flatten(tree)
+        fn = cache.get(treedef)
+        if fn is None:
+            fn = jax.jit(lambda *ls: ls, out_shardings=rep)
+            cache[treedef] = fn
+        return jax.tree.unflatten(treedef, fn(*leaves))
+
+    return _replicate
+
+
+def local_mesh_devices(mesh: Mesh) -> list:
+    """This process's devices in GLOBAL mesh order, verified contiguous.
+
+    The batch dim shards over (data, row) in mesh-flat order, so process
+    p's addressable slice of a global batch is rows
+    [p·B/P, (p+1)·B/P) exactly when its devices form one contiguous run
+    of ``mesh.devices.flat`` — the layout make_mesh produces from jax's
+    process-major device order, and the same assumption make_global_batch
+    documents.  Raises loudly on exotic layouts rather than silently
+    scrambling rows."""
+    flat = list(mesh.devices.flat)
+    pid = jax.process_index()
+    idxs = [i for i, d in enumerate(flat) if d.process_index == pid]
+    if not idxs or idxs != list(range(idxs[0], idxs[0] + len(idxs))):
+        raise ValueError(
+            "this process's devices are not contiguous in the mesh — "
+            "host-local wire staging needs the process-contiguous layout "
+            "make_mesh produces (use wire_format = arrays here)"
+        )
+    return [flat[i] for i in idxs]
+
+
+class WireGlobalConverter:
+    """Host-local packed-wire staging for the multi-host streamed path.
+
+    Each host packs ITS local rows of every global (super)batch into one
+    coalesced wire buffer, unpacks it on its own devices (PR 3's packed
+    wire — per-host by construction), then donates the per-device shards
+    straight into a global jax.Array (``make_array_from_single_device_
+    arrays``) — the multi-host analog of make_global_batch with ~2-3×
+    fewer H2D bytes per host and zero cross-host data movement.
+
+    ``to_batch``-compatible (wraps data/wire.WireConverter, so the wire
+    byte accounting feeds kind=input records unchanged).
+    """
+
+    def __init__(self, mesh: Mesh, spec, verify_ids: bool = True):
+        import numpy as np
+
+        from fast_tffm_tpu.data.wire import WireConverter
+
+        self._mesh = mesh
+        self._wire = WireConverter(spec, verify_ids)
+        self._local_devs = local_mesh_devices(mesh)
+        self._lmesh = Mesh(
+            np.asarray(self._local_devs).reshape(len(self._local_devs)), ("b",)
+        )
+        self._nproc = jax.process_count()
+
+    # WireConverter duck-type (training's InputStats reads these).
+    @property
+    def last_nbytes(self):
+        return self._wire.last_nbytes
+
+    @property
+    def wire_bytes(self):
+        return self._wire.wire_bytes
+
+    @property
+    def calls(self):
+        return self._wire.calls
+
+    def _globalize_leaf(self, x, batch_axis: int):
+        lspec = [None] * x.ndim
+        lspec[batch_axis] = "b"
+        gspec = [None] * x.ndim
+        gspec[batch_axis] = (DATA_AXIS, ROW_AXIS)
+        lx = jax.device_put(x, NamedSharding(self._lmesh, P(*lspec)))
+        by_dev = {s.device: s.data for s in lx.addressable_shards}
+        gshape = list(x.shape)
+        gshape[batch_axis] *= self._nproc
+        return jax.make_array_from_single_device_arrays(
+            tuple(gshape),
+            NamedSharding(self._mesh, P(*gspec)),
+            [by_dev[d] for d in self._local_devs],
+        )
+
+    def __call__(self, parsed, w):
+        local = self._wire(parsed, w)  # local-device Batch ([B] or [K, B])
+        batch_axis = 1 if isinstance(parsed, list) else 0
+        return jax.tree.map(
+            lambda x: self._globalize_leaf(x, batch_axis), local
+        )
 
 
 def _state_specs():

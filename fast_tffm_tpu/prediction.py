@@ -260,9 +260,9 @@ def dist_predict(cfg: Config, log=print, mesh=None) -> str:
         make_mesh,
         make_sharded_predict_step,
     )
-    from fast_tffm_tpu.parallel.multihost import maybe_initialize_distributed
+    from fast_tffm_tpu.distributed import initialize_runtime
 
-    maybe_initialize_distributed(cfg.coordinator_address, cfg.num_processes, cfg.process_id)
+    initialize_runtime(cfg, log=log)
     model = build_model(cfg)
     max_nnz = scan_max_nnz(cfg)
     if mesh is None:

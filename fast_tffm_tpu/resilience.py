@@ -59,6 +59,7 @@ __all__ = [
     "clear_faults",
     "maybe_io_fault",
     "maybe_torn_delta",
+    "maybe_publish_fault",
     "note_io_retry",
     "drain_fault_events",
     "drain_fault_counters",
@@ -127,11 +128,16 @@ def note_io_retry(what: str, exc: Exception, attempt: int = 1) -> None:
 # fault plan / injector
 # ---------------------------------------------------------------------------
 
-FAULT_KINDS = ("kill", "io_error", "nan", "torn_delta")
+# kill_publish appends LAST: the seeded grammar draws positions in
+# FAULT_KINDS order, so inserting it earlier would silently reshuffle
+# every existing seed's schedule (byte-identity is test-pinned).
+FAULT_KINDS = ("kill", "io_error", "nan", "torn_delta", "kill_publish")
 
 # Which ordinal each kind's ``@N`` counts (documented here, enforced by
 # the injection points): kill/nan = absolute training step; io_error =
-# Nth FMB read operation; torn_delta = Kth delta-file write.
+# Nth FMB read operation; torn_delta = Kth delta-file write; kill_publish
+# = Kth npz publish (full or delta, in publish order) — SIGKILL between
+# the finished tmp write and the atomic rename, the torn-publish window.
 
 
 class FaultPlan:
@@ -192,7 +198,13 @@ class FaultPlan:
             # must not depend on dict/spec ordering.
             for kind in FAULT_KINDS:
                 for _ in range(counts.get(kind, 0)):
-                    hi = max(2, horizon // 50) if kind == "torn_delta" else max(2, horizon)
+                    # Per-write/publish ordinals are small numbers; step
+                    # ordinals span the horizon.
+                    hi = (
+                        max(2, horizon // 50)
+                        if kind in ("torn_delta", "kill_publish")
+                        else max(2, horizon)
+                    )
                     events.append({"kind": kind, "at": rng.randrange(1, hi)})
             return cls(events, spec=spec, seed=seed)
         events = []
@@ -251,8 +263,12 @@ class FaultInjector:
         )
         self._io = {e["at"] for e in plan.events if e["kind"] == "io_error"}
         self._torn = {e["at"] for e in plan.events if e["kind"] == "torn_delta"}
+        self._kill_publish = {
+            e["at"] for e in plan.events if e["kind"] == "kill_publish"
+        }
         self._io_ops = 0
         self._delta_writes = 0
+        self._publishes = 0
 
     # -- step-hook faults (loop thread) -----------------------------------
 
@@ -302,6 +318,21 @@ class FaultInjector:
             raise OSError(f"injected transient IO fault (op #{n}, {what})")
 
     # -- checkpoint faults (writer thread) --------------------------------
+
+    def on_publish(self, path: str) -> None:
+        """Called by the npz writers between finishing the tmp file and
+        the atomic rename; SIGKILLs the process on the Kth publish — a
+        crash in the exact window where a non-atomic publish would tear.
+        The chain head on disk must stay loadable (test-pinned)."""
+        with self._lock:
+            self._publishes += 1
+            n = self._publishes
+            due = n in self._kill_publish
+            if due:
+                self._kill_publish.discard(n)
+        if due:
+            _record({"event": "injected_kill_publish", "publish": n, "path": path})
+            os.kill(os.getpid(), signal.SIGKILL)
 
     def on_delta_write(self, path: str) -> None:
         """Called after each delta-file publish; truncates the Kth one to
@@ -360,6 +391,14 @@ def maybe_torn_delta(path: str) -> None:
     inj = _ACTIVE
     if inj is not None:
         inj.on_delta_write(path)
+
+
+def maybe_publish_fault(path: str) -> None:
+    """npz-publish injection point, called between the tmp write and the
+    atomic rename (no-op unless a plan is armed)."""
+    inj = _ACTIVE
+    if inj is not None:
+        inj.on_publish(path)
 
 
 # ---------------------------------------------------------------------------
@@ -473,6 +512,22 @@ class Supervisor:
     ``kind=restart`` per relaunch carrying the backoff used and the
     measured MTTR; the close summary totals restarts and the MTTR
     median.  Exit code: the child's final rc (0 on eventual success).
+
+    **Pod mode** (``processes = N > 1``): the supervisor manages all N
+    hosts of one multi-process dist_train.  ``build_cmd(attempt, resume,
+    process_index)`` then takes the child's process index, children get
+    the FM_DIST_* env contract (distributed.py), and the supervisor owns
+    the pod's *generation file*: when ONE child dies, only that child is
+    relaunched — the survivors' GenerationWatcher threads see the bumped
+    generation and re-exec in place (same PID) — and the whole pod
+    rendezvouses on a fresh coordinator port, restores the shared chain
+    head, and resumes at the saved cursor vector.  ``kind=fault`` /
+    ``kind=restart`` records carry the child's process index; the
+    bounded-restart and exponential-backoff semantics are exactly the
+    single-child ones, counted per incident.  ``straggler_timeout_s``
+    > 0 additionally SIGKILLs a child whose heartbeat file goes stale
+    (a wedged-not-dead host — the collective-entry timeout), which then
+    takes the normal relaunch path.
     """
 
     def __init__(
@@ -490,6 +545,10 @@ class Supervisor:
         sleep=time.sleep,
         repair: bool = True,
         env: dict | None = None,
+        processes: int = 1,
+        runtime_dir: str | None = None,
+        coordinator_host: str = "127.0.0.1",
+        straggler_timeout_s: float = 0.0,
     ):
         self._build_cmd = build_cmd
         self._model_file = model_file
@@ -503,6 +562,12 @@ class Supervisor:
         self._sleep = sleep
         self._repair = repair
         self._env = env
+        self._processes = max(1, int(processes))
+        self._runtime_dir = runtime_dir
+        self._coordinator_host = coordinator_host
+        self._straggler_timeout_s = float(straggler_timeout_s)
+        if self._processes > 1 and not runtime_dir:
+            raise ValueError("pod mode (processes > 1) requires runtime_dir")
         self.restarts = 0
         self.mttr_s: list[float] = []
         self.last_rc: int | None = None
@@ -532,6 +597,8 @@ class Supervisor:
             pass  # a closed pipe on kill is expected, not an error
 
     def run(self, resume: bool = False) -> int:
+        if self._processes > 1:
+            return self._run_pod(resume=resume)
         from fast_tffm_tpu.telemetry import RunMonitor
 
         monitor = RunMonitor(
@@ -646,6 +713,268 @@ class Supervisor:
                 attempt += 1
                 self.restarts = attempt
         finally:
+            summary: dict = {"supervisor_restarts": self.restarts}
+            if self.mttr_s:
+                summary["mttr_s_median"] = round(statistics.median(self.mttr_s), 3)
+                summary["mttr_s_max"] = round(max(self.mttr_s), 3)
+            monitor.close(**summary)
+
+    # -- pod mode ----------------------------------------------------------
+
+    def _clear_heartbeats(self) -> None:
+        """Remove hb-* files so only THIS run's heartbeats are judged."""
+        for p in range(self._processes):
+            try:
+                os.remove(os.path.join(self._runtime_dir, f"hb-{p}.json"))
+            except OSError:
+                pass
+
+    def _pod_launch(self, p: int, attempt: int, resume: bool, generation: int, monitor):
+        """Start child ``p`` into pod ``generation``; returns its record."""
+        from fast_tffm_tpu.distributed import (
+            ENV_GENERATION,
+            ENV_PROCESS_ID,
+            ENV_PROCESSES,
+            ENV_RUNTIME_DIR,
+        )
+
+        cmd = self._build_cmd(attempt, resume, p)
+        env = dict(self._env if self._env is not None else os.environ)
+        env[ENV_RUNTIME_DIR] = self._runtime_dir
+        env[ENV_PROCESS_ID] = str(p)
+        env[ENV_PROCESSES] = str(self._processes)
+        env[ENV_GENERATION] = str(generation)
+        self._log(
+            f"supervisor: launch host {p} attempt {attempt} gen {generation}"
+            f"{' (resume)' if resume else ''}: {' '.join(cmd)}"
+        )
+        proc = subprocess.Popen(
+            cmd,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        rec = {
+            "process": p,
+            "proc": proc,
+            "attempt": attempt,
+            "launched_wall": time.time(),  # straggler grace anchor
+            "first_progress_t": [None],
+            "last_step": [0],
+            "restart_emitted": [False],
+            "crash_t": None,  # set by the incident that relaunched it
+            "prev_rc": None,
+            "backoff": 0.0,
+        }
+
+        def emit_restart():
+            if rec["attempt"] == 0 or rec["restart_emitted"][0]:
+                return
+            rec["restart_emitted"][0] = True
+            mttr = None
+            if rec["first_progress_t"][0] is not None and rec["crash_t"] is not None:
+                mttr = round(rec["first_progress_t"][0] - rec["crash_t"], 3)
+                self.mttr_s.append(mttr)
+            monitor.emit(
+                "restart",
+                step=rec["last_step"][0],
+                attempt=rec["attempt"],
+                exit_code=rec["prev_rc"],
+                backoff_s=round(rec["backoff"], 3),
+                mttr_s=mttr,
+                process=p,
+            )
+
+        rec["emit_restart"] = emit_restart
+        tail = threading.Thread(
+            target=self._tail,
+            args=(proc, rec["first_progress_t"], rec["last_step"],
+                  emit_restart if attempt > 0 else None),
+            name=f"supervisor-tail-{p}",
+            daemon=True,
+        )
+        tail.start()
+        rec["tail"] = tail
+        return rec
+
+    def _run_pod(self, resume: bool = False) -> int:
+        """Supervise N pod hosts: on a crash, bump the generation (fresh
+        coordinator port — survivors re-exec in place via their
+        GenerationWatcher), repair the chain, relaunch ONLY the dead
+        host(s), bounded by max_restarts incidents with exponential
+        backoff.  Returns 0 when every host finishes cleanly."""
+        from fast_tffm_tpu.distributed import (
+            PEER_LOST_EXIT,
+            free_port,
+            read_heartbeat,
+            write_generation,
+        )
+        from fast_tffm_tpu.telemetry import RunMonitor
+
+        monitor = RunMonitor(
+            self._metrics_path, run_id=self._run_id, source="supervisor",
+            log=self._log,
+        )
+        generation = 0
+        attempt = 0  # incident ordinal (bounded by max_restarts)
+        n = self._processes
+        self._clear_heartbeats()  # a previous run's stale files must not
+        #   read as stragglers before the children even start
+        write_generation(
+            self._runtime_dir,
+            {
+                "generation": generation,
+                "coordinator": f"{self._coordinator_host}:{free_port()}",
+                "num_processes": n,
+                "cause": "start",
+            },
+        )
+        children = {
+            p: self._pod_launch(p, 0, resume, generation, monitor) for p in range(n)
+        }
+        relaunched: list[dict] = []  # every attempt>0 rec, for post-mortems
+        finished: dict[int, int] = {}
+        final_rc = 0
+        try:
+            while children:
+                time.sleep(0.2)
+                dead = {
+                    p: rec for p, rec in children.items()
+                    if rec["proc"].poll() is not None
+                }
+                for p, rec in dead.items():
+                    rec["tail"].join(timeout=5.0)
+                crashed = {}
+                for p, rec in dead.items():
+                    rc = rec["proc"].returncode
+                    self.last_rc = rc
+                    del children[p]
+                    if rc == 0:
+                        finished[p] = 0
+                        self._log(f"supervisor: host {p} completed cleanly")
+                    else:
+                        crashed[p] = (rec, rc)
+                if crashed:
+                    crash_t = time.monotonic()
+                    for p, (rec, rc) in crashed.items():
+                        sig = -rc if rc < 0 else None
+                        monitor.emit(
+                            "fault",
+                            step=rec["last_step"][0],
+                            event="crash",
+                            exit_code=rc,
+                            signal=sig,
+                            attempt=attempt,
+                            process=p,
+                        )
+                        self._log(
+                            f"supervisor: host {p} died (rc={rc}"
+                            + (f", signal {sig}" if sig else "")
+                            + (" — peer-lost exit" if rc == PEER_LOST_EXIT else "")
+                            + f") around step {rec['last_step'][0]}"
+                        )
+                    if finished:
+                        # Part of the pod already finished the run: the
+                        # relaunch could never re-form an N-process
+                        # rendezvous.  Unrecoverable by relaunch.
+                        final_rc = next(rc for _, rc in crashed.values())
+                        self._log(
+                            "supervisor: crash after other hosts finished — "
+                            "cannot re-form the pod; giving up"
+                        )
+                        break
+                    if attempt >= self._max_restarts:
+                        final_rc = next(rc for _, rc in crashed.values())
+                        self._log(
+                            f"supervisor: giving up after {attempt} restart "
+                            f"incident(s) (restart_max = {self._max_restarts})"
+                        )
+                        break
+                    attempt += 1
+                    self.restarts = attempt
+                    if self._repair:
+                        try:
+                            repair_delta_chain(self._model_file, log=self._log)
+                        except Exception as e:
+                            self._log(f"supervisor: chain repair failed: {e!r}")
+                    generation += 1
+                    write_generation(
+                        self._runtime_dir,
+                        {
+                            "generation": generation,
+                            "coordinator": f"{self._coordinator_host}:{free_port()}",
+                            "num_processes": n,
+                            "cause": f"host {sorted(crashed)} crashed",
+                        },
+                    )
+                    backoff = min(
+                        self._backoff_s * (2.0 ** (attempt - 1)), self._backoff_max_s
+                    )
+                    if backoff > 0:
+                        self._log(
+                            f"supervisor: backing off {backoff:.1f}s before "
+                            f"relaunching host(s) {sorted(crashed)}"
+                        )
+                        self._sleep(backoff)
+                    do_resume = os.path.exists(self._model_file)
+                    for p, (rec, rc) in crashed.items():
+                        new = self._pod_launch(p, attempt, do_resume, generation, monitor)
+                        new["crash_t"] = crash_t
+                        new["prev_rc"] = rc
+                        new["backoff"] = backoff
+                        children[p] = new
+                        relaunched.append(new)
+                    continue
+                if self._straggler_timeout_s > 0:
+                    for p, rec in list(children.items()):
+                        _, age = read_heartbeat(self._runtime_dir, p)
+                        # Grace: only a heartbeat written by THIS
+                        # incarnation (mtime after its launch) can go
+                        # stale — bring-up (python + jax + rendezvous)
+                        # writes nothing and must never read as a
+                        # straggler, nor may a previous run's old file.
+                        if (
+                            age is not None
+                            and age > self._straggler_timeout_s
+                            and time.time() - age > rec["launched_wall"]
+                        ):
+                            monitor.emit(
+                                "fault",
+                                step=rec["last_step"][0],
+                                event="straggler_kill",
+                                process=p,
+                                age_s=round(age, 3),
+                            )
+                            self._log(
+                                f"supervisor: host {p} heartbeat stale "
+                                f"{age:.1f}s > {self._straggler_timeout_s:.1f}s "
+                                "— SIGKILLing the straggler"
+                            )
+                            try:
+                                rec["proc"].kill()
+                            except OSError:
+                                pass
+            else:
+                self._log(
+                    f"supervisor: pod completed cleanly after {attempt} "
+                    "restart incident(s)"
+                )
+                return 0
+            # Broken out of the loop: tear the remaining children down.
+            for p, rec in children.items():
+                if rec["proc"].poll() is None:
+                    rec["proc"].kill()
+            for p, rec in children.items():
+                rec["proc"].wait()
+                rec["tail"].join(timeout=5.0)
+            return final_rc or 1
+        finally:
+            # Restart records not yet emitted at first progress (child
+            # finished instantly, or died again before any progress) —
+            # emit_restart is idempotent, so double emission cannot happen.
+            for rec in relaunched:
+                rec["emit_restart"]()
             summary: dict = {"supervisor_restarts": self.restarts}
             if self.mttr_s:
                 summary["mttr_s_median"] = round(statistics.median(self.mttr_s), 3)

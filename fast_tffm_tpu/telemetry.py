@@ -73,7 +73,20 @@ __all__ = [
 SCHEMA_VERSION = 1
 
 # Fields every record carries (ts is stamped by MetricsLogger).
-ENVELOPE_FIELDS = ("run_id", "schema_version", "kind", "step", "t", "ts")
+# process_index/process_count identify the EMITTING host on multi-process
+# pods (0/1 on single-process runs and device-free emitters like the
+# supervisor), so tools/report.py can merge per-host JSONL files for one
+# run_id into per-host columns.
+ENVELOPE_FIELDS = (
+    "run_id",
+    "schema_version",
+    "kind",
+    "step",
+    "t",
+    "ts",
+    "process_index",
+    "process_count",
+)
 
 # kind -> keys REQUIRED on every record of that kind (beyond the
 # envelope).  Values may be null when a source genuinely cannot measure
@@ -438,6 +451,11 @@ class RunMonitor:
         self._own_logger = logger is None
         self.run_id = run_id or new_run_id()
         self.source = source
+        # Stamped once at construction: the monitor outlives any single
+        # dispatch, and a host's identity cannot change mid-run.
+        from fast_tffm_tpu.distributed import process_identity
+
+        self.process_index, self.process_count = process_identity()
         self._log = log
         self._t0 = time.monotonic()
         self._lock = threading.Lock()
@@ -502,6 +520,8 @@ class RunMonitor:
             kind=kind,
             step=self._step if step is None else int(step),
             t=round(time.monotonic() - self._t0, 3),
+            process_index=self.process_index,
+            process_count=self.process_count,
             **fields,
         )
 

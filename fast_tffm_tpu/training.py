@@ -100,11 +100,12 @@ def _batch_converter(uses_fields: bool):
     One definition shared by train() and dist_train() so the stacking
     rule cannot diverge between the local and distributed drivers.
 
-    ``wire_capable`` marks this as the LOCAL converter — the marker
-    ``_stream`` keys the packed wire format on (the multi-host
-    global-batch closures deliberately lack it and keep the per-process
-    array stitch, but still carry ``uses_fields`` so the kind=input
-    byte estimates stay honest)."""
+    ``wire_capable`` marks converters the packed wire format can feed —
+    this local one ships one coalesced buffer to the local device; the
+    multi-host global-batch closures additionally carry
+    ``make_wire_converter`` so _stream builds the host-local
+    pack/unpack + global-assembly shipper instead
+    (parallel.WireGlobalConverter)."""
 
     def to_batch(parsed, w):
         if isinstance(parsed, list):
@@ -179,8 +180,14 @@ def _stream(
             # Pod etiquette: on a shared filesystem only the lead process
             # builds a stale cache; the rest wait for it (and build their
             # own copy after the timeout when disks are host-local).
+            # Shard-disjoint file assignment is the exception: each host
+            # OWNS its files, so waiting for a peer build would stall a
+            # non-lead host for the whole timeout on a cache nobody else
+            # will ever write.
             wait_for_peer=(
-                cfg.binary_cache_wait if jax.process_index() != 0 else 0.0
+                cfg.binary_cache_wait
+                if jax.process_index() != 0 and cfg.input_assignment != "files"
+                else 0.0
             ),
         )
     # Per-epoch shuffle (train streams only — drivers create one stream per
@@ -261,24 +268,25 @@ def _stream(
             # THESE files: all-ones vals come off the FMB v2 header flags
             # (ANDed; verified again per batch by the packer), fields
             # follow the model's uses_fields rule, weights elide when the
-            # per-file example weights are uniform.  Local converters only
-            # (wire_capable marker): the multi-host global stitch keeps
-            # the array path, whose per-process slices feed
-            # make_array_from_process_local_data directly.
+            # per-file example weights are uniform.
             from fast_tffm_tpu.data.binary import fmb_wire_flags
             from fast_tffm_tpu.data.wire import WireConverter, make_spec
 
             all_ones, _ = fmb_wire_flags(files)
             uniform_w = weights is None or all(float(x) == 1.0 for x in weights)
-            convert = WireConverter(
-                make_spec(
-                    cfg.vocabulary_size,
-                    max_nnz,
-                    with_vals=not all_ones,
-                    with_fields=to_batch.uses_fields,
-                    with_weights=not uniform_w,
-                )
+            spec = make_spec(
+                cfg.vocabulary_size,
+                max_nnz,
+                with_vals=not all_ones,
+                with_fields=to_batch.uses_fields,
+                with_weights=not uniform_w,
             )
+            # Multi-host converters supply their own wire shipper (the
+            # host-local pack + per-device unpack + global assembly —
+            # parallel.WireGlobalConverter); local converters take the
+            # plain single-device one.
+            maker = getattr(to_batch, "make_wire_converter", None)
+            convert = maker(spec) if maker is not None else WireConverter(spec)
     stats = InputStats()
     gen = stats.timed(raw, convert)
     # Each queued item holds steps_per_call batches, so scale the depth
@@ -360,6 +368,36 @@ def _resolve_cursor(cfg: Config, cursor, log) -> tuple[int, int]:
             "resuming at the start of the data (legacy behavior)"
         )
         return 0, 0
+    # Multi-host cursor vector: the chain head carries every host's exact
+    # position (hosts[p]); resume hands each host back ITS entry.  A
+    # topology change (different process count) or an internally
+    # disagreeing vector cannot be resumed exactly — loud legacy fallback.
+    hosts = cursor.pop("hosts", None)
+    saved_pcount = cursor.pop("process_count", None)
+    if hosts is not None:
+        pcount, p = jax.process_count(), jax.process_index()
+        if (saved_pcount or len(hosts)) != pcount or p >= len(hosts):
+            log(
+                "warning: checkpoint cursor vector was saved by "
+                f"{saved_pcount or len(hosts)} host(s), this run has "
+                f"{pcount} — resuming at the start of the data (legacy "
+                "behavior)"
+            )
+            return 0, 0
+        entries = [
+            ((h or {}).get("epoch"), (h or {}).get("batch_in_epoch")) for h in hosts
+        ]
+        if any(e != entries[0] for e in entries[1:]):
+            log(
+                "warning: checkpoint cursor vector disagrees across hosts "
+                f"({entries}) — resuming at the start of the data (legacy "
+                "behavior)"
+            )
+            return 0, 0
+        mine = hosts[p] or {}
+        if mine.get("epoch") is not None:
+            cursor["epoch"] = int(mine["epoch"])
+            cursor["batch_in_epoch"] = int(mine.get("batch_in_epoch") or 0)
     mismatched = [
         f"{key} {cursor.get(key)!r} != {want!r}"
         for key, want in (
@@ -408,6 +446,8 @@ def _run_training(
     mark_touched=None,
     start_cursor=None,
     rollback=None,
+    runtime=None,
+    mesh=None,
 ):
     """Shared step loop.  ``train_stream(epoch)`` overrides the per-epoch
     input stream, ``to_batch(parsed, w)`` the host→device batch assembly,
@@ -470,40 +510,86 @@ def _run_training(
     losses = []
     pending_steps = 0  # micro-steps since the last log point
     start_step = step_num = int(state.step)
-    # On multi-host pods every process runs this loop; only process 0 owns
-    # the metrics file and profiler trace (shared filesystems would get N
-    # interleaved copies otherwise).
+    # On multi-host pods every process runs this loop; process 0 owns the
+    # profiler trace, and each host writes its OWN telemetry file
+    # (host_metrics_path — tools/report.py merges them per run_id).
     is_lead = jax.process_index() == 0
     ckpt_format = cfg.checkpoint_format
-    if jax.process_count() > 1 and ckpt_format == "npz":
-        # npz gathers the table to one host — impossible once shards live on
-        # other processes; orbax writes each host's shards in parallel.
-        # Import NOW so a missing orbax fails before hours of training, not
-        # at the first end-of-epoch save.
-        import orbax.checkpoint  # noqa: F401
-
-        log("note: multi-host run — switching checkpoint_format npz -> orbax")
-        ckpt_format = "orbax"
-    elif ckpt_format == "npz" and os.path.isdir(cfg.model_file):
+    if ckpt_format == "npz" and os.path.isdir(cfg.model_file):
         # model_file already holds an orbax directory (e.g. an earlier
-        # multi-host run): an npz os.replace onto it would crash at save
+        # orbax run): an npz os.replace onto it would crash at save
         # time, after training.  Stay in the format the path already has.
         log(f"note: {cfg.model_file} is an orbax checkpoint dir — keeping orbax format")
         ckpt_format = "orbax"
+    elif jax.process_count() > 1 and ckpt_format == "npz":
+        # Multi-host npz runs the single-writer protocol: the state
+        # replicates to every host (dist_train supplies the replicating
+        # saveable), process 0 alone publishes full+delta files, and every
+        # other host synchronizes on the published content signature.
+        # The memory bill is the full logical table per host — orbax stays
+        # the format for beyond-host tables (DESIGN §8).
+        log(
+            "note: multi-host npz checkpoints — process 0 is the sole "
+            "writer; peers barrier on each publish's content signature"
+        )
     tracer = WindowTracer(cfg.trace_dir if is_lead else None, count=cfg.trace_steps)
     # Unified telemetry: every record (train/input/validation/compile/mem/
     # stall/anomaly/summary) shares one run_id and the envelope schema
     # (telemetry.SCHEMAS); the compile sentinel drains per dispatch, the
     # liveness watchdog fires kind=stall with thread stacks when the loop
     # wedges, and the close() record documents the run's totals.
+    from fast_tffm_tpu.distributed import host_metrics_path
+
+    run_id = cfg.telemetry_run_id
+    if runtime is not None and runtime.active and not run_id:
+        # One run identity across the pod: the lead draws it, everyone
+        # else adopts it — tools/report.py groups per-host files by it.
+        from fast_tffm_tpu.telemetry import new_run_id
+
+        run_id = runtime.broadcast("run_id", new_run_id() if runtime.is_lead else None)
     monitor = RunMonitor(
-        cfg.metrics_path if is_lead else None,
-        run_id=cfg.telemetry_run_id,
+        host_metrics_path(cfg.metrics_path) if cfg.metrics_path else None,
+        run_id=run_id,
         source="train",
         stall_timeout_s=cfg.telemetry_stall_timeout_s,
         mem_every_s=cfg.telemetry_mem_every_s,
         log=log,
     )
+    # Pod liveness: this host's heartbeat (armed at bring-up) starts
+    # carrying the step counter, and a peer-heartbeat monitor classifies a
+    # stale host as a host-level kind=stall long before jax's own
+    # coordination-service timeout would notice.
+    heartbeat = getattr(runtime, "heartbeat", None) if runtime is not None else None
+    host_monitor = None
+    if (
+        runtime is not None
+        and runtime.process_count > 1
+        and getattr(runtime, "runtime_dir", None)
+        and cfg.host_stall_timeout_s > 0
+    ):
+        from fast_tffm_tpu.distributed import HostMonitor
+
+        def _on_host_stall(peer, classification, detail):
+            monitor.emit(
+                "stall",
+                step=step_num,
+                deadline_s=cfg.host_stall_timeout_s,
+                since_last_step_s=detail.get("age_s"),
+                classification=classification,
+                prefetch_queue_depth=None,
+                stacks={},
+                peer=peer,
+                peer_last_step=detail.get("last_step"),
+            )
+
+        host_monitor = HostMonitor(
+            runtime.runtime_dir,
+            runtime.process_index,
+            runtime.process_count,
+            cfg.host_stall_timeout_s,
+            _on_host_stall,
+            poll_s=min(1.0, cfg.host_stall_timeout_s / 4.0),
+        )
     if rollback is not None:
         # The failed attempt's monitor already recorded the non-finite
         # loss; THIS record documents the recovery decision (restored
@@ -549,9 +635,9 @@ def _run_training(
     if cfg.delta_every_steps > 0 and ckpt_format != "npz":
         raise ValueError(
             "delta_every_steps > 0 requires npz checkpoints — this run "
-            "resolved checkpoint_format to orbax (multi-host pod, or "
-            "model_file already holds an orbax dir); disable delta saves "
-            "or point model_file at a fresh npz path"
+            "resolved checkpoint_format to orbax (model_file already "
+            "holds an orbax dir); disable delta saves or point "
+            "model_file at a fresh npz path"
         )
     if cfg.async_save and ckpt_format != "npz":
         log("note: async_save applies to npz checkpoints — orbax saves stay synchronous")
@@ -570,6 +656,8 @@ def _run_training(
         mark_fn=mark_touched,
         start_step=start_step,
         cursor_fn=input_cursor,
+        runtime=runtime,
+        mesh=mesh,
     )
     # Preemption-safe shutdown (the reference's only recovery story was
     # Supervisor restart-from-checkpoint; cloud TPU maintenance sends
@@ -636,6 +724,8 @@ def _run_training(
                 # thing the serving bucket ladder pins to zero, now
                 # visible on the train path too.
                 monitor.on_dispatch(step_num, warmup=(epoch == start_epoch))
+                if heartbeat is not None:
+                    heartbeat.set_step(step_num)
                 if ckpt.delta_enabled:
                     # OR this batch's rows into the device bitmap; at a
                     # delta boundary, ship the touched window (writer
@@ -791,6 +881,8 @@ def _run_training(
             {f"fault_{k}": v for k, v in drain_fault_counters().items() if v}
         )
         tracer.close()
+        if host_monitor is not None:
+            host_monitor.close()
         monitor.close(**summary_extra)
         for sig, handler in restore_handlers.items():
             try:
@@ -1117,10 +1209,11 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None, step_
         init_sharded_state,
         make_global_batch,
         make_mesh,
+        make_replicator,
         make_sharded_predict_step,
         make_sharded_train_step,
     )
-    from fast_tffm_tpu.parallel.multihost import maybe_initialize_distributed
+    from fast_tffm_tpu.distributed import initialize_runtime
 
     if not cfg.train_files:
         raise ValueError("no train_files configured")
@@ -1132,18 +1225,12 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None, step_
             f"weight_files has {len(cfg.weight_files)} entries for "
             f"{len(cfg.train_files)} train_files (they align per-file)"
         )
-    maybe_initialize_distributed(cfg.coordinator_address, cfg.num_processes, cfg.process_id)
-    if cfg.on_nan == "rollback":
-        # The rollback loop re-enters _run_training with a restored state;
-        # on a multi-process pod every process would have to make the same
-        # decision at the same boundary (a barrier this driver doesn't
-        # have yet).  Silently downgrading to abort would corrupt chaos
-        # A/Bs, so refuse loudly.
-        raise ValueError(
-            "on_nan = rollback is local-train only; dist_train keeps the "
-            "abort-before-overwrite behavior (restart under the supervisor "
-            "to recover)"
-        )
+    # Pod bring-up: jax.distributed initialize (config keys, TPU metadata,
+    # or the supervisor's generation file), gloo CPU collectives, the
+    # coordination runtime (KV + barriers), heartbeats, and — under the
+    # pod supervisor — the generation watcher that re-execs this host into
+    # the next pod incarnation when a peer is replaced.
+    runtime = initialize_runtime(cfg, log=log)
     if cfg.device_cache and cfg.shuffle:
         # A shuffled gather across the mesh-sharded batch dim would move
         # rows between chips every step — exactly the per-step traffic
@@ -1161,51 +1248,97 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None, step_
         mesh = make_mesh(data, row)
     log(f"mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))} on {mesh.devices.size} devices")
     check_batch_divides(cfg.batch_size, mesh)
-    if resume and cfg.table_layout == "packed":
-        # Restore the LOGICAL checkpoint into a rows-layout template and
-        # convert per shard ON DEVICE — no throwaway packed random init,
-        # no host gather (multi-host packed resume works: each process
-        # restores and packs only its own shards).  The template uses the
-        # PACKED padding so a same-mesh packed checkpoint restores
-        # in place; other paddings go through restore's re-pad path
-        # (single-host) or its loud multi-host shape error.
-        from fast_tffm_tpu.parallel import pack_sharded_on_device
-        from fast_tffm_tpu.parallel.train_step import packed_shard_meta
+    def restore_state():
+        """model_file -> this run's live sharded layout.  Shared by
+        --resume and the on_nan=rollback recovery loop below.  Packed
+        runs restore the LOGICAL checkpoint into a rows-layout template
+        and convert per shard ON DEVICE — no throwaway packed random
+        init, no host gather (multi-host packed resume works: each
+        process restores and packs only its own shards).  The template
+        uses the PACKED padding so a same-mesh packed checkpoint
+        restores in place; other paddings go through restore's re-pad
+        path (single-host) or its loud multi-host shape error."""
+        if cfg.table_layout == "packed":
+            from fast_tffm_tpu.parallel import pack_sharded_on_device
+            from fast_tffm_tpu.parallel.train_step import packed_shard_meta
 
-        fused_acc = cfg.adagrad_accumulator == "fused"
-        padded_model, _, _ = packed_shard_meta(model, mesh, fused=fused_acc)
-        logical = restore_checkpoint(
+            fused_acc = cfg.adagrad_accumulator == "fused"
+            padded_model, _, _ = packed_shard_meta(model, mesh, fused=fused_acc)
+            logical = restore_checkpoint(
+                cfg.model_file,
+                init_sharded_state(
+                    padded_model, mesh, jax.random.key(0), cfg.init_accumulator_value,
+                    cfg.adagrad_accumulator,
+                ),
+                chunk_bytes=cfg.checkpoint_chunk_mb << 20,
+            )
+            return pack_sharded_on_device(
+                logical, model, mesh, cfg.init_accumulator_value, fused=fused_acc
+            )
+        return restore_checkpoint(
             cfg.model_file,
             init_sharded_state(
-                padded_model, mesh, jax.random.key(0), cfg.init_accumulator_value,
-                cfg.adagrad_accumulator,
+                model, mesh, jax.random.key(0), cfg.init_accumulator_value,
+                cfg.adagrad_accumulator, table_layout=cfg.table_layout,
             ),
             chunk_bytes=cfg.checkpoint_chunk_mb << 20,
         )
-        state = pack_sharded_on_device(
-            logical, model, mesh, cfg.init_accumulator_value, fused=fused_acc
+
+    if resume and not (
+        os.path.isfile(cfg.model_file) or os.path.isdir(cfg.model_file)
+    ):
+        # A pod relaunch/re-exec forces --resume unconditionally, but a
+        # crash DURING the very first publish legitimately leaves no
+        # checkpoint at all (only a tmp file) — every host observes the
+        # same absence on the shared filesystem and starts fresh; the
+        # restore agreement below pins that they all did.
+        log(
+            f"warning: --resume but no checkpoint at {cfg.model_file} — "
+            "starting fresh (crash before the first publish?)"
         )
-        log(f"resumed from {cfg.model_file} at step {int(state.step)}")
-    else:
-        state = init_sharded_state(
-            model, mesh, jax.random.key(0), cfg.init_accumulator_value,
-            cfg.adagrad_accumulator, table_layout=cfg.table_layout,
-        )
-        if resume:
-            state = restore_checkpoint(
-                cfg.model_file, state, chunk_bytes=cfg.checkpoint_chunk_mb << 20
-            )
-            log(f"resumed from {cfg.model_file} at step {int(state.step)}")
+        resume = False
     start_cursor = None
     if resume:
+        state = restore_state()
+        log(f"resumed from {cfg.model_file} at step {int(state.step)}")
         # Exact-position resume (every process reads the same shared
-        # cursor, so all shards reopen at the same global batch).
+        # cursor vector, so all shards reopen at the same global batch).
         start_cursor = read_input_cursor(cfg.model_file)
         if start_cursor is None:
             log(
                 "note: checkpoint carries no input cursor (pre-resilience "
                 "format) — input restarts at the first file (legacy resume)"
             )
+    else:
+        state = init_sharded_state(
+            model, mesh, jax.random.key(0), cfg.init_accumulator_value,
+            cfg.adagrad_accumulator, table_layout=cfg.table_layout,
+        )
+    ckpt_is_npz = cfg.checkpoint_format == "npz" and not os.path.isdir(cfg.model_file)
+    if runtime.active:
+        # Restore barrier: no host proceeds into collectives until every
+        # host holds the SAME restored step, chain head, and cursor — a
+        # desynced pod must die here, loudly, not train garbage.
+        head = None
+        if ckpt_is_npz and resume:
+            from fast_tffm_tpu.checkpoint import read_delta_chain
+
+            try:
+                base_sig, chain = read_delta_chain(cfg.model_file)
+                head = chain[-1]["save_id"] if chain else base_sig
+            except (ValueError, OSError):
+                head = None
+        runtime.agree(
+            "restore",
+            {
+                "step": int(state.step),
+                "head": head,
+                "cursor": [
+                    (start_cursor or {}).get("epoch"),
+                    (start_cursor or {}).get("batch_in_epoch"),
+                ],
+            },
+        )
     step_fn = make_sharded_train_step(
         model, cfg.learning_rate, mesh,
         lookup=cfg.lookup, capacity_factor=cfg.lookup_capacity_factor,
@@ -1243,6 +1376,21 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None, step_
         else:
             def dist_saveable(st):
                 return unpack_sharded_to_logical(st, model, mesh)
+
+    if jax.process_count() > 1 and ckpt_is_npz:
+        # Multi-host npz single-writer protocol: the saveable additionally
+        # REPLICATES the logical state (one collective every host
+        # dispatches) so process 0 holds complete arrays to stream to
+        # disk.  Full-table-per-host memory — the modest-table path; use
+        # orbax beyond that (DESIGN §8).
+        replicate = make_replicator(mesh)
+        inner_saveable = dist_saveable
+
+        if inner_saveable is not None:
+            def dist_saveable(st, _inner=inner_saveable):
+                return replicate(_inner(st))
+        else:
+            dist_saveable = replicate
 
     cached_data = None
     if cfg.device_cache:
@@ -1374,31 +1522,95 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None, step_
             # (device_cache keeps its resident index stream — each
             # process already staged only its rows at load time; only
             # the STREAMED path shards the text/FMB stream per step, and
-            # only it needs the up-front line count for the fixed
+            # only it needs the up-front row counts for the fixed
             # steps-per-epoch padding.)
-            total = count_lines(cfg.train_files)
-            steps_per_epoch = -(-total // cfg.batch_size)  # ceil
-            log(
-                f"input sharding: {total} rows over {nproc} processes, "
-                f"{steps_per_epoch} steps/epoch, {local_bs} rows/process/step"
-            )
-
-            def train_stream(epoch, skip_batches=0):
-                return _stream(
-                    cfg,
-                    cfg.train_files,
-                    max_nnz,
-                    epochs=1,
-                    batch_size=local_bs,
-                    shard_index=pid,
-                    shard_count=nproc,
-                    shard_block=local_bs,
-                    pad_to_batches=steps_per_epoch,
-                    to_batch=to_batch,
-                    shuffle_epoch=epoch,
-                    steps_per_call=cfg.steps_per_call,
-                    skip_batches=skip_batches,
+            if cfg.input_assignment == "files":
+                # Shard-disjoint FILE assignment: host p streams files
+                # [p::P] whole — each host opens and reads only its own
+                # files (no cross-file seeking through the peers' data),
+                # the pod-scale input shape.  Global batch k is the
+                # stitch of every host's k-th local batch; short hosts
+                # pad the epoch tail with weight-0 batches so every host
+                # runs the same number of collective steps.
+                files_all = tuple(cfg.train_files)
+                if len(files_all) < nproc:
+                    raise ValueError(
+                        f"input_assignment = files needs at least one train "
+                        f"file per process ({len(files_all)} files, {nproc} "
+                        "processes) — split the dataset or use "
+                        "input_assignment = rows"
+                    )
+                my_files = files_all[pid::nproc]
+                # Per-file example weights align with the FULL train file
+                # list; this host's stream sees only its own files, so the
+                # weights slice with the same stride.
+                my_weights = (
+                    tuple(cfg.weight_files)[pid::nproc]
+                    if cfg.weight_files
+                    else None
                 )
+                # Each host counts only ITS files (the mode's whole point
+                # is not touching the peers' data) and the per-host row
+                # counts meet through the pod KV store; without a
+                # coordination backend, fall back to counting everything.
+                if runtime.active:
+                    per_host_rows = [
+                        int(r)
+                        for r in runtime.allgather(
+                            "files-rows", count_lines(my_files)
+                        )
+                    ]
+                else:
+                    per_host_rows = [
+                        count_lines(files_all[p::nproc]) for p in range(nproc)
+                    ]
+                steps_per_epoch = max(-(-r // local_bs) for r in per_host_rows)
+                log(
+                    "input sharding: shard-disjoint files — host "
+                    f"{pid} owns {len(my_files)} file(s) / "
+                    f"{per_host_rows[pid]} rows, {steps_per_epoch} "
+                    f"steps/epoch, {local_bs} rows/process/step"
+                )
+
+                def train_stream(epoch, skip_batches=0):
+                    return _stream(
+                        cfg,
+                        my_files,
+                        max_nnz,
+                        epochs=1,
+                        batch_size=local_bs,
+                        weights=my_weights,
+                        pad_to_batches=steps_per_epoch,
+                        to_batch=to_batch,
+                        shuffle_epoch=epoch,
+                        steps_per_call=cfg.steps_per_call,
+                        skip_batches=skip_batches,
+                    )
+
+            else:
+                total = count_lines(cfg.train_files)
+                steps_per_epoch = -(-total // cfg.batch_size)  # ceil
+                log(
+                    f"input sharding: {total} rows over {nproc} processes, "
+                    f"{steps_per_epoch} steps/epoch, {local_bs} rows/process/step"
+                )
+
+                def train_stream(epoch, skip_batches=0):
+                    return _stream(
+                        cfg,
+                        cfg.train_files,
+                        max_nnz,
+                        epochs=1,
+                        batch_size=local_bs,
+                        shard_index=pid,
+                        shard_count=nproc,
+                        shard_block=local_bs,
+                        pad_to_batches=steps_per_epoch,
+                        to_batch=to_batch,
+                        shuffle_epoch=epoch,
+                        steps_per_call=cfg.steps_per_call,
+                        skip_batches=skip_batches,
+                    )
 
         def to_batch(parsed, w):
             if isinstance(parsed, list):  # K local chunks -> [K, B, ...] global
@@ -1409,10 +1621,20 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None, step_
                 )
             return make_global_batch(mesh, parsed, w, with_fields=model.uses_fields)
 
-        # uses_fields WITHOUT wire_capable: the kind=input byte estimate
-        # stays honest (fields may be skipped) while the packed wire
-        # stays off this per-process stitch path.
         to_batch.uses_fields = model.uses_fields
+        # Host-local packed-wire staging (PR 3's wire, already per-host by
+        # construction): when the stream is FMB-backed and wire_format =
+        # packed, _stream swaps this stitch for a WireGlobalConverter —
+        # each host ships ONE coalesced buffer to its own devices and the
+        # per-device shards assemble straight into the global batch.
+        to_batch.wire_capable = True
+
+        def _make_wire(spec):
+            from fast_tffm_tpu.parallel import WireGlobalConverter
+
+            return WireGlobalConverter(mesh, spec)
+
+        to_batch.make_wire_converter = _make_wire
 
         examples_per_step = cfg.batch_size
 
@@ -1458,13 +1680,7 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None, step_
                 ),
             )
 
-    return _run_training(
-        cfg,
-        state,
-        step_fn,
-        predict_step,
-        max_nnz,
-        log,
+    run_kwargs = dict(
         train_stream=train_stream,
         to_batch=to_batch,
         examples_per_step=examples_per_step,
@@ -1474,5 +1690,76 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None, step_
         step_hook=step_hook,
         row_dim=model.row_dim,
         mark_touched=mark_touched,
-        start_cursor=start_cursor,
+        runtime=runtime,
+        mesh=mesh,
     )
+    # on_nan = rollback, now legal under dist_train: the loss every host
+    # checks is REPLICATED (identical), so every host raises
+    # NonFiniteLossError at the same step with the same cursor; the
+    # rollback barrier below makes the agreement explicit before any host
+    # touches the checkpoint, then all processes restore the same chain
+    # head and resume input at the same cursor vector.
+    rollbacks = 0
+    rollback_note = None
+    while True:
+        try:
+            return _run_training(
+                cfg, state, step_fn, predict_step, max_nnz, log,
+                start_cursor=start_cursor, rollback=rollback_note,
+                **run_kwargs,
+            )
+        except NonFiniteLossError as e:
+            from fast_tffm_tpu.checkpoint import latest_step
+
+            if (
+                cfg.on_nan != "rollback"
+                or rollbacks >= cfg.max_rollbacks
+                or e.cursor is None
+                or latest_step(cfg.model_file) is None
+            ):
+                raise
+            rollbacks += 1
+            # The cross-process rollback barrier: rendezvous BEFORE the
+            # restore so no host can re-enter collectives against peers
+            # still unwinding the failed attempt.
+            runtime.barrier(f"rollback-{rollbacks}")
+            state = restore_state()
+            head = None
+            if ckpt_is_npz:
+                from fast_tffm_tpu.checkpoint import read_delta_chain
+
+                try:
+                    base_sig, chain = read_delta_chain(cfg.model_file)
+                    head = chain[-1]["save_id"] if chain else base_sig
+                except (ValueError, OSError):
+                    head = None
+            runtime.agree(
+                f"rollback-head-{rollbacks}",
+                {
+                    "step": int(state.step),
+                    "head": head,
+                    "cursor": [
+                        e.cursor.get("epoch"),
+                        e.cursor.get("batch_in_epoch"),
+                    ],
+                },
+            )
+            # Fresh KV namespace: the next attempt's checkpoint boundary
+            # ordinals must not collide with the aborted attempt's keys.
+            runtime.advance_namespace()
+            start_cursor = dict(e.cursor, _exact=True)
+            rollback_note = {
+                "step": e.step,
+                "loss": e.loss,
+                "rollback_n": rollbacks,
+                "restored_step": int(state.step),
+                "skip_to_epoch": int(e.cursor.get("epoch", 0)),
+                "skip_to_batch": int(e.cursor.get("batch_in_epoch", 0)),
+            }
+            log(
+                f"on_nan = rollback: non-finite loss at step {e.step}; "
+                f"restored {cfg.model_file} (step {int(state.step)}), "
+                f"skipping input to epoch {rollback_note['skip_to_epoch']} "
+                f"batch {rollback_note['skip_to_batch']} "
+                f"(rollback {rollbacks}/{cfg.max_rollbacks})"
+            )

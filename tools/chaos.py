@@ -20,9 +20,12 @@ The kill steps are drawn from ``random.Random(seed)``, so a probe run is
 reproducible bit for bit (the fault plan's byte-identity is separately
 pinned by tests/test_resilience.py).
 
-Writes PROBE_MTTR_r06.json.  Usage:
+Writes PROBE_MTTR_r06.json; ``--processes 2`` chaoses the REAL
+multi-process pod instead (dist_train under the pod supervisor, gloo
+CPU collectives, one SIGKILLed host per trial, victims alternating
+writer/survivor) and writes PROBE_MTTR_DIST_r07.json.  Usage:
   python tools/chaos.py [--trials 3] [--seed 1106] [--sharded]
-                        [--out PROBE_MTTR_r06.json]
+                        [--processes 2] [--out PROBE.json]
 """
 
 from __future__ import annotations
@@ -60,8 +63,13 @@ def _write_dataset(path: str) -> None:
         f.write("\n".join(lines) + "\n")
 
 
-def _write_cfg(d: str) -> str:
+def _write_cfg(d: str, processes: int = 1) -> str:
     cfg = os.path.join(d, "run.cfg")
+    dist = (
+        f"\n[Distributed]\nnum_processes = {processes}\nbarrier_timeout_s = 60\n"
+        if processes > 1
+        else ""
+    )
     with open(cfg, "w") as f:
         f.write(
             f"""
@@ -82,13 +90,17 @@ max_nnz = 4
 learning_rate = 0.1
 log_every = 1
 metrics_path = {d}/run.jsonl
-"""
+{dist}"""
         )
     return cfg
 
 
-def _env() -> dict:
+def _env(processes: int = 1) -> dict:
     env = dict(os.environ, JAX_PLATFORMS="cpu")
+    if processes > 1:
+        # One virtual device per pod host: the mesh spans the processes.
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        return env
     flags = env.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         env["XLA_FLAGS"] = (
@@ -97,13 +109,15 @@ def _env() -> dict:
     return env
 
 
-def _run(mode: str, cfg: str, *args, timeout: int = 600) -> subprocess.CompletedProcess:
+def _run(
+    mode: str, cfg: str, *args, timeout: int = 600, processes: int = 1
+) -> subprocess.CompletedProcess:
     return subprocess.run(
         [sys.executable, os.path.join(REPO, "fast_tffm.py"), mode, cfg, *args],
         stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT,
         text=True,
-        env=_env(),
+        env=_env(processes),
         cwd=REPO,
         timeout=timeout,
     )
@@ -125,15 +139,27 @@ def _losses(path: str) -> dict[int, float]:
     return {r["step"]: r["loss"] for r in _records(path, "train")}
 
 
-def _trial(mode: str, kill_at: int, base_losses: dict[int, float]) -> dict:
-    """One supervised chaos run; returns the trial record."""
+def _trial(
+    mode: str,
+    kill_at: int,
+    base_losses: dict[int, float],
+    processes: int = 1,
+    victim: int = 0,
+) -> dict:
+    """One supervised chaos run; returns the trial record.  Pod runs
+    (``processes`` > 1) SIGKILL host ``victim`` — alternating writer /
+    non-writer across trials exercises both halves of the single-host
+    relaunch protocol."""
     with tempfile.TemporaryDirectory(prefix="chaos-") as d:
         _write_dataset(os.path.join(d, "t.libsvm"))
-        cfg = _write_cfg(d)
+        cfg = _write_cfg(d, processes)
+        extra = (
+            ["--fault-process", str(victim)] if processes > 1 else []
+        )
         t0 = time.monotonic()
         proc = _run(
             mode, cfg, "--supervised", "--fault-plan", f"kill@{kill_at}",
-            "--max-restarts", "3",
+            "--max-restarts", "3", *extra, processes=processes,
         )
         wall_s = time.monotonic() - t0
         metrics = os.path.join(d, "run.jsonl")
@@ -143,6 +169,9 @@ def _trial(mode: str, kill_at: int, base_losses: dict[int, float]) -> dict:
             "supervisor_rc": proc.returncode,
             "wall_s": round(wall_s, 3),
         }
+        if processes > 1:
+            out["processes"] = processes
+            out["victim"] = victim
         if proc.returncode != 0:
             out["error"] = proc.stdout[-2000:]
             return out
@@ -179,23 +208,42 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=1106)
     ap.add_argument("--sharded", action="store_true",
                     help="also run the dist_train (8-device CPU mesh) path")
-    ap.add_argument("--out", default=os.path.join(REPO, "PROBE_MTTR_r06.json"))
+    ap.add_argument("--processes", type=int, default=1, metavar="N",
+                    help="N > 1: chaos the REAL multi-process pod instead "
+                    "(dist_train under the pod supervisor, gloo CPU; each "
+                    "trial SIGKILLs one host — victims alternate between "
+                    "the writer and a survivor)")
+    ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
+    pod = args.processes > 1
+    out_path = args.out or os.path.join(
+        REPO, "PROBE_MTTR_DIST_r07.json" if pod else "PROBE_MTTR_r06.json"
+    )
 
     rng = random.Random(args.seed)
-    modes = ["train"] + (["dist_train"] if args.sharded else [])
+    modes = (
+        ["dist_train"]
+        if pod
+        else ["train"] + (["dist_train"] if args.sharded else [])
+    )
     result: dict = {
         "steps_total": STEPS,
         "delta_every_steps": DELTA_EVERY,
         "seed": args.seed,
         "paths": {},
     }
+    if pod:
+        result["processes"] = args.processes
     ok = True
     for mode in modes:
         with tempfile.TemporaryDirectory(prefix="chaos-base-") as d:
             _write_dataset(os.path.join(d, "t.libsvm"))
             t0 = time.monotonic()
-            proc = _run(mode, _write_cfg(d))
+            proc = _run(
+                mode, _write_cfg(d, args.processes),
+                *(["--supervised"] if pod else []),
+                processes=args.processes,
+            )
             if proc.returncode != 0:
                 print(proc.stdout[-2000:], file=sys.stderr)
                 print(f"chaos: {mode} baseline failed rc={proc.returncode}",
@@ -207,10 +255,15 @@ def main(argv=None) -> int:
             f"baseline logged {len(base_losses)} steps, wanted {STEPS}"
         )
         trials = []
-        for _ in range(max(1, args.trials)):
+        for i in range(max(1, args.trials)):
             kill_at = rng.randrange(4, STEPS - 3)
-            print(f"chaos: {mode} kill@{kill_at} ...", flush=True)
-            trials.append(_trial(mode, kill_at, base_losses))
+            victim = i % args.processes if pod else 0
+            label = f" victim=host{victim}" if pod else ""
+            print(f"chaos: {mode} kill@{kill_at}{label} ...", flush=True)
+            trials.append(
+                _trial(mode, kill_at, base_losses,
+                       processes=args.processes, victim=victim)
+            )
         mttrs = [
             m for t in trials for m in t.get("mttr_s", [])
             if isinstance(m, (int, float))
@@ -227,10 +280,10 @@ def main(argv=None) -> int:
             "mttr_s_max": round(max(mttrs), 3) if mttrs else None,
             "all_losses_bit_identical": path_ok,
         }
-    with open(args.out, "w") as f:
+    with open(out_path, "w") as f:
         json.dump(result, f, indent=1, sort_keys=True)
         f.write("\n")
-    print(f"chaos: wrote {args.out} (ok={ok})")
+    print(f"chaos: wrote {out_path} (ok={ok})")
     return 0 if ok else 1
 
 

@@ -270,6 +270,60 @@ def summarize(records: list[dict]) -> dict:
         else (0.0 if s["duration_s"] else None)
     )
 
+    # Per-host breakdown (multi-process pods): every record carries the
+    # emitting host's process_index in the envelope; merging the per-host
+    # JSONL files (report.py RUN.jsonl RUN.p1.jsonl ...) for one run_id
+    # yields per-host throughput / stall / MTTR columns.  Host-LEVEL
+    # faults (a peer's heartbeat lost, straggler kills, host crashes) are
+    # counted separately — --compare --strict gates on them.
+    procs = sorted(
+        {
+            r.get("process_index", 0)
+            for r in records
+            if isinstance(r.get("process_index"), int)
+        }
+    )
+    s["hosts"] = {}
+    host_faults = 0
+    for r in kinds.get("stall", []):
+        if str(r.get("classification", "")).startswith("host-"):
+            host_faults += 1
+    for r in faults:
+        if r.get("event") in ("crash", "straggler_kill") and r.get("process") is not None:
+            host_faults += 1
+    s["host_faults"] = host_faults
+    if len(procs) > 1:
+        for p in procs:
+            sub = [r for r in records if r.get("process_index", 0) == p]
+            sk = _by_kind(sub)
+            p_rates = [
+                r["examples_per_sec"]
+                for r in sk.get("train", [])
+                if isinstance(r.get("examples_per_sec"), (int, float))
+            ]
+            p_mttrs = [
+                r["mttr_s"]
+                for r in sk.get("restart", [])
+                if isinstance(r.get("mttr_s"), (int, float))
+            ]
+            s["hosts"][p] = {
+                "records": len(sub),
+                "throughput_median": (
+                    round(statistics.median(p_rates), 1) if p_rates else None
+                ),
+                "steady_compiles": sum(
+                    r.get("compiles", 0)
+                    for r in sk.get("compile", [])
+                    if not r.get("warmup")
+                ),
+                "stalls": len(sk.get("stall", [])),
+                "faults": len(sk.get("fault", [])),
+                "restarts": len(sk.get("restart", [])),
+                "mttr_s_median": (
+                    round(statistics.median(p_mttrs), 3) if p_mttrs else None
+                ),
+            }
+
     mems = kinds.get("mem", [])
     s["host_rss_peak_bytes"] = max(
         (r["host_rss_peak_bytes"] for r in mems if r.get("host_rss_peak_bytes")),
@@ -420,6 +474,22 @@ def render(s: dict, title: str = "run") -> str:
                 f"{s['mttr_s_median']}s, max {s['mttr_s_max']}s"
             )
         L.append("")
+    if s.get("hosts"):
+        L += ["## Hosts (per-process breakdown)", ""]
+        L.append(
+            "| host | records | ex/s median | steady compiles | stalls | "
+            "faults | restarts | MTTR median |"
+        )
+        L.append("|---:|---:|---:|---:|---:|---:|---:|---:|")
+        for p, h in sorted(s["hosts"].items()):
+            L.append(
+                f"| {p} | {h['records']} | {_fmt(h['throughput_median'])} | "
+                f"{h['steady_compiles']} | {h['stalls']} | {h['faults']} | "
+                f"{h['restarts']} | {_fmt(h['mttr_s_median'], 3)} |"
+            )
+        if s.get("host_faults"):
+            L.append(f"- host-level faults: {s['host_faults']}")
+        L.append("")
     L += ["## Memory", ""]
     L.append(f"- host RSS peak: {_fmt_bytes(s['host_rss_peak_bytes'])}")
     L.append(f"- device live-buffer peak: {_fmt_bytes(s['device_peak_bytes'])}")
@@ -517,6 +587,7 @@ def compare(run: dict, base: dict, threshold: float, strict: bool = False):
             ("faults", "faults"),
             ("restarts", "restarts"),
             ("rollbacks", "rollbacks"),
+            ("host_faults", "host-level faults"),
         ):
             if (run.get(key) or 0) > (base.get(key) or 0):
                 regressions.append(
@@ -602,9 +673,19 @@ def main(argv=None) -> int:
         description="Render a fast_tffm_tpu telemetry JSONL run; "
         "--compare gates regressions (exit 1).",
     )
-    ap.add_argument("run", help="telemetry JSONL file (metrics_path of the run)")
     ap.add_argument(
-        "--compare", metavar="BASE", help="baseline telemetry JSONL to diff against"
+        "run",
+        nargs="+",
+        help="telemetry JSONL file(s); pass every per-host file of one "
+        "multi-process run (RUN.jsonl RUN.p1.jsonl ...) to merge them "
+        "into a single report with per-host columns",
+    )
+    ap.add_argument(
+        "--compare",
+        metavar="BASE",
+        nargs="+",
+        help="baseline telemetry JSONL file(s) to diff against (per-host "
+        "files merge like the run's)",
     )
     ap.add_argument(
         "--threshold",
@@ -620,16 +701,24 @@ def main(argv=None) -> int:
     )
     ap.add_argument("--out", metavar="PATH", help="write the report here instead of stdout")
     args = ap.parse_args(argv)
+
+    def _load_many(paths):
+        records = []
+        for p in paths:
+            records.extend(load_run(p))
+        return records
+
     try:
-        run = summarize(load_run(args.run))
+        run = summarize(_load_many(args.run))
     except (OSError, ValueError) as e:
         print(f"report: {e}", file=sys.stderr)
         return 2
-    text = render(run, title=os.path.basename(args.run))
+    title = ", ".join(os.path.basename(p) for p in args.run)
+    text = render(run, title=title)
     rc = 0
     if args.compare:
         try:
-            base = summarize(load_run(args.compare))
+            base = summarize(_load_many(args.compare))
         except (OSError, ValueError) as e:
             print(f"report: {e}", file=sys.stderr)
             return 2
