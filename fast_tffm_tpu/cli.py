@@ -75,6 +75,14 @@ def main(argv: list[str] | None = None) -> int:
         help="step horizon random: fault plans draw positions from",
     )
     ap.add_argument(
+        "--port", type=int, default=None, metavar="P",
+        help="serve only: run the SOCKET front end (serving/frontend.py) on "
+        "this TCP port instead of the stdin/stdout pipe — replicated "
+        "engines, health-checked failover, typed wire errors ([Serving] "
+        "replicas/classes/deadline_ms).  0 = ephemeral (announced as "
+        "SERVE_READY on stdout).  [Serving] port > 0 implies this mode",
+    )
+    ap.add_argument(
         "--fault-process", type=int, default=0, metavar="P",
         help="pod-supervised dist_train only: arm --fault-plan on host P "
         "(default 0, the checkpoint writer; -1 = every host — e.g. nan "
@@ -277,7 +285,20 @@ def main(argv: list[str] | None = None) -> int:
 
         predict(cfg)
     elif args.mode == "serve":
-        # Online path: libsvm lines on stdin -> one score per line on
+        if args.port is not None or cfg.serve_port > 0:
+            # Socket mode: TCP front end -> router -> serve_replicas
+            # engine worker processes (per-replica jit caches), with
+            # health-checked failover, deadline/class admission, and the
+            # router-owned checkpoint-reload fan-out.
+            from fast_tffm_tpu.serving.frontend import run_frontend
+
+            return run_frontend(
+                cfg,
+                args.config,
+                port=args.port,
+                log=lambda *a: print(*a, file=sys.stderr),
+            )
+        # Pipe mode: libsvm lines on stdin -> one score per line on
         # stdout, micro-batched through the bucket-compiled engine
         # ([Serving] config).  Logs/metrics go to stderr/metrics_path so
         # the score stream stays clean for piping.

@@ -137,6 +137,21 @@ class Config:
     #   checkpoint signature before the watcher gives up on it (counted as
     #   reload_giveups + a kind=anomaly record; retries back off
     #   exponentially from reload_interval_s; a NEW write resets)
+    serve_port: int = 0  # socket front end (serving/frontend.py): TCP port
+    #   the `serve` verb listens on; 0 = stdin/stdout mode (the historical
+    #   pipe path) unless the CLI passes --port (0 there = ephemeral,
+    #   introspected and printed — what tests use)
+    serve_replicas: int = 1  # engine replica WORKER PROCESSES behind the
+    #   router (shared-nothing: per-replica jit caches and admission
+    #   queues); 1 still runs the full router path when the front end is up
+    serve_deadline_ms: float = 0.0  # default per-request deadline budget
+    #   (submit -> scored); an expired request is shed BEFORE padding a
+    #   bucket (typed `deadline`, counted as deadline_drops).  0 = none;
+    #   a request's own deadline_ms field overrides
+    serve_classes: tuple[tuple[str, int], ...] = ()  # tiered admission:
+    #   client class -> tier ("gold:2,std:1"); under overload the queue
+    #   sheds strictly-lower tiers first (oldest of the lowest present),
+    #   so degradation follows priority.  Unknown/absent class = tier 0
     # [Resilience] — crash recovery + fault handling (resilience.py)
     on_nan: str = "abort"  # non-finite loss policy: abort (raise before the
     #   next save overwrites good state — the historical behavior) |
@@ -306,6 +321,18 @@ class Config:
                 f"serve_reload_max_retries must be >= 1, got "
                 f"{self.serve_reload_max_retries}"
             )
+        if not (0 <= self.serve_port <= 65535):
+            raise ValueError(f"serve_port must be in [0, 65535], got {self.serve_port}")
+        if self.serve_replicas < 1:
+            raise ValueError(
+                f"serve_replicas must be >= 1, got {self.serve_replicas}"
+            )
+        if self.serve_deadline_ms < 0:
+            raise ValueError(
+                f"serve_deadline_ms must be >= 0 (0 = none), got "
+                f"{self.serve_deadline_ms}"
+            )
+        self.serve_classes = validate_classes(self.serve_classes)
         if self.on_nan not in ("abort", "rollback"):
             raise ValueError(f"unknown on_nan {self.on_nan!r} (abort | rollback)")
         if self.max_rollbacks < 0:
@@ -384,6 +411,42 @@ def validate_buckets(buckets) -> tuple[int, ...]:
     if not out or out[0] < 1:
         raise ValueError(f"serve_buckets must be positive and non-empty, got {buckets!r}")
     return out
+
+
+def validate_classes(classes) -> tuple[tuple[str, int], ...]:
+    """Normalize a serve_classes spec: a ``"gold:2,std:1"`` string or an
+    iterable of (name, tier) pairs → sorted tuple of (name, tier).  Tiers
+    are non-negative ints; names non-empty and unique.  Lives here (like
+    validate_buckets) so config validation stays jax-free."""
+    if isinstance(classes, str):
+        pairs = []
+        for tok in _split(classes):
+            name, sep, tier = tok.partition(":")
+            if not sep or not name:
+                raise ValueError(
+                    f"serve_classes entries are name:tier, got {tok!r}"
+                )
+            pairs.append((name, tier))
+        classes = pairs
+    out = []
+    try:
+        for name, tier in classes:
+            name, tier = str(name), int(tier)
+            if not name or tier < 0:
+                raise ValueError
+            out.append((name, tier))
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"serve_classes must be name:tier pairs with tier >= 0, got {classes!r}"
+        ) from None
+    # Outside the try: the generic format message must not swallow the
+    # far more actionable duplicate-name diagnosis.
+    seen = set()
+    for name, _ in out:
+        if name in seen:
+            raise ValueError(f"duplicate serve_classes name {name!r}")
+        seen.add(name)
+    return tuple(sorted(out))
 
 
 def _split(s: str) -> tuple[str, ...]:
@@ -517,6 +580,10 @@ def load_config(path: str) -> Config:
     cfg.serve_reload_max_retries = get(
         s, "reload_max_retries", int, cfg.serve_reload_max_retries
     )
+    cfg.serve_port = get(s, "port", int, cfg.serve_port)
+    cfg.serve_replicas = get(s, "replicas", int, cfg.serve_replicas)
+    cfg.serve_deadline_ms = get(s, "deadline_ms", float, cfg.serve_deadline_ms)
+    cfg.serve_classes = get(s, "classes", str, cfg.serve_classes)
 
     r = "Resilience"
     cfg.on_nan = get(r, "on_nan", str, cfg.on_nan).lower()

@@ -52,7 +52,9 @@ import time
 
 __all__ = [
     "FAULT_KINDS",
+    "SERVING_FAULT_KINDS",
     "FaultPlan",
+    "RestartPolicy",
     "FaultInjector",
     "install_faults",
     "active_faults",
@@ -128,16 +130,34 @@ def note_io_retry(what: str, exc: Exception, attempt: int = 1) -> None:
 # fault plan / injector
 # ---------------------------------------------------------------------------
 
-# kill_publish appends LAST: the seeded grammar draws positions in
-# FAULT_KINDS order, so inserting it earlier would silently reshuffle
+# New kinds append LAST: the seeded grammar draws positions in
+# FAULT_KINDS order, so inserting one earlier would silently reshuffle
 # every existing seed's schedule (byte-identity is test-pinned).
-FAULT_KINDS = ("kill", "io_error", "nan", "torn_delta", "kill_publish")
+FAULT_KINDS = (
+    "kill",
+    "io_error",
+    "nan",
+    "torn_delta",
+    "kill_publish",
+    "replica_kill",
+    "replica_slow",
+    "reload_corrupt",
+)
 
 # Which ordinal each kind's ``@N`` counts (documented here, enforced by
 # the injection points): kill/nan = absolute training step; io_error =
 # Nth FMB read operation; torn_delta = Kth delta-file write; kill_publish
 # = Kth npz publish (full or delta, in publish order) — SIGKILL between
 # the finished tmp write and the atomic rename, the torn-publish window.
+#
+# SERVING kinds (ISSUE 8; executed by tools/chaos.py --serve against a
+# live front end, not by the in-process FaultInjector): ``@N`` is the
+# REPLICA index (0-based, so >= 0 is legal for these alone).
+# replica_kill@N = SIGKILL replica N; replica_slow@N:MS = inject MS ms of
+# per-flush latency into replica N (the wedged-not-dead axis);
+# reload_corrupt@N = corrupt the checkpoint file so the watcher fan-out's
+# Nth reload wave fails (replicas must keep serving the loaded state).
+SERVING_FAULT_KINDS = ("replica_kill", "replica_slow", "reload_corrupt")
 
 
 class FaultPlan:
@@ -150,8 +170,9 @@ class FaultPlan:
                 raise ValueError(
                     f"unknown fault kind {e.get('kind')!r} (one of {FAULT_KINDS})"
                 )
-            if int(e.get("at", 0)) < 1:
-                raise ValueError(f"fault position must be >= 1: {e}")
+            floor = 0 if e["kind"] in SERVING_FAULT_KINDS else 1
+            if int(e.get("at", floor - 1)) < floor:
+                raise ValueError(f"fault position must be >= {floor}: {e}")
         self.events = sorted(
             (
                 {k: int(v) if k in ("at", "until") else v for k, v in e.items()}
@@ -198,6 +219,15 @@ class FaultPlan:
             # must not depend on dict/spec ordering.
             for kind in FAULT_KINDS:
                 for _ in range(counts.get(kind, 0)):
+                    if kind in SERVING_FAULT_KINDS:
+                        # ``at`` is a replica INDEX; a 2-replica front end
+                        # is the canonical chaos topology.  replica_slow
+                        # also draws its per-flush latency.
+                        e = {"kind": kind, "at": rng.randrange(0, 2)}
+                        if kind == "replica_slow":
+                            e["until"] = rng.randrange(50, 501)
+                        events.append(e)
+                        continue
                     # Per-write/publish ordinals are small numbers; step
                     # ordinals span the horizon.
                     hi = (
@@ -221,15 +251,32 @@ class FaultPlan:
             at, _, until = pos.partition(":")
             e = {"kind": kind, "at": int(at)}
             if until:
-                if kind != "nan":
-                    raise ValueError(f"only nan faults take a window: {tok!r}")
-                e["until"] = int(until)
-                if e["until"] <= e["at"]:
-                    # An inverted/empty window would parse fine and then
-                    # never fire — a chaos run that silently tested nothing.
+                # ``:`` suffixes: nan@A:B = step window [A, B);
+                # replica_slow@N:MS = MS ms of injected per-flush latency.
+                if kind == "nan":
+                    e["until"] = int(until)
+                    if e["until"] <= e["at"]:
+                        # An inverted/empty window would parse fine and then
+                        # never fire — a chaos run that silently tested
+                        # nothing.
+                        raise ValueError(
+                            f"empty nan window {tok!r}: until must be > at"
+                        )
+                elif kind == "replica_slow":
+                    e["until"] = int(until)
+                    if e["until"] < 1:
+                        raise ValueError(
+                            f"replica_slow latency must be >= 1 ms: {tok!r}"
+                        )
+                else:
                     raise ValueError(
-                        f"empty nan window {tok!r}: until must be > at"
+                        f"only nan faults take a window (and replica_slow a "
+                        f"latency) after ':': {tok!r}"
                     )
+            elif kind == "replica_slow":
+                raise ValueError(
+                    f"replica_slow needs a latency: replica_slow@N:MS, got {tok!r}"
+                )
             events.append(e)
         return cls(events, spec=spec, seed=seed)
 
@@ -240,6 +287,12 @@ class FaultPlan:
             sort_keys=True,
             separators=(",", ":"),
         )
+
+    def serving_events(self) -> list[dict]:
+        """The serving-tier faults (replica_kill/slow, reload_corrupt) in
+        schedule order — tools/chaos.py --serve executes these against a
+        live front end; the in-process FaultInjector ignores them."""
+        return [e for e in self.events if e["kind"] in SERVING_FAULT_KINDS]
 
 
 class FaultInjector:
@@ -487,6 +540,29 @@ def repair_delta_chain(path: str, log=print) -> list[str]:
 
 
 # ---------------------------------------------------------------------------
+# restart policy (shared by the training Supervisor and the serving router)
+# ---------------------------------------------------------------------------
+
+
+class RestartPolicy:
+    """Bounded retries + exponential backoff, as data: ``backoff(attempt)``
+    returns the pre-relaunch sleep for restart ``attempt`` (1-based), or
+    None once the budget is spent.  The training Supervisor and the
+    serving router (serving/router.py — the Supervisor's serving mode)
+    must degrade identically, so the arithmetic lives in one place."""
+
+    def __init__(self, max_restarts: int, backoff_s: float, backoff_max_s: float):
+        self.max_restarts = max(0, int(max_restarts))
+        self.backoff_s = float(backoff_s)
+        self.backoff_max_s = float(backoff_max_s)
+
+    def backoff(self, attempt: int) -> float | None:
+        if attempt > self.max_restarts:
+            return None
+        return min(self.backoff_s * (2.0 ** (attempt - 1)), self.backoff_max_s)
+
+
+# ---------------------------------------------------------------------------
 # supervisor
 # ---------------------------------------------------------------------------
 
@@ -552,9 +628,7 @@ class Supervisor:
     ):
         self._build_cmd = build_cmd
         self._model_file = model_file
-        self._max_restarts = max(0, int(max_restarts))
-        self._backoff_s = float(backoff_s)
-        self._backoff_max_s = float(backoff_max_s)
+        self._policy = RestartPolicy(max_restarts, backoff_s, backoff_max_s)
         self._metrics_path = metrics_path
         self._run_id = run_id
         self._log = log
@@ -692,10 +766,11 @@ class Supervisor:
                     + (f", signal {sig}" if sig else "")
                     + f") around step {last_step[0]}"
                 )
-                if attempt >= self._max_restarts:
+                used_backoff = self._policy.backoff(attempt + 1)
+                if used_backoff is None:
                     self._log(
                         f"supervisor: giving up after {attempt} restart(s) "
-                        f"(restart_max = {self._max_restarts})"
+                        f"(restart_max = {self._policy.max_restarts})"
                     )
                     return rc
                 if self._repair:
@@ -703,9 +778,6 @@ class Supervisor:
                         repair_delta_chain(self._model_file, log=self._log)
                     except Exception as e:
                         self._log(f"supervisor: chain repair failed: {e!r}")
-                used_backoff = min(
-                    self._backoff_s * (2.0 ** attempt), self._backoff_max_s
-                )
                 if used_backoff > 0:
                     self._log(f"supervisor: backing off {used_backoff:.1f}s before relaunch")
                     self._sleep(used_backoff)
@@ -884,11 +956,13 @@ class Supervisor:
                             "cannot re-form the pod; giving up"
                         )
                         break
-                    if attempt >= self._max_restarts:
+                    backoff = self._policy.backoff(attempt + 1)
+                    if backoff is None:
                         final_rc = next(rc for _, rc in crashed.values())
                         self._log(
                             f"supervisor: giving up after {attempt} restart "
-                            f"incident(s) (restart_max = {self._max_restarts})"
+                            f"incident(s) (restart_max = "
+                            f"{self._policy.max_restarts})"
                         )
                         break
                     attempt += 1
@@ -907,9 +981,6 @@ class Supervisor:
                             "num_processes": n,
                             "cause": f"host {sorted(crashed)} crashed",
                         },
-                    )
-                    backoff = min(
-                        self._backoff_s * (2.0 ** (attempt - 1)), self._backoff_max_s
                     )
                     if backoff > 0:
                         self._log(

@@ -1,45 +1,67 @@
-"""Online serving subsystem: micro-batched, bucket-compiled inference.
+"""Online serving subsystem: micro-batched, bucket-compiled inference,
+replicated behind a socket front end.
 
 The offline drivers (prediction.py) stream whole files; this package is
 the low-latency ONLINE path the ROADMAP north star ("serves heavy traffic
-from millions of users") asks for.  In-process, no network layer — a
-transport (gRPC/HTTP) would wrap ``ServingEngine.submit_line`` without
-touching anything here.
+from millions of users") asks for.
 
-Pieces (DESIGN.md "Serving"):
+Pieces (DESIGN.md "Serving" + "Serving resilience"):
 
   * ``BucketLadder`` (buckets.py) — predict functions pre-compiled at a
     ladder of batch sizes; requests pad up to the nearest bucket so no
     request ever triggers a fresh XLA compile in steady state;
   * ``ServingEngine`` (engine.py) — micro-batching collector (flush on
     ``serve_max_batch`` or the ``serve_flush_deadline_ms`` timer),
-    bounded admission queue (block | reject), hot checkpoint reload with
-    atomic swap between flushes;
+    tiered admission (admission.py: shed-by-class eviction under
+    overload), per-request deadlines shed before bucket padding, hot
+    checkpoint reload with atomic swap between flushes;
   * ``ServingMetrics`` (metrics.py) — queue/compute latency histograms
-    (p50/p95/p99), batch occupancy, reload counters, exported through the
-    existing utils.tracing.MetricsLogger JSONL path.
+    (p50/p95/p99, per client class), occupancy, shed/drop/reload
+    counters, exported through the telemetry JSONL path;
+  * the replicated tier (protocol.py, replica.py, router.py,
+    frontend.py) — a TCP front end (`serve --port`) multiplexing onto N
+    engine worker processes behind a health-checked router: failover
+    with one bit-identical retry, bounded-backoff replica restart with
+    MTTR telemetry, one checkpoint watcher fanning reloads to all
+    replicas, typed wire errors (overloaded | deadline | bad_request |
+    unavailable) — never a silently dropped connection.
 
-``tools/loadgen.py`` drives the engine open-loop (Poisson) or closed-loop
-and emits a BENCH_SERVE JSON, the serving analog of bench.py's train
-BENCH files.
+``tools/loadgen.py`` drives either transport (in-process, or the socket
+tier via --connect/--spawn) and emits a BENCH_SERVE JSON; ``tools/
+chaos.py --serve`` kills/slows/corrupts replicas under live traffic and
+pins the no-hung-client + bit-identical-scores acceptance.
 """
 
+from fast_tffm_tpu.serving.admission import AdmissionQueue
 from fast_tffm_tpu.serving.buckets import BucketLadder, validate_buckets
 from fast_tffm_tpu.serving.engine import (
+    DeadlineExceeded,
     EngineClosed,
     OverloadError,
     ServingEngine,
     serve_lines,
 )
 from fast_tffm_tpu.serving.metrics import LatencyHistogram, ServingMetrics
+from fast_tffm_tpu.serving.protocol import (
+    BadRequest,
+    Overloaded,
+    Unavailable,
+    WireError,
+)
 
 __all__ = [
+    "AdmissionQueue",
+    "BadRequest",
     "BucketLadder",
+    "DeadlineExceeded",
     "EngineClosed",
     "LatencyHistogram",
+    "Overloaded",
     "OverloadError",
     "ServingEngine",
     "ServingMetrics",
+    "Unavailable",
+    "WireError",
     "serve_lines",
     "validate_buckets",
 ]

@@ -1,0 +1,188 @@
+"""Client-side serving helpers: pipelined wire connection + spawner.
+
+tools/loadgen.py (bench) and tools/chaos.py (chaos probe) both speak to
+a live front end; this module is their ONE implementation of the
+pipelined JSONL connection and the `SERVE_READY` spawn-and-wait, so a
+wire or readiness change cannot silently split the tools.  jax-free.
+
+``ServeConnection`` multiplexes by caller-assigned ``id``: attach a
+``meta`` to each send and route responses through ``on_response(msg,
+meta)`` (return falsy to ALSO keep the message in ``responses``), or
+use the default accumulation in ``responses`` and the synchronous
+``request()`` for ops.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+from fast_tffm_tpu.serving.protocol import SERVE_READY_PREFIX, decode, encode
+
+__all__ = ["ServeConnection", "spawn_serve"]
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class _SyncBox:
+    """Meta marker that turns a response into a synchronous result."""
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.msg = None
+
+
+class ServeConnection:
+    """One pipelined TCP connection to a front end (or replica — same
+    wire).  Thread-safe sends; one reader thread resolves responses."""
+
+    def __init__(self, port: int, host: str = "127.0.0.1", on_response=None,
+                 timeout: float = 60.0):
+        import socket as _socket
+
+        self.sock = _socket.create_connection((host, port), timeout=timeout)
+        self.sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        self._f = self.sock.makefile("rb")
+        self._on_response = on_response
+        self.lock = threading.Lock()
+        self._pending: dict = {}  # id -> meta
+        self.responses: dict = {}  # id -> msg (unconsumed responses)
+        self._next = 0
+        self._reader = threading.Thread(target=self._read, daemon=True)
+        self._reader.start()
+
+    def next_id(self) -> int:
+        with self.lock:
+            self._next += 1
+            return self._next
+
+    def send(self, msg: dict, meta=None) -> None:
+        """Send one message; ``msg['id']`` is the response key (assigned
+        from the connection counter when absent)."""
+        if "id" not in msg:
+            msg["id"] = self.next_id()
+        with self.lock:
+            self._pending[msg["id"]] = meta
+        self.sock.sendall(encode(msg))
+
+    def request(self, msg: dict, timeout: float = 30.0) -> dict:
+        """Synchronous op (ping/stats/slow): send and wait for its ack."""
+        box = _SyncBox()
+        self.send(msg, meta=box)
+        if not box.event.wait(timeout):
+            raise TimeoutError(f"op {msg.get('op')!r} not answered in {timeout}s")
+        return box.msg
+
+    def _read(self) -> None:
+        try:
+            for raw in self._f:
+                raw = raw.strip()
+                if not raw:
+                    continue
+                try:
+                    msg = decode(raw)
+                except Exception:
+                    continue
+                with self.lock:
+                    meta = self._pending.pop(msg.get("id"), None)
+                if isinstance(meta, _SyncBox):
+                    meta.msg = msg
+                    meta.event.set()
+                    continue
+                if self._on_response is not None and self._on_response(msg, meta):
+                    continue
+                with self.lock:
+                    self.responses[msg.get("id")] = msg
+        except (OSError, ValueError):
+            pass
+
+    def inflight(self) -> int:
+        with self.lock:
+            return len(self._pending)
+
+    def wait_answered(self, ids, timeout: float) -> set:
+        """Block until every id in ``ids`` has a stored response (default
+        routing); returns the ids still missing at the deadline."""
+        deadline = time.monotonic() + timeout
+        missing = set(ids)
+        while missing and time.monotonic() < deadline:
+            with self.lock:
+                missing = {i for i in missing if i not in self.responses}
+            if missing:
+                time.sleep(0.05)
+        return missing
+
+    def drain_inflight(self, timeout: float) -> int:
+        """Wait for the pending map to empty; returns what's left."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline and self.inflight():
+            time.sleep(0.01)
+        return self.inflight()
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def spawn_serve(
+    cfg_path: str,
+    *,
+    port: int = 0,
+    timeout_s: float = 300.0,
+    log=None,
+) -> tuple[subprocess.Popen, int]:
+    """Launch ``fast_tffm.py serve <cfg> --port N`` and block until its
+    SERVE_READY line (deadline bounds SILENCE — a child wedged before
+    its first output fails at the deadline, not never); returns (proc,
+    announced port).  Caller owns terminate()."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(_REPO, "fast_tffm.py"), "serve",
+         cfg_path, "--port", str(port)],
+        stdout=subprocess.PIPE,
+        stderr=None,
+        text=True,
+        env=env,
+        cwd=_REPO,
+    )
+    ready = threading.Event()
+    box: list[int | None] = [None]
+
+    def wait_ready():
+        try:
+            for line in proc.stdout:
+                line = line.strip()
+                if line.startswith(SERVE_READY_PREFIX):
+                    fields = dict(
+                        kv.split("=", 1)
+                        for kv in line[len(SERVE_READY_PREFIX):].split()
+                    )
+                    box[0] = int(fields["port"])
+                    ready.set()
+                    break
+                if line and log is not None:
+                    log(line)
+            # After readiness (or EOF), keep draining so the pipe never
+            # fills and blocks the server.
+            for line in proc.stdout:
+                if line.strip() and log is not None:
+                    log(line.strip())
+        except Exception:
+            pass
+        ready.set()
+
+    threading.Thread(target=wait_ready, name="serve-ready", daemon=True).start()
+    ready.wait(timeout_s)
+    if box[0] is None:
+        proc.kill()
+        raise RuntimeError(
+            f"spawned front end never announced SERVE_READY within {timeout_s:.0f}s"
+        )
+    return proc, box[0]

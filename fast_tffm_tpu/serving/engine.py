@@ -46,15 +46,24 @@ from fast_tffm_tpu.checkpoint import (
 )
 from fast_tffm_tpu.config import Config
 from fast_tffm_tpu.data.libsvm import parse_lines
+from fast_tffm_tpu.serving.admission import AdmissionQueue
 from fast_tffm_tpu.serving.buckets import BucketLadder
 from fast_tffm_tpu.serving.metrics import ServingMetrics
+from fast_tffm_tpu.serving.protocol import DeadlineExceeded
 from fast_tffm_tpu.telemetry import RunMonitor
 
-__all__ = ["ServingEngine", "OverloadError", "EngineClosed", "serve_lines"]
+__all__ = [
+    "ServingEngine",
+    "OverloadError",
+    "DeadlineExceeded",
+    "EngineClosed",
+    "serve_lines",
+]
 
 
 class OverloadError(RuntimeError):
-    """Admission queue full under serve_overload = reject."""
+    """Admission queue full under serve_overload = reject, or a queued
+    request evicted by a higher-class arrival (tiered admission)."""
 
 
 class EngineClosed(RuntimeError):
@@ -69,6 +78,9 @@ class _Request:
     row: tuple  # (ids [max_nnz] i32, vals [max_nnz] f32, fields [max_nnz] i32)
     future: Future = field(default_factory=Future)
     t_submit: float = field(default_factory=time.perf_counter)
+    klass: str = ""  # client class name ("" = default tier)
+    tier: int = 0  # admission tier (higher sheds later; from serve_classes)
+    deadline_t: float | None = None  # perf_counter deadline; None = none
 
 
 class ServingEngine:
@@ -79,7 +91,9 @@ class ServingEngine:
     differently-shaped batches, agreement is within a few float32 ULPs
     on backends where XLA programs of different shapes round apart."""
 
-    def __init__(self, cfg: Config, log=print, state=None, model=None):
+    def __init__(
+        self, cfg: Config, log=print, state=None, model=None, replica: int | None = None
+    ):
         from fast_tffm_tpu.prediction import load_scoring_state, make_score_fn
         from fast_tffm_tpu.training import scan_max_nnz
 
@@ -141,7 +155,20 @@ class ServingEngine:
             )
         self.deadline_s = cfg.serve_flush_deadline_ms / 1e3
         self._policy = cfg.serve_overload
-        self._q: queue.Queue = queue.Queue(maxsize=cfg.serve_queue_size)
+        self._q = AdmissionQueue(cfg.serve_queue_size)
+        # Tiered admission (serve_classes): class name -> tier; unknown /
+        # absent classes land at tier 0 (shed first).  Per-request
+        # deadlines default to serve_deadline_ms (0 = none) unless the
+        # submit carries its own.
+        self._tiers = dict(cfg.serve_classes)
+        self._default_deadline_s = (
+            cfg.serve_deadline_ms / 1e3 if cfg.serve_deadline_ms > 0 else None
+        )
+        # Chaos/latency injection (tools/chaos.py replica_slow@N:ms): the
+        # next `_slow_flushes` flushes sleep `_slow_ms` before dispatch.
+        self._slow_ms = 0.0
+        self._slow_flushes = 0
+        self._last_flush_t = time.perf_counter()
         self.metrics = ServingMetrics()
         # kind=serving records ride the same telemetry envelope as the
         # train/predict drivers (shared run_id per engine lifetime); the
@@ -153,6 +180,7 @@ class ServingEngine:
             run_id=cfg.telemetry_run_id,
             source="serving",
             mem_every_s=cfg.telemetry_mem_every_s,
+            replica=replica,
             log=log,
         )
         self._flush_seq = 0  # telemetry step for serving = flush ordinal
@@ -171,6 +199,15 @@ class ServingEngine:
         self._staged_state = None
         self._staged_step = None
         self._staged_is_delta = False
+        # Reload failure discipline for ONE observed signature (shared by
+        # the polling watcher thread and router-driven reload_once calls):
+        # retries back off exponentially, and after serve_reload_max_retries
+        # consecutive failures the engine GIVES UP on that signature until
+        # a NEW write lands.
+        self._fail_sig = None
+        self._fail_count = 0
+        self._gave_up = False
+        self._next_retry_t = 0.0
 
         n = self._ladder.warmup(self._state)
         # Attribute every startup compile (ladder rungs + unpackers) to
@@ -208,12 +245,30 @@ class ServingEngine:
     def compile_count(self) -> int | None:
         return self._ladder.compile_count()
 
-    def submit_line(self, line: str) -> Future:
+    def submit_line(
+        self,
+        line: str,
+        *,
+        klass: str = "",
+        deadline_ms: float | None = None,
+        deadline_at: float | None = None,
+    ) -> Future:
         """Submit one libsvm/libffm line (``label feat:val ...`` — the
         label is required by the grammar and ignored, the exact format of
         predict_files).  Returns a Future resolving to the float score.
         Malformed lines and rows wider than max_nnz raise ValueError in
-        the caller (admission is never charged for parse errors)."""
+        the caller (admission is never charged for parse errors).
+
+        ``klass`` names the client class (tier from serve_classes;
+        unknown = tier 0, shed first).  ``deadline_ms`` is THIS request's
+        deadline from submit time (None = serve_deadline_ms; 0 disables):
+        a request still unscored when it expires is shed pre-padding with
+        DeadlineExceeded and counted as a deadline_drop.  ``deadline_at``
+        (a ``time.monotonic()`` timestamp, same host) wins over both —
+        it is how the socket front end anchors the budget at WIRE receipt
+        so time spent in TCP buffers and reader backlog counts too; the
+        engine converts it to a remaining budget at ingest, so the two
+        clocks never need a shared epoch."""
         parsed = parse_lines(
             [line],
             vocabulary_size=self._cfg.vocabulary_size,
@@ -225,10 +280,22 @@ class ServingEngine:
                 parsed.ids[0].astype(np.int32, copy=False),
                 parsed.vals[0],
                 parsed.fields[0],
-            )
+            ),
+            klass=klass,
+            deadline_ms=deadline_ms,
+            deadline_at=deadline_at,
         )
 
-    def submit(self, ids, vals, fields=None) -> Future:
+    def submit(
+        self,
+        ids,
+        vals,
+        fields=None,
+        *,
+        klass: str = "",
+        deadline_ms: float | None = None,
+        deadline_at: float | None = None,
+    ) -> Future:
         """Submit one pre-parsed example (1-D ids/vals[/fields], up to
         max_nnz entries; zero-padded here).  The programmatic twin of
         submit_line for callers that skip text."""
@@ -262,33 +329,72 @@ class ServingEngine:
             ids = np.pad(ids, (0, pad))
             vals = np.pad(vals, (0, pad))
             fields = np.pad(fields, (0, pad))
-        return self._submit_row((ids, vals, fields))
+        return self._submit_row(
+            (ids, vals, fields),
+            klass=klass,
+            deadline_ms=deadline_ms,
+            deadline_at=deadline_at,
+        )
 
-    def _submit_row(self, row) -> Future:
-        req = _Request(row)
+    def _shed_evicted(self, evicted: "_Request | None") -> None:
+        """Fail an evicted request's future with the typed overload error
+        — the no-silent-drop half of tiered admission."""
+        if evicted is None:
+            return
+        if evicted.future.set_running_or_notify_cancel():
+            evicted.future.set_exception(
+                OverloadError(
+                    f"shed: evicted by a higher-class arrival under overload "
+                    f"(class {evicted.klass or 'default'!r}, tier {evicted.tier})"
+                )
+            )
+        self.metrics.on_evict(evicted.klass)
+
+    def _submit_row(
+        self,
+        row,
+        *,
+        klass: str = "",
+        deadline_ms: float | None = None,
+        deadline_at: float | None = None,
+    ) -> Future:
+        req = _Request(row, klass=klass, tier=self._tiers.get(klass, 0))
+        if deadline_at is not None:
+            # Wire-anchored absolute deadline: convert the REMAINING
+            # monotonic budget into this engine's perf_counter terms (one
+            # clock read; no shared epoch assumed).  May be <= 0 already —
+            # the flush sheds it before padding, which is the point:
+            # backlog time upstream of admission counts.
+            req.deadline_t = req.t_submit + (deadline_at - time.monotonic())
+        else:
+            dl = self._default_deadline_s if deadline_ms is None else deadline_ms / 1e3
+            if dl is not None and dl > 0:
+                req.deadline_t = req.t_submit + dl
         if self._closed:
             raise EngineClosed("engine is closed")
         if self._policy == "reject":
             try:
-                self._q.put_nowait(req)
+                self._shed_evicted(self._q.put_nowait(req, tier=req.tier))
             except queue.Full:
-                self.metrics.on_submit(accepted=False)
+                self.metrics.on_submit(accepted=False, klass=klass)
                 raise OverloadError(
                     f"admission queue full ({self._q.maxsize} pending) — "
                     "overload; shed load or raise serve_queue_size / switch "
                     "serve_overload to block"
                 ) from None
         else:  # block: backpressure, re-checking closure so a shutdown
-            # mid-overload can't strand the caller forever.
+            # mid-overload can't strand the caller forever.  (A strictly
+            # lower-tier queued request is still evicted rather than
+            # blocking the higher-class arrival behind shed-able traffic.)
             while True:
                 if self._closed:
                     raise EngineClosed("engine closed while blocked on admission")
                 try:
-                    self._q.put(req, timeout=0.1)
+                    self._shed_evicted(self._q.put(req, tier=req.tier, timeout=0.1))
                     break
                 except queue.Full:
                     continue
-        self.metrics.on_submit(accepted=True)
+        self.metrics.on_submit(accepted=True, klass=klass)
         # Close-race epilogue: if close() finished its drain between our
         # closed-check and our enqueue, nobody will ever pop this request.
         # _closed is set BEFORE close joins/drains, so observing it here
@@ -406,8 +512,35 @@ class ServingEngine:
         # set_running_or_notify_cancel() both blocks late cancels and
         # filters already-cancelled requests out of the batch.
         pending = [r for r in pending if r.future.set_running_or_notify_cancel()]
+        # Deadline shed BEFORE padding: a request whose own deadline has
+        # already expired cannot be answered in time — scoring it would
+        # only inflate the bucket (and the batch's latency) for an answer
+        # nobody is waiting for.  Shedding first can also shrink the
+        # bucket the survivors pad to.
+        now = time.perf_counter()
+        live = []
+        for r in pending:
+            if r.deadline_t is not None and now >= r.deadline_t:
+                r.future.set_exception(
+                    DeadlineExceeded(
+                        f"deadline expired {1e3 * (now - r.deadline_t):.1f}ms "
+                        f"before scoring (waited {1e3 * (now - r.t_submit):.1f}ms)"
+                    )
+                )
+                self.metrics.on_deadline_drop(r.klass)
+            else:
+                live.append(r)
+        pending = live
         if not pending:
+            # Still PROGRESS: the collector drained (and answered) work —
+            # an all-shed flush must advance the liveness clock or a
+            # tight-deadline overload reads as a wedged collector to the
+            # router's health checks.
+            self._last_flush_t = time.perf_counter()
             return
+        if self._slow_flushes > 0:  # injected latency (chaos replica_slow)
+            self._slow_flushes -= 1
+            time.sleep(self._slow_ms / 1e3)
         t_start = time.perf_counter()
         try:
             batch, bucket = self._ladder.assemble([r.row for r in pending])
@@ -422,6 +555,7 @@ class ServingEngine:
                 self._log(f"serving: flush failed: {e!r}")
             except Exception:
                 pass
+            self._last_flush_t = time.perf_counter()  # answered = progress
             return
         for i, r in enumerate(pending):
             r.future.set_result(float(scores[i]))
@@ -434,6 +568,7 @@ class ServingEngine:
             # failure (ENOSPC mem record) degrades to a lost record —
             # it must NEVER kill the collector.
             pass
+        self._last_flush_t = t_resolved
         self.metrics.on_flush(
             bucket,
             len(pending),
@@ -441,6 +576,7 @@ class ServingEngine:
             compute_s=t_done - t_dispatch,
             total_s=[t_resolved - r.t_submit for r in pending],
             deadline_fired=deadline_fired,
+            classes=[r.klass for r in pending],
         )
         if (
             self._metrics_every > 0
@@ -531,113 +667,160 @@ class ServingEngine:
             )
         return (state, len(new))
 
-    def _watch(self) -> None:
+    def _note_reload_failure(self, sig, what, exc) -> None:
+        """Failure discipline for ONE observed signature: retries back
+        off exponentially from the poll interval, and after
+        serve_reload_max_retries consecutive failures the engine GIVES
+        UP on that signature (reload_giveups counter + kind=anomaly
+        record) instead of hot-spinning reload_failures forever on a
+        persistently corrupt file.  Any NEW write (signature change)
+        resets the state and retries immediately."""
+        self.metrics.on_reload(ok=False)
+        if sig != self._fail_sig:
+            self._fail_sig, self._fail_count, self._gave_up = sig, 0, False
+        self._fail_count += 1
+        backoff = min(
+            max(self._cfg.serve_reload_interval_s, 0.01) * (2.0 ** self._fail_count),
+            60.0,
+        )
+        self._next_retry_t = time.monotonic() + backoff
+        self._log(
+            f"serving: {what} of {self._cfg.model_file} failed "
+            f"(attempt {self._fail_count}/{self._cfg.serve_reload_max_retries}, "
+            f"next retry in {backoff:.2f}s): {exc!r}"
+        )
+        if self._fail_count >= self._cfg.serve_reload_max_retries:
+            self._gave_up = True
+            self.metrics.on_reload_giveup()
+            try:
+                self._monitor.emit_anomaly(
+                    self.step, None, event="reload_giveup",
+                    path=self._cfg.model_file, error=repr(exc),
+                    attempts=self._fail_count,
+                )
+            except Exception:
+                pass  # a full metrics disk must not kill the watcher
+            self._log(
+                f"serving: giving up on this checkpoint write after "
+                f"{self._fail_count} failed reloads — persistently corrupt? "
+                "serving continues on the loaded state; a NEW write "
+                "will be retried"
+            )
+
+    def _reload_tick(self) -> str:
+        """One reload attempt: check the signature, stage a new state if
+        one landed.  Called by the polling watcher thread (its loop body)
+        and by ``reload_once`` (a router fanning out ONE reload command
+        to every replica).  Returns the outcome for the caller's ack:
+        ``noop`` | ``staged`` | ``staged_delta`` | ``failed`` |
+        ``backoff`` | ``busy``."""
+        with self._reload_lock:
+            if self._staged_state is not None:
+                # The collector hasn't swapped the previous stage yet;
+                # applying deltas onto _state now would drop that stage.
+                return "busy"
+        sig = checkpoint_signature(self._cfg.model_file)
+        if sig is None or sig == self._loaded_sig:
+            return "noop"
+        if sig == self._fail_sig:
+            if self._gave_up or time.monotonic() < self._next_retry_t:
+                return "backoff"  # backing off / abandoned until a new write
+        else:
+            self._fail_sig, self._fail_count, self._gave_up = None, 0, False
+        with self._monitor.warmup_window():
+            return self._reload_attempt(sig)
+
+    def _reload_attempt(self, sig) -> str:
+        """The actual restore/apply work of one reload tick.  Runs inside
+        a telemetry warmup_window: the chunked-restore and delta-apply
+        programs it may compile execute OFF the hot path (the collector
+        keeps flushing the old state), so they must not read as
+        steady-state score recompiles."""
         import os as _os
 
         from fast_tffm_tpu.prediction import load_scoring_state
 
-        # Failure discipline for ONE observed signature: retries back off
-        # exponentially from the poll interval, and after
-        # serve_reload_max_retries consecutive failures the watcher GIVES
-        # UP on that signature (reload_giveups counter + kind=anomaly
-        # record) instead of hot-spinning reload_failures forever on a
-        # persistently corrupt file.  Any NEW write (signature change)
-        # resets the state and retries immediately.
-        fail_sig = None
-        fail_count = 0
-        gave_up = False
-        next_retry_t = 0.0
+        state = None
+        applied = 0
+        if not _os.path.isdir(self._cfg.model_file):
+            try:
+                got = self._try_apply_deltas()
+            except Exception as e:
+                # Torn/mid-write delta: count, keep serving, retry with
+                # backoff (signature not advanced, so a complete write
+                # still reloads).
+                self._note_reload_failure(sig, "delta reload", e)
+                return "failed"
+            if got == (None, 0):
+                # Signature moved without new chain content (e.g. a
+                # same-base rewrite mid-observation) — nothing to do.
+                self._loaded_sig = sig
+                return "noop"
+            if got is not None:
+                state, applied = got
+        if state is None:
+            # Full restore OFF the hot path: the collector keeps serving
+            # the old state while this loads.  Chain baseline is read
+            # PRE-restore (under-count = safe, see above).
+            new_sid, new_applied = self._chain_baseline()
+            try:
+                _, state = load_scoring_state(self._cfg, log=lambda *_: None)
+            except Exception as e:
+                # Torn write (non-atomic writer, or a checkpoint
+                # mid-copy): count it, keep serving, back off.
+                self._note_reload_failure(sig, "reload", e)
+                return "failed"
+            self._loaded_save_id = new_sid
+            self._applied_deltas = new_applied
+        else:
+            self._applied_deltas += applied
+            self.metrics.on_delta_reload(applied)
+        self._fail_sig, self._fail_count, self._gave_up = None, 0, False
+        self._loaded_sig = sig
+        with self._reload_lock:
+            self._staged_state = state
+            self._staged_step = int(state.step)
+            self._staged_is_delta = applied > 0
+        return "staged_delta" if applied > 0 else "staged"
 
-        def note_failure(sig, what, exc):
-            nonlocal fail_sig, fail_count, gave_up, next_retry_t
-            self.metrics.on_reload(ok=False)
-            if sig != fail_sig:
-                fail_sig, fail_count, gave_up = sig, 0, False
-            fail_count += 1
-            backoff = min(
-                max(self._cfg.serve_reload_interval_s, 0.01) * (2.0 ** fail_count),
-                60.0,
-            )
-            next_retry_t = time.monotonic() + backoff
-            self._log(
-                f"serving: {what} of {self._cfg.model_file} failed "
-                f"(attempt {fail_count}/{self._cfg.serve_reload_max_retries}, "
-                f"next retry in {backoff:.2f}s): {exc!r}"
-            )
-            if fail_count >= self._cfg.serve_reload_max_retries:
-                gave_up = True
-                self.metrics.on_reload_giveup()
-                try:
-                    self._monitor.emit_anomaly(
-                        self.step, None, event="reload_giveup",
-                        path=self._cfg.model_file, error=repr(exc),
-                        attempts=fail_count,
-                    )
-                except Exception:
-                    pass  # a full metrics disk must not kill the watcher
-                self._log(
-                    f"serving: giving up on this checkpoint write after "
-                    f"{fail_count} failed reloads — persistently corrupt? "
-                    "serving continues on the loaded state; a NEW write "
-                    "will be retried"
-                )
+    def reload_once(self) -> dict:
+        """Router-driven reload: one watcher tick on the CALLER's thread
+        (the replica worker runs it off its reader loop).  The in-process
+        polling watcher stays off (serve_reload_interval_s = 0) when a
+        router owns reload fan-out — exactly one of the two drives
+        reloads, so a delta is applied exactly once per replica."""
+        status = self._reload_tick()
+        return {"status": status, "step": self.step}
 
+    def _watch(self) -> None:
         while not self._stop.wait(self._cfg.serve_reload_interval_s):
-            with self._reload_lock:
-                pending = self._staged_state is not None
-            if pending:
-                # The collector hasn't swapped the previous stage yet;
-                # applying deltas onto _state now would drop that stage.
-                continue
-            sig = checkpoint_signature(self._cfg.model_file)
-            if sig is None or sig == self._loaded_sig:
-                continue
-            if sig == fail_sig:
-                if gave_up or time.monotonic() < next_retry_t:
-                    continue  # backing off / abandoned until a new write
-            else:
-                fail_sig, fail_count, gave_up = None, 0, False
-            state = None
-            applied = 0
-            if not _os.path.isdir(self._cfg.model_file):
-                try:
-                    got = self._try_apply_deltas()
-                except Exception as e:
-                    # Torn/mid-write delta: count, keep serving, retry
-                    # with backoff (signature not advanced, so a complete
-                    # write still reloads).
-                    note_failure(sig, "delta reload", e)
-                    continue
-                if got == (None, 0):
-                    # Signature moved without new chain content (e.g. a
-                    # same-base rewrite mid-observation) — nothing to do.
-                    self._loaded_sig = sig
-                    continue
-                if got is not None:
-                    state, applied = got
-            if state is None:
-                # Full restore OFF the hot path: the collector keeps
-                # serving the old state while this loads.  Chain baseline
-                # is read PRE-restore (under-count = safe, see above).
-                new_sid, new_applied = self._chain_baseline()
-                try:
-                    _, state = load_scoring_state(self._cfg, log=lambda *_: None)
-                except Exception as e:
-                    # Torn write (non-atomic writer, or a checkpoint
-                    # mid-copy): count it, keep serving, back off.
-                    note_failure(sig, "reload", e)
-                    continue
-                self._loaded_save_id = new_sid
-                self._applied_deltas = new_applied
-            else:
-                self._applied_deltas += applied
-                self.metrics.on_delta_reload(applied)
-            fail_sig, fail_count, gave_up = None, 0, False
-            self._loaded_sig = sig
-            with self._reload_lock:
-                self._staged_state = state
-                self._staged_step = int(state.step)
-                self._staged_is_delta = applied > 0
+            self._reload_tick()
+
+    # -- health / chaos ----------------------------------------------------
+
+    def inject_slow(self, ms: float, flushes: int = 1) -> None:
+        """Chaos hook (FaultPlan replica_slow@N:ms): make the next
+        ``flushes`` flushes sleep ``ms`` before dispatch — a degraded or
+        wedged replica, without touching real scoring."""
+        self._slow_ms = float(ms)
+        self._slow_flushes = int(flushes)
+
+    def health(self) -> dict:
+        """O(1) liveness probe for routers/load balancers: queue depth,
+        age of the oldest QUEUED request (keeps growing when the
+        collector wedges — the router's wedge signal), time since the
+        last completed flush, and whether the engine still accepts."""
+        now = time.perf_counter()
+        oldest = self._q.oldest_wait_s(now)
+        return {
+            "ok": not self._closed,
+            "closed": self._closed,
+            "step": self.step,
+            "queue_depth": self._q.qsize(),
+            "oldest_wait_s": round(oldest, 4) if oldest is not None else None,
+            "last_flush_age_s": round(now - self._last_flush_t, 4),
+            "steady_compiles": self._monitor.compiles_steady,
+        }
 
     # -- shutdown --------------------------------------------------------
 
@@ -652,16 +835,10 @@ class ServingEngine:
         self._close_done = True
         self._closed = True
         self._stop.set()
-        # Bounded-queue etiquette: a live collector will make room for
-        # the sentinel; a DEAD one (flush raised) never will — don't
-        # block close() forever on its full queue.
-        while True:
-            try:
-                self._q.put(_CLOSE, timeout=0.1)
-                break
-            except queue.Full:
-                if not self._collector.is_alive():
-                    break
+        # The sentinel bypasses the admission bound (put_sentinel), so a
+        # full queue — or a dead collector behind one — can never block
+        # close(); a dead collector's exit drain clears it regardless.
+        self._q.put_sentinel(_CLOSE)
         self._collector.join(timeout=timeout)
         # A submit that passed the closed-check concurrently with this
         # close can enqueue AFTER the collector's exit drain — fail its

@@ -102,6 +102,15 @@ class ServingMetrics:
         self.requests = 0
         self.rejected = 0
         self.flushes = 0
+        self.deadline_drops = 0  # requests shed at flush because their OWN
+        #   deadline expired before scoring (pre-padding; typed `deadline`)
+        self.drops_by_class: dict[str, int] = {}  # deadline drops per class
+        self.sheds_by_class: dict[str, int] = {}  # overload sheds per class:
+        #   submit-side rejects AND tiered evictions (typed `overloaded`)
+        self.evicted = 0  # queued requests evicted by a higher-class arrival
+        #   (a subset of the sheds — says tiering, not just pressure, fired)
+        self.class_total: dict[str, LatencyHistogram] = {}  # per-class
+        #   submit→resolved latency (the per-class p50/p99 the SLO gate reads)
         self.flushes_deadline = 0  # timer fired before max_batch filled
         self.flushes_full = 0  # max_batch filled before the timer
         self.rows = 0  # real rows scored (excl. bucket padding)
@@ -115,11 +124,32 @@ class ServingMetrics:
         #   swap does NOT also bump `reloads` — the counters are disjoint)
         self.bucket_rows: dict[int, int] = {}  # bucket size -> real rows
 
-    def on_submit(self, accepted: bool) -> None:
+    @staticmethod
+    def _class_key(klass: str) -> str:
+        return klass or "default"
+
+    def on_submit(self, accepted: bool, klass: str = "") -> None:
         with self._lock:
             self.requests += 1
             if not accepted:
                 self.rejected += 1
+                k = self._class_key(klass)
+                self.sheds_by_class[k] = self.sheds_by_class.get(k, 0) + 1
+
+    def on_evict(self, klass: str = "") -> None:
+        """A QUEUED request was shed to admit a higher-class arrival."""
+        with self._lock:
+            self.evicted += 1
+            k = self._class_key(klass)
+            self.sheds_by_class[k] = self.sheds_by_class.get(k, 0) + 1
+
+    def on_deadline_drop(self, klass: str = "") -> None:
+        """A request's own deadline expired before scoring — shed at the
+        flush, BEFORE it could pad a bucket."""
+        with self._lock:
+            self.deadline_drops += 1
+            k = self._class_key(klass)
+            self.drops_by_class[k] = self.drops_by_class.get(k, 0) + 1
 
     def on_flush(
         self,
@@ -129,6 +159,7 @@ class ServingMetrics:
         compute_s: float,
         total_s: list[float],
         deadline_fired: bool,
+        classes: list[str] | None = None,
     ) -> None:
         with self._lock:
             self.flushes += 1
@@ -142,8 +173,14 @@ class ServingMetrics:
             self.compute.add(compute_s)
             for w in queue_waits:
                 self.queue.add(w)
-            for t in total_s:
+            for i, t in enumerate(total_s):
                 self.total.add(t)
+                if classes is not None:
+                    k = self._class_key(classes[i])
+                    h = self.class_total.get(k)
+                    if h is None:
+                        h = self.class_total[k] = LatencyHistogram()
+                    h.add(t)
 
     def on_reload(self, ok: bool) -> None:
         with self._lock:
@@ -172,6 +209,13 @@ class ServingMetrics:
             return {
                 "requests": self.requests,
                 "rejected": self.rejected,
+                "deadline_drops": self.deadline_drops,
+                "deadline_drops_by_class": dict(sorted(self.drops_by_class.items())),
+                "sheds_by_class": dict(sorted(self.sheds_by_class.items())),
+                "evicted": self.evicted,
+                "class_total_ms": {
+                    k: h.snapshot() for k, h in sorted(self.class_total.items())
+                },
                 "flushes": self.flushes,
                 "flushes_deadline": self.flushes_deadline,
                 "flushes_full": self.flushes_full,

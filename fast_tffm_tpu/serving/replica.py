@@ -1,0 +1,266 @@
+"""Replica worker: one ServingEngine behind a socket, spoken to by the
+router.
+
+The deployment unit of the replicated serving tier: a process that owns
+ONE engine (its own jit cache, admission queue, telemetry monitor) and
+answers the wire protocol (protocol.py) on a TCP socket.  Launched by
+serving/router.py (or by hand for debugging):
+
+    python -m fast_tffm_tpu.serving.replica run.cfg --replica 0 --port 0
+
+On startup it binds (``--port 0`` = ephemeral), warms the bucket ladder,
+and only THEN prints the readiness line the router blocks on::
+
+    REPLICA_READY port=<port> pid=<pid>
+
+so a replica is never routed to before its compile ladder is warm (a
+cold replica would pay XLA compiles at p99).  Ops beyond ``score``:
+
+  * ``ping``   → engine.health() (queue depth, oldest queued wait — the
+    router's wedge signal — last flush age, steady compiles);
+  * ``reload`` → one engine.reload_once() tick, run on a dedicated
+    thread so scoring keeps flowing during a multi-second full restore;
+    the ack carries the outcome (noop/staged/staged_delta/failed);
+  * ``stats``  → engine.metrics_snapshot() + compile counts;
+  * ``slow``   → engine.inject_slow (chaos replica_slow@N:ms);
+  * ``close``  → drain and exit 0.
+
+The engine's own reload watcher is forced OFF here
+(serve_reload_interval_s = 0): the router owns the ONE checkpoint
+watcher and fans reload commands out, so each published delta is applied
+exactly once per replica instead of N watchers racing the filesystem.
+
+Every admitted request gets exactly one response line — scoring errors,
+overload, deadline expiry, and parse errors all map to typed codes
+(protocol.error_response); the socket is never just dropped.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import socket
+import sys
+import threading
+
+from fast_tffm_tpu.serving.protocol import (
+    REPLICA_READY_PREFIX,
+    decode,
+    encode,
+    error_response,
+)
+
+__all__ = ["run_replica", "main"]
+
+
+class _Conn:
+    """One router connection: reader loop + a write lock (score futures
+    resolve on the collector thread, acks on the reader/reload threads —
+    whole-line writes must not interleave)."""
+
+    def __init__(self, sock: socket.socket, engine, log):
+        self._sock = sock
+        self._engine = engine
+        self._log = log
+        self._wlock = threading.Lock()
+        self._reload_lock = threading.Lock()  # one reload at a time
+
+    def send(self, obj: dict) -> None:
+        data = encode(obj)
+        try:
+            with self._wlock:
+                self._sock.sendall(data)
+        except OSError:
+            pass  # router gone; its reconnect (or our exit) handles it
+
+    def _score(self, msg: dict) -> None:
+        req_id = msg.get("id")
+        fut = self._engine.submit_line(
+            str(msg["line"]),
+            klass=str(msg.get("class", "") or ""),
+            deadline_ms=msg.get("deadline_ms"),
+            deadline_at=msg.get("deadline_at"),
+        )
+
+        def done(f, req_id=req_id):
+            exc = f.exception()
+            if exc is None:
+                self.send({"id": req_id, "score": float(f.result())})
+            else:
+                self.send(error_response(req_id, exc))
+
+        fut.add_done_callback(done)
+
+    def _reload(self, msg: dict) -> None:
+        def work():
+            with self._reload_lock:
+                try:
+                    out = self._engine.reload_once()
+                except Exception as e:  # a reload crash must not kill the worker
+                    out = {"status": "failed", "error": repr(e)}
+            self.send({"id": msg.get("id"), "ok": True, "op": "reload", **out})
+
+        threading.Thread(target=work, name="replica-reload", daemon=True).start()
+
+    def handle(self, msg: dict) -> bool:
+        """Dispatch one request; False = close this worker."""
+        req_id = msg.get("id")
+        if "line" in msg:
+            self._score(msg)
+            return True
+        op = msg.get("op")
+        if op == "ping":
+            self.send({"id": req_id, "ok": True, "op": "ping", **self._engine.health()})
+        elif op == "stats":
+            self.send(
+                {
+                    "id": req_id,
+                    "ok": True,
+                    "op": "stats",
+                    "pid": os.getpid(),
+                    "engine": self._engine.metrics_snapshot(),
+                    "compile_count": self._engine.compile_count(),
+                    **self._engine.health(),
+                }
+            )
+        elif op == "slow":
+            self._engine.inject_slow(
+                float(msg.get("ms", 0.0)), int(msg.get("flushes", 1))
+            )
+            self.send({"id": req_id, "ok": True, "op": "slow"})
+        elif op == "reload":
+            self._reload(msg)
+        elif op == "close":
+            self.send({"id": req_id, "ok": True, "op": "close"})
+            return False
+        else:
+            self.send(error_response(req_id, ValueError(f"unknown op {op!r}")))
+        return True
+
+    def serve(self) -> bool:
+        """Read until EOF; True = a ``close`` op asked the worker to exit."""
+        buf = self._sock.makefile("rb")
+        for line in buf:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                msg = decode(line)
+            except Exception as e:
+                self.send(error_response(None, e))
+                continue
+            try:
+                if not self.handle(msg):
+                    return True
+            except Exception as e:
+                # submit_line raising (overload, parse, closed engine) —
+                # typed response, never a dropped line.
+                self.send(error_response(msg.get("id"), e))
+        return False
+
+
+def run_replica(
+    cfg,
+    *,
+    replica: int = 0,
+    port: int = 0,
+    host: str = "127.0.0.1",
+    log=None,
+    ready_out=None,
+) -> int:
+    """Build the engine, bind, announce readiness, serve until the
+    router sends ``close`` (or the process is killed — that IS a chaos
+    scenario the router recovers from)."""
+    from fast_tffm_tpu.serving.engine import ServingEngine
+
+    log = log or (lambda *a: print(f"replica {replica}:", *a, file=sys.stderr))
+    ready_out = ready_out or sys.stdout
+    # Router owns reload fan-out (one watcher, N appliers), and the
+    # socket tier always SHEDS under overload: a block-policy submit
+    # would wedge the reader thread (pings included), making an
+    # overloaded replica indistinguishable from a dead one to the
+    # router's health checks.  The typed `overloaded` response IS the
+    # backpressure signal on the wire; `block` remains the pipe-mode
+    # (stdin serve_lines) policy.
+    overrides = {"serve_reload_interval_s": 0.0, "serve_overload": "reject"}
+    if cfg.metrics_path:
+        # Per-replica JSONL sibling: cross-process appends to one file
+        # interleave partial lines; report.py merges the siblings instead.
+        overrides["metrics_path"] = f"{cfg.metrics_path}.r{replica}"
+    cfg = dataclasses.replace(cfg, **overrides)
+    srv = socket.create_server((host, port))
+    engine = ServingEngine(cfg, log=log, replica=replica)
+    actual = srv.getsockname()[1]
+    print(
+        f"{REPLICA_READY_PREFIX}port={actual} pid={os.getpid()}",
+        file=ready_out,
+        flush=True,
+    )
+    log(f"listening on {host}:{actual}")
+    close_evt = threading.Event()
+    try:
+        srv.settimeout(0.5)
+        # Thread per connection: the router holds TWO — a DATA connection
+        # (scores) and a CONTROL connection (ping/reload/slow/stats) — so
+        # health checks are never queued behind a score-parse backlog; an
+        # overloaded replica answers pings promptly and sheds typed
+        # instead of reading as wedged.
+        def serve_conn(conn):
+            try:
+                if _Conn(conn, engine, log).serve():
+                    close_evt.set()
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+        while not close_evt.is_set():
+            try:
+                conn, peer = srv.accept()
+            except TimeoutError:
+                continue
+            except OSError:
+                break
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(
+                target=serve_conn, args=(conn,), daemon=True
+            ).start()
+    finally:
+        try:
+            srv.close()
+        finally:
+            engine.close()
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fast_tffm_tpu.serving.replica",
+        description="serving replica worker (spawned by the router)",
+    )
+    ap.add_argument("config", help="INI config file")
+    ap.add_argument("--replica", type=int, default=0, metavar="N")
+    ap.add_argument("--port", type=int, default=0, metavar="P",
+                    help="listen port (0 = ephemeral, announced on stdout)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--run-id", default=None, metavar="ID")
+    ap.add_argument("--metrics-path", default=None, metavar="PATH")
+    args = ap.parse_args(argv)
+
+    from fast_tffm_tpu.utils.platform import apply_platform_env
+
+    apply_platform_env()
+    from fast_tffm_tpu.config import load_config
+
+    cfg = load_config(args.config)
+    if args.metrics_path is not None:
+        cfg.metrics_path = args.metrics_path
+    if args.run_id is not None:
+        cfg.telemetry_run_id = args.run_id
+    return run_replica(cfg, replica=args.replica, port=args.port, host=args.host)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

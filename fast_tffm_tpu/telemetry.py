@@ -445,12 +445,17 @@ class RunMonitor:
         mem_every_s: float = 0.0,
         queue_depth_fn=None,
         logger: MetricsLogger | None = None,
+        replica: int | None = None,
         log=None,
     ):
         self._logger = logger if logger is not None else MetricsLogger(path)
         self._own_logger = logger is None
         self.run_id = run_id or new_run_id()
         self.source = source
+        # Serving replica ordinal (None outside the replicated serving
+        # tier): stamped on every record like process_index, so report.py
+        # can split one run's stream into per-replica columns.
+        self.replica = replica
         # Stamped once at construction: the monitor outlives any single
         # dispatch, and a host's identity cannot change mid-run.
         from fast_tffm_tpu.distributed import process_identity
@@ -466,6 +471,10 @@ class RunMonitor:
         self.compiles_total = 0
         self.compiles_steady = 0  # compiles NOT marked warmup
         self._last_warmup = True  # nothing dispatched yet = startup/warmup
+        self._warmup_depth = 0  # >0: inside a warmup_window() — compiles
+        #   drained by ANY thread attribute as warmup (e.g. a serving
+        #   reload's restore/apply programs, which run off the hot path
+        #   and must not read as steady-state score-ladder recompiles)
 
         self._mem = _MemWatermarks()
         self._mem_every_s = float(mem_every_s)
@@ -514,7 +523,7 @@ class RunMonitor:
         could never catch, so it raises here."""
         if kind not in SCHEMAS:
             raise ValueError(f"unknown telemetry kind {kind!r} (register it in SCHEMAS)")
-        self._logger.log(
+        envelope = dict(
             run_id=self.run_id,
             schema_version=SCHEMA_VERSION,
             kind=kind,
@@ -522,8 +531,10 @@ class RunMonitor:
             t=round(time.monotonic() - self._t0, 3),
             process_index=self.process_index,
             process_count=self.process_count,
-            **fields,
         )
+        if self.replica is not None and "replica" not in fields:
+            envelope["replica"] = self.replica
+        self._logger.log(**envelope, **fields)
 
     def heartbeat(self, step: int) -> None:
         """The liveness signal: call whenever a dispatch completes."""
@@ -551,11 +562,36 @@ class RunMonitor:
                     self._last_beat = time.monotonic()
                 self._stall_fired = False
 
+    @contextlib.contextmanager
+    def warmup_window(self):
+        """Mark a window whose compiles are EXPECTED and off the hot path
+        (a serving reload's restore/delta-apply programs): any compile
+        drained while a thread is inside — including by a concurrent
+        dispatch on another thread — attributes as warmup, not as a
+        steady-state recompile.  The trailing drain on exit catches
+        compiles nobody dispatched over.  (A genuine steady recompile
+        landing inside the window is misattributed — accepted: windows
+        are rare and short, and the alternative is a false alarm on
+        every hot reload.)"""
+        with self._lock:
+            self._warmup_depth += 1
+        try:
+            yield
+        finally:
+            try:
+                self.on_dispatch(self._step, warmup=True)
+            except Exception:
+                pass
+            with self._lock:
+                self._warmup_depth -= 1
+
     def on_dispatch(self, step: int, warmup: bool = False) -> None:
         """Per-dispatch hook for driver loops: heartbeat + compile drain +
         due memory sample.  ``warmup`` marks dispatches where a compile
         is EXPECTED (first call, bucket warmup) so steady-state recompiles
         are separable from the priced-in ones."""
+        with self._lock:
+            warmup = warmup or self._warmup_depth > 0
         self.heartbeat(step)
         delta = self._sentinel.drain()
         hits = self._sentinel.drain_cache_hits()

@@ -33,12 +33,35 @@ assert jax.default_backend() == "cpu", "tests must run on the virtual CPU mesh"
 TIER1_BUDGET_S = 700
 
 
+# Hardcoded-TCP-port guard (ISSUE 8 satellite): a test that binds (or
+# serves on) a LITERAL nonzero port races every parallel CI shard and
+# every leftover process for that number — tier-1 must never flake on a
+# port collision.  The only collision-proof pattern is the ephemeral
+# helper: bind port 0, then introspect the real port (getsockname()[1] /
+# Frontend.port / the child's READY line).  Scanned statically at
+# collection so the guard itself can't flake.
+import re as _re
+
+_PORT_LITERAL_RE = _re.compile(
+    r"(?:\.bind\(|create_server\(|TCPServer\(|UDPServer\()"
+    r"\s*\(\s*[^,()]+,\s*([1-9]\d*)\s*\)"
+)
+
+
 def pytest_collection_modifyitems(config, items):
-    """Collection-time tier-1 guard: tests that spawn multi-process worker
-    jobs (their module uses the ``_run_workers`` subprocess harness) MUST
-    carry ``@pytest.mark.slow``, or the 'not slow' verify gate silently
-    inherits minutes-long subprocess runs and blows the ROADMAP timeout.
-    Unknown markers are caught by --strict-markers (pytest.ini addopts)."""
+    """Collection-time tier-1 guards.
+
+    1. Tests that spawn multi-process worker jobs (their module uses the
+       ``_run_workers`` subprocess harness) MUST carry
+       ``@pytest.mark.slow``, or the 'not slow' verify gate silently
+       inherits minutes-long subprocess runs and blows the ROADMAP
+       timeout.  Unknown markers are caught by --strict-markers
+       (pytest.ini addopts).
+    2. No test module may bind a TCP/UDP socket to a literal nonzero
+       port (see _PORT_LITERAL_RE above) — use port 0 + introspection.
+    """
+    import pytest
+
     offenders = [
         item.nodeid
         for item in items
@@ -46,13 +69,28 @@ def pytest_collection_modifyitems(config, items):
         and "slow" not in {m.name for m in item.iter_markers()}
     ]
     if offenders:
-        import pytest
-
         raise pytest.UsageError(
             "tier-1 guard: these tests use the subprocess worker harness "
             "(_run_workers) but are not @pytest.mark.slow — they would run "
             "inside the 'not slow' verify gate and exceed its timeout:\n  "
             + "\n  ".join(offenders)
+        )
+    port_offenders = []
+    for path in sorted({str(item.path) for item in items}):
+        try:
+            with open(path) as f:
+                src = f.read()
+        except OSError:
+            continue
+        for m in _PORT_LITERAL_RE.finditer(src):
+            line = src.count("\n", 0, m.start()) + 1
+            port_offenders.append(f"{path}:{line} (literal port {m.group(1)})")
+    if port_offenders:
+        raise pytest.UsageError(
+            "tier-1 guard: tests must bind ephemeral ports (port=0, then "
+            "introspect via getsockname()/Frontend.port/READY line) — a "
+            "literal port number flakes on collisions:\n  "
+            + "\n  ".join(port_offenders)
         )
 
 

@@ -23,9 +23,23 @@ pinned by tests/test_resilience.py).
 Writes PROBE_MTTR_r06.json; ``--processes 2`` chaoses the REAL
 multi-process pod instead (dist_train under the pod supervisor, gloo
 CPU collectives, one SIGKILLed host per trial, victims alternating
-writer/survivor) and writes PROBE_MTTR_DIST_r07.json.  Usage:
+writer/survivor) and writes PROBE_MTTR_DIST_r07.json.
+
+``--serve`` (ISSUE 8) chaoses the SERVING tier instead: a live
+2-replica socket front end under a FaultPlan serving schedule
+(``replica_kill@N`` SIGKILLs replica N, ``replica_slow@N:MS`` injects
+per-flush latency, ``reload_corrupt@N`` corrupts the checkpoint under
+the reload watcher's nose and then heals it), while a steady request
+stream pins the acceptance: ZERO hung or unanswered clients (every
+request gets a score or a typed code), every DELIVERED score
+bit-identical to a fault-free baseline run of the same request set,
+replica restart MTTR measured, and zero steady-state recompiles on
+every replica.  Writes PROBE_SERVE_CHAOS_r08.json.
+
+Usage:
   python tools/chaos.py [--trials 3] [--seed 1106] [--sharded]
                         [--processes 2] [--out PROBE.json]
+  python tools/chaos.py --serve [--serve-plan SPEC] [--out PROBE.json]
 """
 
 from __future__ import annotations
@@ -34,6 +48,7 @@ import argparse
 import json
 import os
 import random
+import signal
 import statistics
 import subprocess
 import sys
@@ -201,6 +216,308 @@ def _trial(
         return out
 
 
+# ---------------------------------------------------------------------------
+# serving chaos (--serve): live front end + replica kill/slow/corrupt
+# ---------------------------------------------------------------------------
+
+SERVE_REPLICAS = 2
+SERVE_REQUESTS = 600
+SERVE_QPS = 200.0
+
+
+def _serve_cfg(d: str) -> str:
+    cfg = os.path.join(d, "serve.cfg")
+    with open(cfg, "w") as f:
+        f.write(
+            f"""
+[General]
+model = fm
+factor_num = 4
+vocabulary_size = 4096
+model_file = {d}/m.ckpt
+
+[Train]
+max_nnz = 6
+metrics_path = {d}/serve.jsonl
+
+[Serving]
+buckets = 1 8 64
+flush_deadline_ms = 3
+replicas = {SERVE_REPLICAS}
+classes = gold:2,std:1
+reload_interval_s = 0.2
+"""
+        )
+    return cfg
+
+
+def _serve_checkpoint(model_file: str) -> bytes:
+    """Write the serving checkpoint; returns the bytes of a CORRUPT
+    would-be successor (different step, valid zip metadata, torn array
+    data) — what a dying trainer's non-atomic publish leaves behind.
+    Its signature and save_id still read, so the reload path ATTEMPTS
+    the restore and must survive the CRC failure."""
+    import jax
+
+    from fast_tffm_tpu.checkpoint import save_checkpoint
+    from fast_tffm_tpu.config import Config, build_model
+    from fast_tffm_tpu.trainer import init_state
+
+    cfg = Config(
+        model="fm", factor_num=4, vocabulary_size=4096, max_nnz=6,
+        model_file=model_file,
+    ).validate()
+    state = init_state(
+        build_model(cfg), jax.random.key(3), cfg.init_accumulator_value
+    )
+    save_checkpoint(model_file, state._replace(table=state.table + 0.25))
+    succ = model_file + ".successor"
+    save_checkpoint(
+        succ, state._replace(table=state.table + 0.5, step=state.step + 10)
+    )
+    with open(succ, "rb") as f:
+        b = f.read()
+    os.remove(succ)
+    mid = len(b) // 2
+    return b[:mid] + b"\xde\xad" * 32 + b[mid + 64:]
+
+
+def _serve_lines(n: int, seed: int) -> list[str]:
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        k = int(rng.integers(1, 7))
+        ids = rng.choice(4096, size=k, replace=False)
+        vals = np.round(np.abs(rng.normal(size=k)) + 0.1, 4)
+        out.append(
+            f"{int(rng.integers(0, 2))} "
+            + " ".join(f"{i}:{v}" for i, v in zip(ids, vals))
+        )
+    return out
+
+
+def _client(port):
+    """Pipelined connection keeping every response keyed by id, so the
+    probe can diff delivered scores against the baseline run (the shared
+    client's default routing does exactly that)."""
+    from fast_tffm_tpu.serving.client import ServeConnection
+
+    return ServeConnection(port)
+
+
+def _drive(client, lines, base: int, qps: float, events=None):
+    """Send every line (ids base+i) at ~qps; fire ``events`` (callables
+    keyed by send-index) along the way — the chaos schedule rides the
+    request stream, so faults land mid-traffic."""
+    events = events or {}
+    interval = 1.0 / qps
+    t_next = time.perf_counter()
+    for i, line in enumerate(lines):
+        if i in events:
+            events[i]()
+        now = time.perf_counter()
+        if now < t_next:
+            time.sleep(t_next - now)
+        t_next += interval
+        klass = "gold" if i % 10 == 0 else "std"
+        client.send({"id": base + i, "line": line, "class": klass})
+
+
+def _serve_chaos(args) -> int:
+    from fast_tffm_tpu.resilience import FaultPlan
+
+    out_path = args.out or os.path.join(REPO, "PROBE_SERVE_CHAOS_r08.json")
+    plan = FaultPlan.parse(args.serve_plan, seed=args.seed)
+    serving = plan.serving_events()
+    if not serving:
+        print("chaos: --serve-plan has no serving faults", file=sys.stderr)
+        return 1
+    lines = _serve_lines(SERVE_REQUESTS, args.seed)
+    result: dict = {
+        "probe": "SERVE_CHAOS",
+        "seed": args.seed,
+        "plan": json.loads(plan.to_json()),
+        "replicas": SERVE_REPLICAS,
+        "requests": SERVE_REQUESTS,
+        "qps": SERVE_QPS,
+    }
+    with tempfile.TemporaryDirectory(prefix="chaos-serve-") as d:
+        cfg_path = _serve_cfg(d)
+        model_file = os.path.join(d, "m.ckpt")
+        corrupt_bytes = _serve_checkpoint(model_file)
+        with open(model_file, "rb") as f:
+            good_bytes = f.read()
+
+        from fast_tffm_tpu.serving.client import spawn_serve
+
+        # ---- baseline: fault-free, same request set --------------------
+        proc, port = spawn_serve(cfg_path)
+        try:
+            client = _client(port)
+            _drive(client, lines, base=0, qps=SERVE_QPS)
+            missing = client.wait_answered(range(len(lines)), timeout=60)
+            assert not missing, f"baseline left {len(missing)} unanswered"
+            with client.lock:
+                baseline = {
+                    i: client.responses[i].get("score")
+                    for i in range(len(lines))
+                }
+            client.close()
+        finally:
+            proc.terminate()
+            proc.wait(timeout=30)
+        unscored = sum(1 for v in baseline.values() if v is None)
+        result["baseline_unscored"] = unscored
+        if unscored:
+            print(f"chaos: baseline failed to score {unscored} requests",
+                  file=sys.stderr)
+
+        # ---- chaos run: same lines, faults mid-stream ------------------
+        proc, port = spawn_serve(cfg_path)
+        hard_fail = None
+        try:
+            client = _client(port)
+            stats0 = client.request({"op": "stats"}, timeout=60)
+            pids = {r["replica"]: r["pid"] for r in stats0["replicas"]}
+            t_kill = [None]
+
+            def fire(event):
+                kind, at = event["kind"], event["at"]
+                if kind == "replica_kill":
+                    print(f"chaos: SIGKILL replica {at} (pid {pids[at]})",
+                          flush=True)
+                    t_kill[0] = time.monotonic()
+                    os.kill(pids[at], signal.SIGKILL)
+                elif kind == "replica_slow":
+                    ms = event.get("until", 100)
+                    print(f"chaos: slow replica {at} by {ms}ms/flush", flush=True)
+                    client.send(
+                        {"id": f"slow-{at}", "op": "slow", "replica": at,
+                         "ms": ms, "flushes": 40}
+                    )
+                elif kind == "reload_corrupt":
+                    # A torn NEW publish: different save_id, readable
+                    # signature, corrupt array data — the watcher fans a
+                    # reload that must FAIL cleanly on every replica
+                    # while serving continues on the loaded state.
+                    print("chaos: publishing a torn successor checkpoint",
+                          flush=True)
+                    with open(model_file, "wb") as f:
+                        f.write(corrupt_bytes)
+
+            # Spread the schedule across the stream's middle half.
+            step = max(1, SERVE_REQUESTS // (2 * (len(serving) + 1)))
+            events = {
+                SERVE_REQUESTS // 4 + k * step: (lambda e=e: fire(e))
+                for k, e in enumerate(serving)
+            }
+            _drive(client, lines, base=10_000, qps=SERVE_QPS, events=events)
+            ids = [10_000 + i for i in range(len(lines))]
+            missing = client.wait_answered(ids, timeout=120)
+            result["unanswered"] = len(missing)
+
+            # Heal the corrupt checkpoint: the watcher must pick the good
+            # bytes back up (same content ⇒ same scores) — reload
+            # failures were counted while it was torn.
+            if any(e["kind"] == "reload_corrupt" for e in serving):
+                with open(model_file, "wb") as f:
+                    f.write(good_bytes)
+
+            with client.lock:
+                answered = dict(client.responses)
+            scored = mismatched = typed = 0
+            codes: dict[str, int] = {}
+            for i in range(len(lines)):
+                r = answered.get(10_000 + i)
+                if r is None:
+                    continue
+                if "score" in r:
+                    scored += 1
+                    if r["score"] != baseline.get(i):
+                        mismatched += 1
+                else:
+                    typed += 1
+                    codes[r.get("code", "?")] = codes.get(r.get("code", "?"), 0) + 1
+            result.update(
+                scored=scored,
+                typed_errors=typed,
+                typed_codes=codes,
+                scores_mismatched=mismatched,
+            )
+
+            # Recovery: all replicas healthy again, MTTR on the books.
+            deadline = time.monotonic() + 120
+            snap = None
+            while time.monotonic() < deadline:
+                snap = client.request({"op": "ping"}, timeout=30)
+                if all(r["state"] == "healthy" for r in snap["replicas"]):
+                    break
+                time.sleep(0.5)
+            stats = client.request({"op": "stats"}, timeout=60)
+            result["replica_restarts"] = sum(
+                r["restarts"] for r in stats["replicas"]
+            )
+            result["mttr_s"] = stats.get("mttr_s", [])
+            result["mttr_s_detection_to_healthy"] = (
+                stats["mttr_s"][0] if stats.get("mttr_s") else None
+            )
+            if t_kill[0] is not None and stats.get("mttr_s"):
+                # Kill → healthy as the CLIENT would measure it (includes
+                # the router's detection latency, not just its restart).
+                result["kill_observed"] = True
+            steady = {}
+            reload_failures = {}
+            delta_or_reloads = {}
+            for idx, eng in stats.get("engines", {}).items():
+                steady[idx] = eng.get("steady_compiles")
+                e = eng.get("engine", {})
+                reload_failures[idx] = e.get("reload_failures")
+                delta_or_reloads[idx] = (e.get("reloads"), e.get("delta_reloads"))
+            result["steady_compiles_by_replica"] = steady
+            result["reload_failures_by_replica"] = reload_failures
+            result["reloads_by_replica"] = delta_or_reloads
+            result["all_healthy_after"] = bool(
+                snap and all(r["state"] == "healthy" for r in snap["replicas"])
+            )
+            client.close()
+        except Exception as e:  # the probe must always write its verdict
+            hard_fail = repr(e)
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+    ok = (
+        hard_fail is None
+        and result.get("baseline_unscored") == 0
+        and result.get("unanswered") == 0
+        and result.get("scores_mismatched") == 0
+        and result.get("replica_restarts", 0) >= 1
+        and result.get("all_healthy_after")
+        and all(
+            v == 0 for v in result.get("steady_compiles_by_replica", {}).values()
+        )
+    )
+    if any(e["kind"] == "reload_corrupt" for e in serving):
+        # The torn successor must have been ATTEMPTED and survived — a
+        # probe where no replica even tried the reload tested nothing.
+        ok = ok and any(
+            (v or 0) >= 1
+            for v in result.get("reload_failures_by_replica", {}).values()
+        )
+    if hard_fail:
+        result["error"] = hard_fail
+    result["ok"] = ok
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"chaos: wrote {out_path} (ok={ok})")
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--trials", type=int, default=3, metavar="N",
@@ -213,8 +530,19 @@ def main(argv=None) -> int:
                     "(dist_train under the pod supervisor, gloo CPU; each "
                     "trial SIGKILLs one host — victims alternate between "
                     "the writer and a survivor)")
+    ap.add_argument("--serve", action="store_true",
+                    help="chaos the SERVING tier: a live 2-replica socket "
+                    "front end under replica kill/slow/corrupt faults "
+                    "(writes PROBE_SERVE_CHAOS_r08.json)")
+    ap.add_argument("--serve-plan",
+                    default="replica_kill@0,replica_slow@1:150,reload_corrupt@0",
+                    metavar="SPEC",
+                    help="FaultPlan spec for --serve (serving kinds only; "
+                    "@N is the replica index)")
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
+    if args.serve:
+        return _serve_chaos(args)
     pod = args.processes > 1
     out_path = args.out or os.path.join(
         REPO, "PROBE_MTTR_DIST_r07.json" if pod else "PROBE_MTTR_r06.json"

@@ -1,11 +1,25 @@
 #!/usr/bin/env python
-"""Serving load generator: drive a ServingEngine, emit BENCH_SERVE JSON.
+"""Serving load generator: drive an engine or a socket front end, emit
+BENCH_SERVE JSON.
 
 The serving analog of bench.py's train BENCH files: one JSON object with
-client-observed latency percentiles (p50/p95/p99), achieved QPS, the
-engine's own queue/compute/occupancy metrics, and the compile counts
-that pin "zero steady-state recompiles" — so future PRs can track a
-serving trajectory the way BENCH_r*.json tracks training.
+client-observed latency percentiles (p50/p95/p99, overall AND per client
+class), achieved QPS, typed-shed counts (overloaded / deadline /
+unavailable), the engine's own queue/compute/occupancy metrics, and the
+compile counts that pin "zero steady-state recompiles" — so future PRs
+can track a serving trajectory the way BENCH_r*.json tracks training.
+
+Two transports:
+
+  * **in-process** (default) — a ServingEngine in this process, the
+    PR-2 mode; measures the engine alone, no network.
+  * **socket** (``--connect HOST:PORT`` or ``--spawn``) — speak the wire
+    protocol (serving/protocol.py) to a live front end; ``--spawn``
+    launches ``fast_tffm.py serve <cfg> --port 0`` itself and tears it
+    down after.  ``--connections N`` pipelined TCP connections each run
+    an independent open-loop schedule at qps/N — the multi-connection
+    sender is what lifts the open-loop ceiling past what one
+    send/recv loop can drive (the PR-2 single-loop topped out ~1k QPS).
 
 Two modes:
 
@@ -16,17 +30,17 @@ Two modes:
   * ``closed`` — ``--concurrency`` workers each submit-and-wait in a
     loop: measures best-case service latency and saturation throughput.
 
-Request sizes are MIXED by construction (per-line nnz drawn 1..max_nnz)
-so the run exercises every ladder bucket.
+Traffic shaping: ``--classes gold:0.1,std:0.9`` draws each request's
+client class from the given mix (tiers come from the server's
+serve_classes); ``--deadline-ms`` stamps a per-request deadline so the
+deadline-shed path is exercised under load.  Request sizes are MIXED by
+construction (per-line nnz drawn 1..max_nnz) so the run exercises every
+ladder bucket.
 
 Usage:
     python tools/loadgen.py run.cfg --mode open --qps 500 --duration 3
-    python tools/loadgen.py run.cfg --mode closed --concurrency 8 \
-        --requests 2000 --out BENCH_SERVE.json
-
-With no --input and no predict_files, synthetic libsvm lines are drawn
-from the configured vocabulary; --init-missing-checkpoint writes a fresh
-random checkpoint when model_file is absent (zero-setup smoke runs).
+    python tools/loadgen.py run.cfg --spawn --connections 8 --qps 10000 \
+        --classes gold:0.1,std:0.9 --deadline-ms 50 --out BENCH_SERVE.json
 """
 
 from __future__ import annotations
@@ -34,6 +48,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 import threading
 import time
@@ -41,6 +56,8 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
+
+from fast_tffm_tpu.serving.client import ServeConnection, spawn_serve
 
 
 def synth_lines(cfg, n: int, max_nnz: int, seed: int) -> list[str]:
@@ -59,79 +76,62 @@ def synth_lines(cfg, n: int, max_nnz: int, seed: int) -> list[str]:
     return lines
 
 
-def run_open(engine, lines, qps: float, duration: float, max_requests: int, seed: int):
-    """Open-loop Poisson arrivals; returns client latencies (seconds)."""
-    rng = np.random.default_rng(seed)
-    lat: list[float] = []
-    lat_lock = threading.Lock()
-    inflight: list = []
-    t_end = time.perf_counter() + duration
-    i = sent = 0
-    t_next = time.perf_counter()
-    while time.perf_counter() < t_end and sent < max_requests:
-        now = time.perf_counter()
-        if now < t_next:
-            time.sleep(min(t_next - now, 0.005))
+def parse_class_mix(spec: str) -> list[tuple[str, float]]:
+    """``gold:0.1,std:0.9`` → [(name, fraction)]; fractions normalized."""
+    out = []
+    for tok in spec.split(","):
+        tok = tok.strip()
+        if not tok:
             continue
-        t_next += rng.exponential(1.0 / qps)
-        t0 = time.perf_counter()
-        try:
-            fut = engine.submit_line(lines[i % len(lines)])
-        except Exception:
-            i += 1
-            continue  # rejected (overload policy): engine counts it
-        def _record(f, t0=t0):
-            if f.exception() is None:
-                with lat_lock:
-                    lat.append(time.perf_counter() - t0)
-
-        fut.add_done_callback(_record)
-        inflight.append(fut)
-        i += 1
-        sent += 1
-    for f in inflight:
-        try:
-            f.result(timeout=30)
-        except Exception:
-            pass
-    return lat, sent
+        name, sep, frac = tok.partition(":")
+        if not sep or not name:
+            raise ValueError(f"--classes entries are name:fraction, got {tok!r}")
+        out.append((name, float(frac)))
+    total = sum(f for _, f in out)
+    if not out or total <= 0:
+        raise ValueError(f"--classes needs positive fractions, got {spec!r}")
+    return [(n, f / total) for n, f in out]
 
 
-def run_closed(engine, lines, concurrency: int, duration: float, max_requests: int):
-    """Closed-loop submit-and-wait workers; returns client latencies."""
-    lat: list[float] = []
-    lock = threading.Lock()
-    stop = time.perf_counter() + duration
-    counter = [0]
+def draw_class(rng, mix: list[tuple[str, float]] | None) -> str:
+    if not mix:
+        return ""
+    x = rng.random()
+    acc = 0.0
+    for name, frac in mix:
+        acc += frac
+        if x < acc:
+            return name
+    return mix[-1][0]
 
-    def worker(wid: int):
-        i = wid
-        while time.perf_counter() < stop:
-            with lock:
-                if counter[0] >= max_requests:
-                    return
-                counter[0] += 1
-            t0 = time.perf_counter()
-            try:
-                s = engine.submit_line(lines[i % len(lines)]).result(timeout=30)
-                del s
-            except Exception:
-                # Advance past the failing line (a reject, or one bad
-                # input row) and yield briefly — retrying the SAME line
-                # in a tight loop would busy-spin the whole --duration.
-                i += concurrency
-                time.sleep(0.001)
-                continue
-            with lock:
-                lat.append(time.perf_counter() - t0)
-            i += concurrency
 
-    threads = [threading.Thread(target=worker, args=(w,)) for w in range(concurrency)]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    return lat, counter[0]
+# ---------------------------------------------------------------------------
+# result aggregation (shared by both transports)
+# ---------------------------------------------------------------------------
+
+
+class Results:
+    """Thread-safe (klass, latency | typed code) sink."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.lat: list[float] = []
+        self.lat_by_class: dict[str, list[float]] = {}
+        self.codes: dict[str, int] = {}
+        self.sent = 0
+
+    def on_sent(self, n=1):
+        with self._lock:
+            self.sent += n
+
+    def ok(self, klass: str, latency_s: float):
+        with self._lock:
+            self.lat.append(latency_s)
+            self.lat_by_class.setdefault(klass or "default", []).append(latency_s)
+
+    def err(self, code: str):
+        with self._lock:
+            self.codes[code] = self.codes.get(code, 0) + 1
 
 
 def percentiles_ms(lat: list[float]) -> dict:
@@ -148,6 +148,226 @@ def percentiles_ms(lat: list[float]) -> dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# in-process transport (the PR-2 path, now class/deadline aware)
+# ---------------------------------------------------------------------------
+
+
+def run_open_engine(engine, lines, args, mix, res: Results):
+    rng = np.random.default_rng(args.seed)
+    t_end = time.perf_counter() + args.duration
+    i = 0
+    t_next = time.perf_counter()
+    while time.perf_counter() < t_end and res.sent < args.requests:
+        now = time.perf_counter()
+        if now < t_next:
+            time.sleep(min(t_next - now, 0.005))
+            continue
+        t_next += rng.exponential(1.0 / args.qps)
+        klass = draw_class(rng, mix)
+        t0 = time.perf_counter()
+        try:
+            fut = engine.submit_line(
+                lines[i % len(lines)], klass=klass,
+                deadline_ms=args.deadline_ms or None,
+            )
+        except Exception as e:
+            from fast_tffm_tpu.serving.protocol import exc_code
+
+            res.err(exc_code(e))
+            res.on_sent()
+            i += 1
+            continue
+
+        def _record(f, t0=t0, klass=klass):
+            exc = f.exception()
+            if exc is None:
+                res.ok(klass, time.perf_counter() - t0)
+            else:
+                from fast_tffm_tpu.serving.protocol import exc_code
+
+                res.err(exc_code(exc))
+
+        fut.add_done_callback(_record)
+        res.on_sent()
+        i += 1
+    # Drain: wait for stragglers to resolve (callbacks fill res).
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        with res._lock:
+            done = len(res.lat) + sum(res.codes.values())
+        if done >= res.sent:
+            break
+        time.sleep(0.01)
+
+
+def run_closed_engine(engine, lines, args, mix, res: Results):
+    stop = time.perf_counter() + args.duration
+    lock = threading.Lock()
+    counter = [0]
+
+    def worker(wid: int):
+        rng = np.random.default_rng(args.seed + wid)
+        i = wid
+        while time.perf_counter() < stop:
+            with lock:
+                if counter[0] >= args.requests:
+                    return
+                counter[0] += 1
+            klass = draw_class(rng, mix)
+            t0 = time.perf_counter()
+            try:
+                engine.submit_line(
+                    lines[i % len(lines)], klass=klass,
+                    deadline_ms=args.deadline_ms or None,
+                ).result(timeout=30)
+            except Exception as e:
+                from fast_tffm_tpu.serving.protocol import exc_code
+
+                res.err(exc_code(e))
+                res.on_sent()
+                i += args.concurrency
+                time.sleep(0.001)
+                continue
+            res.ok(klass, time.perf_counter() - t0)
+            res.on_sent()
+            i += args.concurrency
+
+    threads = [
+        threading.Thread(target=worker, args=(w,)) for w in range(args.concurrency)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+# ---------------------------------------------------------------------------
+# socket transport (shared pipelined client: serving/client.py)
+# ---------------------------------------------------------------------------
+
+
+def bench_connection(port: int, host: str, res: Results) -> ServeConnection:
+    """A ServeConnection routing score responses into the Results sink
+    (meta = (t_send, klass)); op acks flow through request() as usual."""
+
+    def on_response(msg, meta):
+        if meta is None:
+            return False  # not ours to consume
+        t0, klass = meta
+        if "score" in msg:
+            res.ok(klass, time.perf_counter() - t0)
+        else:
+            res.err(msg.get("code", "unavailable"))
+        return True
+
+    return ServeConnection(port, host=host, on_response=on_response)
+
+
+def send_score(conn: ServeConnection, res, line, klass, deadline_ms) -> None:
+    msg = {"line": line}
+    if klass:
+        msg["class"] = klass
+    if deadline_ms:
+        msg["deadline_ms"] = deadline_ms
+    conn.send(msg, meta=(time.perf_counter(), klass))
+    res.on_sent()
+
+
+def run_open_socket(conns: list[ServeConnection], lines, args, mix, res: Results):
+    """Each connection runs an independent Poisson schedule at qps/C —
+    open-loop in aggregate, parallel enough to drive 10k+ QPS from one
+    Python client."""
+    per_conn_qps = args.qps / len(conns)
+    t_end = time.perf_counter() + args.duration
+    cap = max(1, args.requests // len(conns))
+
+    def sender(ci: int, conn: ServeConnection):
+        rng = np.random.default_rng(args.seed + ci)
+        i = ci
+        sent = 0
+        t_next = time.perf_counter()
+        while time.perf_counter() < t_end and sent < cap:
+            now = time.perf_counter()
+            if now < t_next:
+                time.sleep(min(t_next - now, 0.002))
+                continue
+            t_next += rng.exponential(1.0 / per_conn_qps)
+            try:
+                send_score(
+                    conn, res, lines[i % len(lines)], draw_class(rng, mix),
+                    args.deadline_ms or None,
+                )
+            except OSError:
+                res.err("unavailable")
+            sent += 1
+            i += len(conns)
+
+    threads = [
+        threading.Thread(target=sender, args=(ci, c)) for ci, c in enumerate(conns)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and any(c.inflight() for c in conns):
+        time.sleep(0.01)
+
+
+def run_closed_socket(port, host, lines, args, mix, res: Results):
+    stop = time.perf_counter() + args.duration
+    lock = threading.Lock()
+    counter = [0]
+
+    def worker(wid: int):
+        conn = ServeConnection(port, host=host)
+        rng = np.random.default_rng(args.seed + wid)
+        i = wid
+        try:
+            while time.perf_counter() < stop:
+                with lock:
+                    if counter[0] >= args.requests:
+                        return
+                    counter[0] += 1
+                klass = draw_class(rng, mix)
+                t0 = time.perf_counter()
+                try:
+                    msg = conn.request(
+                        {
+                            "line": lines[i % len(lines)],
+                            **({"class": klass} if klass else {}),
+                            **(
+                                {"deadline_ms": args.deadline_ms}
+                                if args.deadline_ms
+                                else {}
+                            ),
+                        },
+                        timeout=30,
+                    )
+                except (TimeoutError, OSError):
+                    res.err("unavailable")
+                    res.on_sent()
+                    i += args.concurrency
+                    continue
+                res.on_sent()
+                if "score" in msg:
+                    res.ok(klass, time.perf_counter() - t0)
+                else:
+                    res.err(msg.get("code", "unavailable"))
+                i += args.concurrency
+        finally:
+            conn.close()
+
+    threads = [
+        threading.Thread(target=worker, args=(w,)) for w in range(args.concurrency)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("config", help="INI config (uses [Serving] + model_file)")
@@ -160,6 +380,29 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", default=None, help="also write the JSON here")
     ap.add_argument(
+        "--connect", default=None, metavar="HOST:PORT",
+        help="drive a LIVE socket front end instead of an in-process engine",
+    )
+    ap.add_argument(
+        "--spawn", action="store_true",
+        help="spawn `serve <cfg> --port 0` (replicated front end) and drive it",
+    )
+    ap.add_argument(
+        "--connections", type=int, default=4, metavar="C",
+        help="socket open-loop: parallel pipelined connections, each at qps/C "
+        "(the multi-connection sender that makes 10k+ QPS drivable)",
+    )
+    ap.add_argument(
+        "--classes", default=None, metavar="MIX",
+        help="client-class traffic mix, e.g. gold:0.1,std:0.9 (tiers come "
+        "from the server's serve_classes)",
+    )
+    ap.add_argument(
+        "--deadline-ms", type=float, default=0.0, metavar="MS",
+        help="stamp a per-request deadline (0 = none) — exercises the "
+        "deadline-shed path under load",
+    )
+    ap.add_argument(
         "--init-missing-checkpoint",
         action="store_true",
         help="write a fresh random checkpoint when model_file is absent",
@@ -169,24 +412,15 @@ def main(argv=None) -> int:
         ap.error("--qps must be > 0 in open mode (it is the Poisson arrival rate)")
     if args.mode == "closed" and args.concurrency < 1:
         ap.error("--concurrency must be >= 1 in closed mode")
+    if args.connections < 1:
+        ap.error("--connections must be >= 1")
+    if args.connect and args.spawn:
+        ap.error("--connect and --spawn are mutually exclusive")
+    mix = parse_class_mix(args.classes) if args.classes else None
 
     from fast_tffm_tpu.config import build_model, load_config
-    from fast_tffm_tpu.serving import ServingEngine
 
     cfg = load_config(args.config)
-    if args.mode == "open" and cfg.serve_overload == "block":
-        # A blocking submit would stall the Poisson arrival schedule the
-        # moment the queue fills — turning the open loop into a closed
-        # one exactly at the queueing-collapse point it exists to expose.
-        # Shed instead; rejects are counted in the result.
-        import dataclasses
-
-        cfg = dataclasses.replace(cfg, serve_overload="reject")
-        print(
-            "loadgen: open-loop mode forces serve_overload = reject "
-            "(blocking submits would self-throttle the arrival schedule)",
-            file=sys.stderr,
-        )
     if args.init_missing_checkpoint and not os.path.exists(cfg.model_file.rstrip("/")):
         import jax
 
@@ -216,48 +450,138 @@ def main(argv=None) -> int:
         print(f"loadgen: synthesized {len(lines)} request lines", file=sys.stderr)
 
     log = lambda *a: print(*a, file=sys.stderr)
-    t_setup = time.perf_counter()
-    engine = ServingEngine(cfg, log=log)
-    warm = engine.compile_count()  # ladder fully compiled here (ctor warmup)
-    t_warm = time.perf_counter() - t_setup
-
-    t0 = time.perf_counter()
-    if args.mode == "open":
-        lat, sent = run_open(
-            engine, lines, args.qps, args.duration, args.requests, args.seed
-        )
-    else:
-        lat, sent = run_closed(
-            engine, lines, args.concurrency, args.duration, args.requests
-        )
-    wall = time.perf_counter() - t0
-    end = engine.compile_count()
-    snap = engine.metrics_snapshot()
-    engine.close()
-
-    result = {
+    res = Results()
+    result: dict = {
         "bench": "BENCH_SERVE",
         "mode": args.mode,
         "qps_target": args.qps if args.mode == "open" else None,
         "concurrency": args.concurrency if args.mode == "closed" else None,
-        "duration_s": round(wall, 3),
-        "warmup_s": round(t_warm, 3),
-        "requests_sent": sent,
-        "requests_scored": len(lat),
-        "qps_achieved": round(len(lat) / wall, 1) if wall > 0 else None,
-        "client_ms": percentiles_ms(lat),
-        "buckets": list(engine.buckets),
+        "class_mix": dict(mix) if mix else None,
+        "deadline_ms": args.deadline_ms or None,
         "flush_deadline_ms": cfg.serve_flush_deadline_ms,
-        "overload": cfg.serve_overload,
-        # Flat compile count across the traffic phase IS the acceptance
-        # signal: every request shape landed on a warmed bucket.
-        "compile_count_warm": warm,
-        "compile_count_end": end,
-        "steady_state_recompiles": (
-            end - warm if warm is not None and end is not None else None
-        ),
-        **snap,
     }
+
+    if args.connect or args.spawn:
+        proc = None
+        if args.spawn:
+            t_setup = time.perf_counter()
+            proc, port = spawn_serve(args.config, log=log)
+            host = "127.0.0.1"
+            warmup_s = time.perf_counter() - t_setup
+        else:
+            host, _, port = args.connect.rpartition(":")
+            host, port = host or "127.0.0.1", int(port)
+            warmup_s = 0.0
+        try:
+            t0 = time.perf_counter()
+            if args.mode == "open":
+                conns = [
+                    bench_connection(port, host, res)
+                    for _ in range(args.connections)
+                ]
+                try:
+                    run_open_socket(conns, lines, args, mix, res)
+                    # The no-hung-client pin: anything STILL unresolved
+                    # after the drain window never got its one response.
+                    result["unanswered"] = sum(c.inflight() for c in conns)
+                    stats = conns[0].request({"op": "stats"}, timeout=60)
+                finally:
+                    for c in conns:
+                        c.close()
+            else:
+                run_closed_socket(port, host, lines, args, mix, res)
+                c = ServeConnection(port, host=host)
+                try:
+                    stats = c.request({"op": "stats"}, timeout=60)
+                finally:
+                    c.close()
+            wall = time.perf_counter() - t0
+            engines = stats.get("engines", {})
+            steady = [
+                e.get("steady_compiles")
+                for e in engines.values()
+                if isinstance(e.get("steady_compiles"), int)
+            ]
+            result.update(
+                transport="socket",
+                connections=args.connections if args.mode == "open" else None,
+                warmup_s=round(warmup_s, 3),
+                server={
+                    k: stats.get(k)
+                    for k in (
+                        "replicas",
+                        "failovers",
+                        "failed_unanswerable",
+                        "reload_fanouts",
+                        "mttr_s",
+                    )
+                },
+                engines=engines,
+                steady_state_recompiles=max(steady) if steady else None,
+            )
+        finally:
+            if proc is not None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+    else:
+        from fast_tffm_tpu.serving import ServingEngine
+
+        if args.mode == "open" and cfg.serve_overload == "block":
+            # A blocking submit would stall the Poisson arrival schedule
+            # the moment the queue fills — turning the open loop into a
+            # closed one exactly at the queueing-collapse point it exists
+            # to expose.  Shed instead; rejects are counted in the result.
+            import dataclasses
+
+            cfg = dataclasses.replace(cfg, serve_overload="reject")
+            print(
+                "loadgen: open-loop mode forces serve_overload = reject "
+                "(blocking submits would self-throttle the arrival schedule)",
+                file=sys.stderr,
+            )
+        t_setup = time.perf_counter()
+        engine = ServingEngine(cfg, log=log)
+        warm = engine.compile_count()  # ladder fully compiled here (ctor warmup)
+        warmup_s = time.perf_counter() - t_setup
+        t0 = time.perf_counter()
+        if args.mode == "open":
+            run_open_engine(engine, lines, args, mix, res)
+        else:
+            run_closed_engine(engine, lines, args, mix, res)
+        wall = time.perf_counter() - t0
+        end = engine.compile_count()
+        snap = engine.metrics_snapshot()
+        engine.close()
+        result.update(
+            transport="inprocess",
+            warmup_s=round(warmup_s, 3),
+            buckets=list(engine.buckets),
+            overload=cfg.serve_overload,
+            # Flat compile count across the traffic phase IS the
+            # acceptance signal: every request shape landed on a warmed
+            # bucket.
+            compile_count_warm=warm,
+            compile_count_end=end,
+            steady_state_recompiles=(
+                end - warm if warm is not None and end is not None else None
+            ),
+            **snap,
+        )
+
+    result.update(
+        duration_s=round(wall, 3),
+        requests_sent=res.sent,
+        requests_scored=len(res.lat),
+        qps_achieved=round(len(res.lat) / wall, 1) if wall > 0 else None,
+        client_ms=percentiles_ms(res.lat),
+        client_ms_by_class={
+            k: percentiles_ms(v) for k, v in sorted(res.lat_by_class.items())
+        },
+        shed_codes=dict(sorted(res.codes.items())),
+    )
     out = json.dumps(result, indent=2)
     print(out)
     if args.out:

@@ -340,6 +340,58 @@ def summarize(records: list[dict]) -> dict:
     ]
     serving = kinds.get("serving", [])
     s["serving_last"] = serving[-1] if serving else None
+
+    # Replicated serving tier (ISSUE 8): per-replica kind=serving records
+    # carry a `replica` envelope key (each replica worker writes its own
+    # JSONL sibling — pass them all: report.py RUN.jsonl RUN.jsonl.r0 ...).
+    # Replica-level faults/restarts come from the router's records.
+    by_replica: dict = {}
+    for r in serving:
+        if isinstance(r.get("replica"), int):
+            by_replica[r["replica"]] = r  # last snapshot wins per replica
+    s["serving_replicas"] = by_replica
+    s["deadline_drops"] = (
+        sum(r.get("deadline_drops") or 0 for r in by_replica.values())
+        if by_replica
+        else (s["serving_last"] or {}).get("deadline_drops")
+    )
+    sheds: dict[str, int] = {}
+    class_p99: dict[str, float] = {}
+    snaps = list(by_replica.values()) or ([s["serving_last"]] if s["serving_last"] else [])
+    for r in snaps:
+        for k, v in (r.get("sheds_by_class") or {}).items():
+            sheds[k] = sheds.get(k, 0) + v
+        for k, h in (r.get("class_total_ms") or {}).items():
+            p99 = h.get("p99")
+            if isinstance(p99, (int, float)):
+                # Max across replicas: the SLO is only as good as the
+                # worst replica a client can land on.
+                class_p99[k] = max(class_p99.get(k, 0.0), p99)
+    s["sheds_by_class"] = sheds
+    s["class_p99_ms"] = class_p99
+    s["replica_faults"] = sum(
+        1 for r in faults if isinstance(r.get("replica"), int)
+    )
+    s["replica_fault_events"] = [
+        {
+            "replica": r.get("replica"),
+            "event": r.get("event"),
+            "exit_code": r.get("exit_code"),
+        }
+        for r in faults
+        if isinstance(r.get("replica"), int)
+    ][:50]
+    rep_restarts = [
+        r for r in restarts if isinstance(r.get("replica"), int)
+    ]
+    s["replica_restarts"] = len(rep_restarts)
+    rep_mttrs = [
+        r["mttr_s"] for r in rep_restarts if isinstance(r.get("mttr_s"), (int, float))
+    ]
+    s["replica_mttr_s_median"] = (
+        round(statistics.median(rep_mttrs), 3) if rep_mttrs else None
+    )
+    s["replica_mttr_s_max"] = round(max(rep_mttrs), 3) if rep_mttrs else None
     predict = kinds.get("predict", [])
     s["predict_last"] = predict[-1] if predict else None
     summary = kinds.get("summary", [])
@@ -518,6 +570,55 @@ def render(s: dict, title: str = "run") -> str:
                 f"p99 {h.get('p99')}, max {h.get('max')}"
             )
         L.append("")
+    if s.get("serving_replicas") or s.get("replica_faults"):
+        L += ["## Serving resilience (replicated tier)", ""]
+        if s.get("serving_replicas"):
+            L.append(
+                "| replica | requests | rows scored | ex/s | deadline_drops "
+                "| sheds | p99 ms |"
+            )
+            L.append("|---:|---:|---:|---:|---:|---:|---:|")
+            for rep, sv in sorted(s["serving_replicas"].items()):
+                rows = sv.get("rows")
+                qps = (
+                    round(rows / s["duration_s"], 1)
+                    if isinstance(rows, (int, float)) and s["duration_s"]
+                    else None
+                )
+                shed_n = sum((sv.get("sheds_by_class") or {}).values())
+                L.append(
+                    f"| {rep} | {_fmt(sv.get('requests'))} | {_fmt(rows)} | "
+                    f"{_fmt(qps)} | {_fmt(sv.get('deadline_drops'))} | "
+                    f"{_fmt(shed_n)} | "
+                    f"{(sv.get('total_ms') or {}).get('p99')} |"
+                )
+        if s.get("sheds_by_class"):
+            L.append(
+                "- sheds by class: "
+                + ", ".join(f"{k}={v}" for k, v in sorted(s["sheds_by_class"].items()))
+            )
+        if s.get("class_p99_ms"):
+            L.append(
+                "- per-class p99 (worst replica): "
+                + ", ".join(
+                    f"{k}={v}ms" for k, v in sorted(s["class_p99_ms"].items())
+                )
+            )
+        L.append(
+            f"- replica faults: {s.get('replica_faults', 0)}, restarts: "
+            f"{s.get('replica_restarts', 0)}"
+        )
+        for e in s.get("replica_fault_events", []):
+            L.append(
+                f"  - replica {e['replica']}: {e['event']}"
+                + (f" (rc={e['exit_code']})" if e.get("exit_code") is not None else "")
+            )
+        if s.get("replica_mttr_s_median") is not None:
+            L.append(
+                f"- replica MTTR (death detected → healthy again): median "
+                f"{s['replica_mttr_s_median']}s, max {s['replica_mttr_s_max']}s"
+            )
+        L.append("")
     return "\n".join(L)
 
 
@@ -534,6 +635,9 @@ _GATE_METRICS = [
     ("faults", "faults", False),
     ("restarts", "restarts", False),
     ("rollbacks", "rollbacks", False),
+    ("deadline_drops", "serving deadline drops", False),
+    ("replica_faults", "serving replica faults", False),
+    ("replica_restarts", "serving replica restarts", False),
     ("host_rss_peak_bytes", "host RSS peak", False),
     ("device_peak_bytes", "device mem peak", False),
     ("ckpt_stall_share", "ckpt stall share", False),
@@ -588,10 +692,28 @@ def compare(run: dict, base: dict, threshold: float, strict: bool = False):
             ("restarts", "restarts"),
             ("rollbacks", "rollbacks"),
             ("host_faults", "host-level faults"),
+            ("replica_faults", "serving replica faults"),
         ):
             if (run.get(key) or 0) > (base.get(key) or 0):
                 regressions.append(
                     f"new {label}: {base.get(key) or 0} -> {run.get(key) or 0}"
+                )
+        # Per-class serving p99 SLO gate: a class whose worst-replica p99
+        # degraded past the threshold regresses even if the aggregate
+        # (dominated by the bulk class) still looks fine — priority
+        # classes are exactly the ones a mean would hide.
+        for k, bp in (base.get("class_p99_ms") or {}).items():
+            rp = (run.get("class_p99_ms") or {}).get(k)
+            if (
+                isinstance(rp, (int, float))
+                and isinstance(bp, (int, float))
+                and bp > 0
+                and rp > bp * (1 + threshold)
+            ):
+                regressions.append(
+                    f"serving class {k!r} p99 regressed "
+                    f"{(rp - bp) / bp * 100:.1f}% (> {threshold * 100:.0f}%): "
+                    f"{bp}ms -> {rp}ms"
                 )
         # Checkpoint stall share regression: the run spends a meaningfully
         # larger fraction of wall clock blocked on saves than the base did.
