@@ -586,6 +586,11 @@ def main():
 
     rng = np.random.default_rng(0)
     results = {}
+    # One telemetry identity per bench invocation (artifact join key —
+    # stamped on the result line with schema_version below).
+    from fast_tffm_tpu.telemetry import new_run_id
+
+    _BENCH_RUN_ID = new_run_id()
 
     # --- headline: local jitted step, largest WORKING table (probed in
     #     fresh subprocesses — see _probe_rung), Zipf ids, row accum ---
@@ -1128,11 +1133,18 @@ def main():
             "table, Zipf(1.1) ids, fused tile-row layout, capped compact tail)"
         )
     _watchdog.cancel()
+    from fast_tffm_tpu.telemetry import artifact_stamp
+
     result = {
         "metric": metric,
         "value": value,
         "unit": "examples/sec/chip",
         "vs_baseline": round(value / BASELINE_EXAMPLES_PER_SEC_PER_CHIP, 4),
+        # Envelope join keys: one identity per bench invocation (the main
+        # rungs run raw jitted loops with no monitor — the stamp names the
+        # invocation; bench --dist threads its run_id into the workers'
+        # [Telemetry] so THAT artifact joins its streams for real).
+        **artifact_stamp(_BENCH_RUN_ID),
         **results,
     }
     print(json.dumps(result))
@@ -1174,6 +1186,7 @@ cfg = Config(
     train_files=tuple(files.split(",")),
     epoch_num=1, batch_size={batch}, max_nnz={nnz}, learning_rate=0.01,
     log_every=4, metrics_path=f"{{tmp}}/run.jsonl",
+    telemetry_run_id={run_id!r},
     input_assignment="files",
     barrier_timeout_s=120,
     hash_feature_id=True,  # the synthetic FMB files are written hashed
@@ -1184,7 +1197,9 @@ print(f"[{{pid}}] BENCH DONE", flush=True)
 '''
 
 
-def bench_dist(processes: int = 2, out_path: str | None = None) -> dict:
+def bench_dist(
+    processes: int = 2, out_path: str | None = None, run_id: str = ""
+) -> dict:
     """The ``processes`` lever (ROADMAP item 1): a REAL multi-process CPU
     pod — N OS processes, gloo collectives, shard-disjoint FMB file
     assignment, host-local packed wire — measured through the production
@@ -1205,19 +1220,30 @@ def bench_dist(processes: int = 2, out_path: str | None = None) -> dict:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
+    from fast_tffm_tpu.telemetry import artifact_stamp
+
+    # The workers adopt this run_id via [Telemetry] (the worker template's
+    # telemetry_run_id), so the stamp genuinely joins artifact to streams.
+    stamp = artifact_stamp(run_id)
+    run_id = stamp["run_id"]
     result: dict = {
         "metric": (
             f"dist_train global examples/sec ({processes}-process CPU pod, "
             f"gloo, shard-disjoint FMB files, packed wire, batch {batch}, "
             f"vocab {vocab}, nnz {NNZ})"
         ),
+        **stamp,
         "processes": processes,
         "rows_per_host": rows,
     }
     with tempfile.TemporaryDirectory(prefix="bench-dist-") as tmp:
         script = os.path.join(tmp, "worker.py")
         with open(script, "w") as f:
-            f.write(_DIST_WORKER.format(repo=repo, vocab=vocab, batch=batch, nnz=NNZ))
+            f.write(
+                _DIST_WORKER.format(
+                    repo=repo, vocab=vocab, batch=batch, nnz=NNZ, run_id=run_id
+                )
+            )
         env = dict(os.environ, JAX_PLATFORMS="cpu")
         env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
         procs = [

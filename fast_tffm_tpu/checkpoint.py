@@ -43,6 +43,7 @@ __all__ = [
     "latest_step",
     "checkpoint_signature",
     "checkpoint_save_id",
+    "read_publish_time",
     "save_delta",
     "read_delta_chain",
     "load_delta",
@@ -266,6 +267,11 @@ def _save_npz(
         "save_id": np.frombuffer(
             (save_id or uuid.uuid4().hex).encode(), np.uint8
         ),
+        # Publish event time (wall clock): the anchor every downstream
+        # freshness SLO (publish→applied, publish→first-scored) measures
+        # from.  Stamped at write start — the rename lands moments later,
+        # so the serving-side latency INCLUDES the final write tail.
+        "published_at": np.float64(time.time()),
     }
     if cursor is not None:
         entries["input_cursor"] = _cursor_entry(cursor)
@@ -381,6 +387,8 @@ def save_delta(
         "step": step,
         "parent_sig": np.frombuffer(parent_sig.encode(), np.uint8),
         "save_id": np.frombuffer(sid.encode(), np.uint8),
+        # Same freshness anchor full saves carry (see _save_npz).
+        "published_at": np.float64(time.time()),
     }
     if cursor is not None:
         entries["input_cursor"] = _cursor_entry(cursor)
@@ -451,6 +459,26 @@ def read_delta_chain(path: str) -> tuple[str | None, list[dict]]:
         chain.append(meta)
         expect = meta["save_id"]
     return base_sig, chain
+
+
+def read_publish_time(path: str) -> float | None:
+    """Publish event time (wall clock, seconds) of ``path``'s CHAIN HEAD
+    — the newest delta when incremental files extend the base, else the
+    base itself.  None for orbax dirs, pre-PR-9 files (no ``published_at``
+    member), or anything unreadable: freshness measurement degrades to
+    absent, never to an error on an old checkpoint."""
+    path = path.rstrip("/")
+    if not os.path.isfile(path):
+        return None
+    deltas = delta_paths(path)
+    head = deltas[-1] if deltas else path
+    try:
+        with _open_npz(head) as z:
+            if "published_at" not in getattr(z, "files", ()):
+                return None
+            return float(z["published_at"])
+    except (ValueError, OSError):
+        return None
 
 
 def checkpoint_save_id(path: str) -> str | None:

@@ -41,6 +41,14 @@ def main(argv: list[str] | None = None) -> int:
         "[Telemetry] run_id (default: auto-generated per run)",
     )
     ap.add_argument(
+        "--profile-steps",
+        default=None,
+        metavar="A:B",
+        help="capture a jax.profiler trace over steps [A, B) (rounded to "
+        "dispatch boundaries under step fusion) into <model_file>.profile "
+        "(trace_dir overrides); overrides [Telemetry] profile_steps",
+    )
+    ap.add_argument(
         "--supervised",
         action="store_true",
         help="train/dist_train only: run the trainer as a SUPERVISED child "
@@ -100,6 +108,11 @@ def main(argv: list[str] | None = None) -> int:
         cfg.metrics_path = args.metrics_path
     if args.run_id is not None:
         cfg.telemetry_run_id = args.run_id
+    if args.profile_steps is not None:
+        from fast_tffm_tpu.profiling import parse_profile_steps
+
+        parse_profile_steps(args.profile_steps)  # fail fast on a bad spec
+        cfg.telemetry_profile_steps = args.profile_steps
     if cfg.telemetry_compilation_cache_dir:
         # Before any driver import compiles a program: repeated runs (and
         # serving cold starts) then read their XLA programs back from the

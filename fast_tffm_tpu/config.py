@@ -114,6 +114,21 @@ class Config:
     #   cache directory (jax_compilation_cache_dir): serving cold-start
     #   warmup and repeated bench runs skip recompiles across processes;
     #   the compile sentinel marks cache hits distinctly ("" = off)
+    telemetry_profile_steps: str = ""  # "A:B" captures a jax.profiler trace
+    #   over steps [A, B) (rounded to dispatch boundaries under step
+    #   fusion) into <model_file>.profile (trace_dir overrides); start/
+    #   stop land as kind=profile event records ("" = no trace)
+    telemetry_profile_costs: bool = True  # per-compiled-program MEASURED
+    #   cost ledger (XLA cost analysis: bytes accessed, FLOPs) emitted as
+    #   ONE kind=profile record per program on train/predict/serving —
+    #   one re-lowering each, no second backend compile, no hot-path work
+    telemetry_datastats_every_steps: int = 0  # sample device-side id-traffic
+    #   statistics (unique/dedup ratio, heavy-hitter sketch, rows-seen)
+    #   every N steps as kind=datastats records (0 = off; the sampled
+    #   batch pays one O(M log M) device sort per window)
+    telemetry_heavy_hitter_k: int = 16  # top-K buckets of the datastats
+    #   heavy-hitter sketch reported per record (sizes ROADMAP item 3's
+    #   hot-id cache; bucket collisions overstate mass — an upper bound)
     # [Predict]
     predict_files: tuple[str, ...] = ()
     score_path: str = "scores.txt"
@@ -369,6 +384,21 @@ class Config:
                 "telemetry_mem_every_s and telemetry_stall_timeout_s must be "
                 ">= 0 (0 disables)"
             )
+        if self.telemetry_profile_steps:
+            # Parse-validate at config time, not at step N of a long run.
+            from fast_tffm_tpu.profiling import parse_profile_steps
+
+            parse_profile_steps(self.telemetry_profile_steps)
+        if self.telemetry_datastats_every_steps < 0:
+            raise ValueError(
+                "telemetry_datastats_every_steps must be >= 0 (0 = off), got "
+                f"{self.telemetry_datastats_every_steps}"
+            )
+        if self.telemetry_heavy_hitter_k < 1:
+            raise ValueError(
+                f"telemetry_heavy_hitter_k must be >= 1, got "
+                f"{self.telemetry_heavy_hitter_k}"
+            )
         if self.packed_update not in ("auto", "dense", "compact", "sorted"):
             raise ValueError(
                 f"unknown packed_update {self.packed_update!r} "
@@ -549,6 +579,18 @@ def load_config(path: str) -> Config:
     )
     cfg.telemetry_compilation_cache_dir = get(
         te, "compilation_cache_dir", str, cfg.telemetry_compilation_cache_dir
+    )
+    cfg.telemetry_profile_steps = get(
+        te, "profile_steps", str, cfg.telemetry_profile_steps
+    )
+    cfg.telemetry_profile_costs = get(
+        te, "profile_costs", ini._convert_to_boolean, cfg.telemetry_profile_costs
+    )
+    cfg.telemetry_datastats_every_steps = get(
+        te, "datastats_every_steps", int, cfg.telemetry_datastats_every_steps
+    )
+    cfg.telemetry_heavy_hitter_k = get(
+        te, "heavy_hitter_k", int, cfg.telemetry_heavy_hitter_k
     )
 
     c = "Checkpoint"

@@ -249,6 +249,14 @@ def make_cached_train_step(model, learning_rate: float, data: DeviceDataset, bod
     def step_shuffled(state, perm, i):
         return _step_shuffled(state, arrays, perm, i)
 
+    # Measured-cost hooks (profiling.CostLedger): the closures stay
+    # profileable by delegating .lower to the inner jit with the resident
+    # arrays bound — lowering only, never a second backend compile.
+    step.lower = lambda st, i: _step.lower(st, arrays, i)
+    step_shuffled.lower = lambda st, perm, i: _step_shuffled.lower(
+        st, arrays, perm, i
+    )
+
     return step, step_shuffled
 
 
@@ -286,6 +294,28 @@ def make_cached_touched_marker(data: DeviceDataset):
         return _mark_shuffled(bitmap, data.ids, perm, i)
 
     return mark, mark_shuffled
+
+
+def make_cached_ids_slicer(data: DeviceDataset):
+    """``ids_fn(batch_index) -> ids`` for the datastats collector on the
+    device-cache path, where the driver's per-step "batch" is a resident
+    batch index (scalar) or a [K] scan chunk: the sampled window's ids
+    are sliced ON DEVICE from the resident array — no host round-trip.
+    Same explicit-argument jit discipline as the touched marker above."""
+    B = data.batch_size
+
+    @jax.jit
+    def _slice(ids_arr, i):
+        starts = i.reshape(-1).astype(jnp.int32)
+        rows = (
+            starts[:, None] * B + jnp.arange(B, dtype=jnp.int32)[None, :]
+        ).reshape(-1)
+        return ids_arr[rows]
+
+    def ids_at(b):
+        return _slice(data.ids, jnp.asarray(b))
+
+    return ids_at
 
 
 def epoch_index_chunks(batches: int, k: int, start: int = 0):
@@ -351,6 +381,12 @@ def make_cached_scan_train_step(model, learning_rate: float, data: DeviceDataset
 
     def step_shuffled(state, perm, idxs):
         return _scan_step_shuffled(state, arrays, perm, idxs)
+
+    # Same measured-cost .lower delegation as make_cached_train_step's.
+    step.lower = lambda st, idxs: _scan_step.lower(st, arrays, idxs)
+    step_shuffled.lower = lambda st, perm, idxs: _scan_step_shuffled.lower(
+        st, arrays, perm, idxs
+    )
 
     return step, step_shuffled
 

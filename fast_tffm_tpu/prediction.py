@@ -169,6 +169,14 @@ def _run_predict(
         mem_every_s=cfg.telemetry_mem_every_s,
         log=log,
     )
+    # Measured cost ledger (profiling.py): ONE kind=profile record for
+    # the predict program — bytes accessed / FLOPs from XLA cost
+    # analysis, emitted after the first dispatch compiled it.
+    ledger = None
+    if cfg.telemetry_profile_costs:
+        from fast_tffm_tpu.profiling import CostLedger
+
+        ledger = CostLedger(monitor, source="predict")
     t_start = time.perf_counter()
     out = None
     try:
@@ -192,9 +200,16 @@ def _run_predict(
         for b, parsed, w in stream:
             if b is None:
                 b = to_batch(parsed, w)
+            if ledger is not None and ledger.want("predict_step"):
+                ledger.stage(
+                    "predict_step", predict_step, (state, b),
+                    examples=int(getattr(b.labels, "shape", (0,))[0] or 0) or None,
+                )
             scores = np.asarray(predict_step(state, b))
             batches += 1
             monitor.on_dispatch(batches, warmup=(batches == 1))
+            if ledger is not None:
+                ledger.flush(batches)
             if not np.isfinite(scores).all():
                 # Under lookup_overflow=fallback an overflow cannot
                 # poison scores (the lookup reran via allgather).

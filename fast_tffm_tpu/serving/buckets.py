@@ -154,5 +154,12 @@ class BucketLadder:
         warmup is the no-steady-state-recompiles invariant."""
         return self._score.cache_size()
 
+    def example_batch(self, bucket: int) -> Batch:
+        """An all-padding batch of ``bucket``'s exact dispatched shape —
+        what the measured cost ledger lowers the score program at (the
+        same single _batch path warmup and assemble use, so the profiled
+        shape can never diverge from the served one)."""
+        return self._batch(bucket)
+
     def score(self, state, batch: Batch):
         return self._score(state, batch)

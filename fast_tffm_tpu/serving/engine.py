@@ -43,6 +43,7 @@ from fast_tffm_tpu.checkpoint import (
     checkpoint_signature,
     load_delta,
     read_delta_chain,
+    read_publish_time,
 )
 from fast_tffm_tpu.config import Config
 from fast_tffm_tpu.data.libsvm import parse_lines
@@ -199,6 +200,13 @@ class ServingEngine:
         self._staged_state = None
         self._staged_step = None
         self._staged_is_delta = False
+        # Freshness SLO bookkeeping: the staged checkpoint's publish
+        # timestamp (stamped into the npz by the writer — wall clock, so
+        # cross-host skew applies and negatives clamp to 0) travels with
+        # the stage; the swap records publish→applied and the first
+        # successful score after it completes publish→first-scored.
+        self._staged_pub_t = None
+        self._pending_fresh = None
         # Reload failure discipline for ONE observed signature (shared by
         # the polling watcher thread and router-driven reload_once calls):
         # retries back off exponentially, and after serve_reload_max_retries
@@ -210,6 +218,22 @@ class ServingEngine:
         self._next_retry_t = 0.0
 
         n = self._ladder.warmup(self._state)
+        if cfg.telemetry_profile_costs:
+            # Measured cost ledger: one kind=profile record per bucket's
+            # score program (bytes/FLOPs from XLA cost analysis).  Pure
+            # re-lowering at the warmed shapes — no extra backend compile,
+            # and it runs inside startup, never on the flush path.
+            from fast_tffm_tpu.profiling import CostLedger
+
+            ledger = CostLedger(self._monitor, source="serving")
+            for bkt in self._ladder.buckets:
+                ledger.stage(
+                    f"serve_score_b{bkt}",
+                    self._score.fn,
+                    (self._state, self._ladder.example_batch(bkt)),
+                    examples=bkt,
+                )
+            ledger.flush(0)
         # Attribute every startup compile (ladder rungs + unpackers) to
         # warmup; anything the sentinel sees after this is steady-state.
         self._monitor.on_dispatch(0, warmup=True)
@@ -241,6 +265,12 @@ class ServingEngine:
         """Step of the state CURRENTLY serving (advances at the first
         flush after a reload swap, not when the watcher stages)."""
         return int(self._state.step)
+
+    @property
+    def run_id(self) -> str:
+        """Telemetry run id of this engine's monitor — the join key
+        bench/probe artifacts stamp so they are joinable to the JSONL."""
+        return self._monitor.run_id
 
     def compile_count(self) -> int | None:
         return self._ladder.compile_count()
@@ -495,8 +525,19 @@ class ServingEngine:
             staged, self._staged_state = self._staged_state, None
             staged_step = self._staged_step
             staged_is_delta = self._staged_is_delta
+            staged_pub_t = self._staged_pub_t
         if staged is not None:
             self._state = staged
+            if staged_pub_t is not None:
+                # publish→applied is sealed HERE (the swap is the apply);
+                # publish→first-scored completes when this (or, if this
+                # flush is all-shed/fails, a later) flush resolves scores.
+                self._pending_fresh = {
+                    "published_at": staged_pub_t,
+                    "applied_ms": max(0.0, (time.time() - staged_pub_t) * 1e3),
+                    "step": staged_step,
+                    "mode": "delta" if staged_is_delta else "full",
+                }
             if not staged_is_delta:
                 # Delta swaps are already counted (per FILE) by
                 # on_delta_reload — keeping them out of `reloads` keeps
@@ -561,6 +602,8 @@ class ServingEngine:
             r.future.set_result(float(scores[i]))
         t_resolved = time.perf_counter()
         self._flush_seq += 1
+        if self._pending_fresh is not None:
+            self._emit_freshness()
         try:
             self._monitor.on_dispatch(self._flush_seq)
         except Exception:
@@ -590,6 +633,26 @@ class ServingEngine:
                 # metrics records, never to a dead collector: every
                 # request behind a dead collector hangs or blocks.
                 pass
+
+    def _emit_freshness(self) -> None:
+        """Seal one reload's freshness SLO: publish→applied was measured
+        at the swap; publish→first-scored-with-new-rows completes now,
+        at the first flush that RESOLVED scores against the new state.
+        Collector thread only (it owns _pending_fresh after the swap)."""
+        f, self._pending_fresh = self._pending_fresh, None
+        scored_ms = max(0.0, (time.time() - f["published_at"]) * 1e3)
+        self.metrics.on_freshness(f["applied_ms"] / 1e3, scored_ms / 1e3)
+        try:
+            self._monitor.emit(
+                "freshness",
+                step=self._flush_seq,
+                publish_step=f["step"],
+                publish_to_applied_ms=round(f["applied_ms"], 3),
+                publish_to_first_scored_ms=round(scored_ms, 3),
+                mode=f["mode"],
+            )
+        except Exception:
+            pass  # a full metrics disk must not kill the collector
 
     # -- hot reload ------------------------------------------------------
 
@@ -740,6 +803,13 @@ class ServingEngine:
 
         from fast_tffm_tpu.prediction import load_scoring_state
 
+        # Freshness stamp captured BEFORE the (possibly multi-second)
+        # restore: it names the chain head observed at attempt start.  A
+        # publish landing mid-restore can only make the measured latency
+        # OVERSTATE staleness (older stamp vs whatever got restored) —
+        # the safe error direction for an SLO; reading after the restore
+        # would attribute the staged (older) state to the newer publish.
+        pub_t = read_publish_time(self._cfg.model_file)
         state = None
         applied = 0
         if not _os.path.isdir(self._cfg.model_file):
@@ -781,6 +851,7 @@ class ServingEngine:
             self._staged_state = state
             self._staged_step = int(state.step)
             self._staged_is_delta = applied > 0
+            self._staged_pub_t = pub_t
         return "staged_delta" if applied > 0 else "staged"
 
     def reload_once(self) -> dict:

@@ -123,6 +123,13 @@ class ServingMetrics:
         self.delta_reloads = 0  # delta FILES applied in place (a delta
         #   swap does NOT also bump `reloads` — the counters are disjoint)
         self.bucket_rows: dict[int, int] = {}  # bucket size -> real rows
+        # Freshness SLO distributions (ISSUE 9): one sample per reload
+        # swap — checkpoint publish → state applied (collector swap) and
+        # publish → first score resolved against the new state.  Wall
+        # clocks on both ends (the publisher stamps, this process reads),
+        # so cross-host skew is the documented error bar.
+        self.fresh_applied = LatencyHistogram()
+        self.fresh_scored = LatencyHistogram()
 
     @staticmethod
     def _class_key(klass: str) -> str:
@@ -193,6 +200,12 @@ class ServingMetrics:
         with self._lock:
             self.reload_giveups += 1
 
+    def on_freshness(self, applied_s: float, scored_s: float) -> None:
+        """One reload swap's freshness pair (seconds since publish)."""
+        with self._lock:
+            self.fresh_applied.add(max(0.0, applied_s))
+            self.fresh_scored.add(max(0.0, scored_s))
+
     def on_delta_reload(self, n_deltas: int) -> None:
         """The watcher applied ``n_deltas`` incremental checkpoint files in
         place (no full-table re-read) — counted separately from full
@@ -230,6 +243,8 @@ class ServingMetrics:
                 "queue_ms": self.queue.snapshot(),
                 "compute_ms": self.compute.snapshot(),
                 "total_ms": self.total.snapshot(),
+                "freshness_applied_ms": self.fresh_applied.snapshot(),
+                "freshness_scored_ms": self.fresh_scored.snapshot(),
             }
 
     def log_to(self, sink) -> None:

@@ -173,13 +173,14 @@ def spawn_replica(
 
 
 class _Pending:
-    __slots__ = ("msg", "future", "retried", "t0", "kind")
+    __slots__ = ("msg", "future", "retried", "t0", "kind", "gen")
 
-    def __init__(self, msg, future, kind="score", retried=False):
+    def __init__(self, msg, future, kind="score", retried=False, gen=0):
         self.msg = msg
         self.future = future
         self.kind = kind
         self.retried = retried
+        self.gen = gen  # reload fan-out ordinal (freshness attribution)
         self.t0 = time.perf_counter()
 
 
@@ -268,6 +269,26 @@ class Router:
         #   read-then-clear pair could drop the LAST failed ack forever
         self._retry_lock = threading.Lock()
         self.mttr_s: list[float] = []
+        # Fleet freshness (ISSUE 9): per fan-out, the router measures
+        # checkpoint-publish → each replica's staged ack (wall clocks on
+        # both ends; the engine-side kind=freshness records carry the
+        # precise applied/first-scored pair — this is the tier-level
+        # roll-up).  One kind=freshness record per COMPLETED fan-out,
+        # stamped with the slowest replica's latency; stats() reports the
+        # running percentiles operators poll over the wire.
+        from fast_tffm_tpu.serving.metrics import LatencyHistogram
+
+        self._fresh_lock = threading.Lock()
+        # Per-replica staged latencies, bounded: the same fixed-bin
+        # histogram the engine's freshness pair uses (a raw list would
+        # grow one float per ack forever and re-sort under the lock on
+        # every stats poll).
+        self.freshness_hist = LatencyHistogram()
+        self._fanout_gen = 0  # fan-out ordinal: a slow replica's ack from
+        #   fan-out N must not be measured against (or close) fan-out N+1
+        self._fanout_pub_t: float | None = None
+        self._fanout_pending: set[int] = set()
+        self._fanout_ms: list[float] = []  # current fan-out only (<= replicas)
         # Reload-watch baseline, captured BEFORE the replicas spawn so a
         # publish landing during their multi-second bring-up still fans
         # out (replicas already on it ack noop — idempotent).
@@ -534,6 +555,8 @@ class Router:
             slot.reload_acks += 1
             slot.last_reload = msg
             pending.future.set_result(msg)
+            if msg.get("status") in ("staged", "staged_delta"):
+                self._note_reload_staged(slot, msg, pending.gen)
             if msg.get("status") in ("failed", "busy"):
                 # The replica could not complete this reload (torn write
                 # mid-read, or a previous stage unswapped).  Its own
@@ -553,6 +576,53 @@ class Router:
             err = WireError(msg.get("error", code))
             err.code = code if code in ("overloaded", "deadline", "bad_request") else "unavailable"
             pending.future.set_exception(err)
+
+    def _note_reload_staged(self, slot: _Slot, msg: dict, pending_gen: int = 0) -> None:
+        """One replica staged the fanned-out checkpoint: record its
+        publish→staged latency; when the whole fleet has, emit ONE
+        aggregate kind=freshness record (the slowest replica's latency is
+        the tier's — a client can land anywhere).  Reader threads call
+        this concurrently; the lock owns all fan-out state."""
+        ms = None
+        fleet_done = False
+        publish_step = msg.get("step")
+        with self._fresh_lock:
+            if self._fanout_pub_t is None or pending_gen != self._fanout_gen:
+                # No stamp, pre-baseline reload, or a STALE ack: a slow
+                # replica still staging fan-out N while fan-out N+1 opened
+                # must not be measured against N+1's publish time (nor
+                # shrink N+1's pending set).
+                return
+            ms = max(0.0, (time.time() - self._fanout_pub_t) * 1e3)
+            self.freshness_hist.add(ms / 1e3)  # histogram takes seconds
+            self._fanout_ms.append(ms)
+            self._fanout_pending.discard(slot.index)
+            if not self._fanout_pending:
+                fleet_done = True
+                worst = max(self._fanout_ms)
+                n = len(self._fanout_ms)
+                self._fanout_pub_t = None
+                self._fanout_ms = []
+        if fleet_done:
+            try:
+                self._monitor.emit(
+                    "freshness",
+                    publish_step=publish_step,
+                    publish_to_applied_ms=round(worst, 3),
+                    publish_to_first_scored_ms=None,
+                    replicas=n,
+                    scope="fleet_staged",
+                )
+            except Exception:
+                pass
+
+    def freshness_percentiles(self) -> dict:
+        """Running publish→staged percentiles across every ack observed —
+        the fleet freshness number the `stats` wire op reports (the same
+        {count, mean, p50, p95, p99, max}-in-ms snapshot vocabulary every
+        serving histogram speaks)."""
+        with self._fresh_lock:
+            return self.freshness_hist.snapshot()
 
     # -- failure handling --------------------------------------------------
 
@@ -714,7 +784,7 @@ class Router:
 
     def _watch_loop(self) -> None:
         from concurrent.futures import Future
-        from fast_tffm_tpu.checkpoint import checkpoint_signature
+        from fast_tffm_tpu.checkpoint import checkpoint_signature, read_publish_time
 
         # The baseline was captured in __init__ BEFORE the replicas were
         # spawned: a checkpoint published during the multi-second
@@ -734,12 +804,21 @@ class Router:
             else:
                 self.reload_retries += 1
                 why = "re-driving a failed/deferred reload"
+            targets = self.healthy_replicas()
             self._log(
-                f"router: {why} — fanning reload to "
-                f"{len(self.healthy_replicas())} replica(s)"
+                f"router: {why} — fanning reload to {len(targets)} replica(s)"
             )
-            for slot in self.healthy_replicas():
-                pending = _Pending({"op": "reload"}, Future(), kind="reload")
+            # Fleet freshness window: measure publish → each replica's
+            # staged ack against the chain head's publish stamp (None on
+            # pre-stamp checkpoints — measurement degrades to absent).
+            with self._fresh_lock:
+                self._fanout_gen += 1
+                gen = self._fanout_gen
+                self._fanout_pub_t = read_publish_time(self._cfg.model_file)
+                self._fanout_pending = {s.index for s in targets}
+                self._fanout_ms = []
+            for slot in targets:
+                pending = _Pending({"op": "reload"}, Future(), kind="reload", gen=gen)
                 self._register(slot, pending)
                 try:
                     self._send(slot, pending.msg, ctrl=True)
@@ -764,17 +843,22 @@ class Router:
                 }
             )
         return {
+            "run_id": self.run_id,
             "replicas": reps,
             "failovers": self.failovers,
             "failed_unanswerable": self.failed_unanswerable,
             "reload_fanouts": self.reload_fanouts,
             "reload_retries": self.reload_retries,
             "mttr_s": list(self.mttr_s),
+            "freshness_staged_ms": self.freshness_percentiles(),
         }
 
     def stats(self, timeout: float = 10.0) -> dict:
         """Router snapshot + each healthy replica's engine stats (the
-        ``stats`` wire op's payload)."""
+        ``stats`` wire op's payload) + the fleet freshness roll-up: the
+        router's publish→staged percentiles and, from the engines' own
+        histograms, the worst replica's publish→first-scored p99 — the
+        end-to-end freshness SLO an operator polls without tailing JSONL."""
         out = self.snapshot()
         engines = {}
         for slot in list(self.healthy_replicas()):
@@ -783,6 +867,16 @@ class Router:
             except Exception as e:
                 engines[str(slot.index)] = {"error": repr(e)}
         out["engines"] = engines
+        scored_p99 = [
+            h.get("p99")
+            for e in engines.values()
+            for h in ((e.get("engine") or {}).get("freshness_scored_ms"),)
+            if isinstance(h, dict) and isinstance(h.get("p99"), (int, float))
+        ]
+        out["freshness"] = {
+            "staged_ms": out.pop("freshness_staged_ms"),
+            "scored_p99_ms_worst_replica": max(scored_p99) if scored_p99 else None,
+        }
         return out
 
     # -- shutdown ----------------------------------------------------------
@@ -824,10 +918,16 @@ class Router:
                 h.kill()
                 h.wait(timeout=2.0)
         try:
+            fresh = self.freshness_percentiles()
             self._monitor.close(
                 router_failovers=self.failovers,
                 router_unanswerable=self.failed_unanswerable,
                 router_restarts=sum(s.restarts for s in self.slots),
+                **(
+                    {"router_freshness_staged_p99_ms": fresh["p99"]}
+                    if fresh.get("count")
+                    else {}
+                ),
             )
         except Exception:
             pass

@@ -62,6 +62,7 @@ __all__ = [
     "RunMonitor",
     "CompileSentinel",
     "new_run_id",
+    "artifact_stamp",
     "thread_stacks",
     "classify_stall",
     "first_nonfinite_leaf",
@@ -130,6 +131,29 @@ SCHEMAS: dict[str, tuple[str, ...]] = {
         "rows_written",
         "train_stall_ms",
     ),
+    # Deep observability (profiling.py).  profile: one record per
+    # measured compiled program (XLA cost analysis — bytes/flops null
+    # only for trace start/stop event records, program="trace");
+    # datastats: sampled device-side id-traffic statistics (dedup ratio,
+    # heavy-hitter sketch mass, cumulative rows seen); freshness: the
+    # publish→applied / publish→first-scored-with-new-rows SLO measured
+    # at a serving reload swap (engine) or aggregated across a reload
+    # fan-out (router — applied/scored keys null where it cannot see).
+    "profile": ("program", "flops", "bytes_accessed"),
+    "datastats": (
+        "window_steps",
+        "ids",
+        "unique",
+        "dedup_ratio",
+        "rows_seen",
+        "hh_k",
+        "hh_topk_mass",
+    ),
+    "freshness": (
+        "publish_step",
+        "publish_to_applied_ms",
+        "publish_to_first_scored_ms",
+    ),
     "summary": ("total_compiles", "steady_compiles", "stalls", "anomalies"),
 }
 
@@ -137,6 +161,15 @@ SCHEMAS: dict[str, tuple[str, ...]] = {
 def new_run_id() -> str:
     """Sortable-by-start-time and collision-safe across processes."""
     return f"{time.strftime('%Y%m%d-%H%M%S')}-{os.getpid():x}-{os.urandom(3).hex()}"
+
+
+def artifact_stamp(run_id: str = "") -> dict:
+    """The join keys every committed BENCH_*/PROBE_* JSON must carry so a
+    bench artifact is joinable to the telemetry JSONL stream(s) it was
+    measured from: the envelope ``run_id`` (pass the run's; a fresh one
+    is drawn for tools that never started a monitored run) and the
+    envelope ``schema_version`` the emitters wrote under."""
+    return {"run_id": run_id or new_run_id(), "schema_version": SCHEMA_VERSION}
 
 
 # -- compile sentinel -----------------------------------------------------

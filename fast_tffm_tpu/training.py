@@ -448,6 +448,7 @@ def _run_training(
     rollback=None,
     runtime=None,
     mesh=None,
+    datastats_ids=None,
 ):
     """Shared step loop.  ``train_stream(epoch)`` overrides the per-epoch
     input stream, ``to_batch(parsed, w)`` the host→device batch assembly,
@@ -479,6 +480,11 @@ def _run_training(
     optional custom touched-row bitmap marker — the device-cache drivers
     mark from their resident id arrays) parameterize the async/delta
     checkpoint subsystem (checkpoint_async.AsyncCheckpointer).
+
+    ``datastats_ids`` (optional ``batch -> device ids``) lets the sampled
+    id-statistics collector read a device-cache batch's ids straight off
+    the resident arrays; streamed paths feed it the host-side ``parsed``
+    rows instead (profiling.DataStatsCollector).
 
     ``start_cursor`` (a dict from checkpoint.read_input_cursor) resumes
     the INPUT at the exact saved position: the epoch loop starts at the
@@ -555,6 +561,76 @@ def _run_training(
         mem_every_s=cfg.telemetry_mem_every_s,
         log=log,
     )
+    # Deep observability (profiling.py): the on-demand step-window trace,
+    # the per-compiled-program measured cost ledger (kind=profile — the
+    # evidence column next to the modeled HBM floor), and the sampled
+    # id-traffic statistics (kind=datastats — the dedup/heavy-hitter
+    # numbers ROADMAP item 3 sizes against).  All compiles these issue
+    # attribute as warmup; the trace is lead-host-only like WindowTracer.
+    from fast_tffm_tpu.profiling import (
+        CostLedger,
+        DataStatsCollector,
+        StepProfiler,
+        modeled_step_bytes,
+    )
+
+    profiler = StepProfiler(
+        cfg.telemetry_profile_steps if is_lead else "",
+        cfg.trace_dir or (cfg.model_file + ".profile"),
+        monitor=monitor,
+        log=log,
+    )
+    ledger = CostLedger(monitor, source="train") if cfg.telemetry_profile_costs else None
+    datastats = None
+    if cfg.telemetry_datastats_every_steps > 0:
+        datastats = DataStatsCollector(
+            monitor,
+            vocab=cfg.vocabulary_size,
+            row_dim=max(1, row_dim),
+            every_steps=cfg.telemetry_datastats_every_steps,
+            heavy_hitter_k=cfg.telemetry_heavy_hitter_k,
+            ids_fn=datastats_ids,
+        )
+    accum_cols = max(1, row_dim) if cfg.adagrad_accumulator == "element" else 1
+
+    def _stage_step_profile(b, parsed):
+        """First-dispatch capture: abstract shapes (before donation) plus
+        the modeled HBM floor for THIS batch's ids — measured and modeled
+        land on one kind=profile record."""
+        modeled = None
+        ex = None
+        if isinstance(parsed, list):
+            ex = sum(p.batch_size for p in parsed)
+            modeled = sum(
+                modeled_step_bytes(p.ids, max(1, row_dim), accum_cols)[0]
+                for p in parsed
+            )
+        elif parsed is not None and hasattr(parsed, "ids"):
+            ex = parsed.batch_size
+            modeled, _ = modeled_step_bytes(parsed.ids, max(1, row_dim), accum_cols)
+        elif examples_per_step is not None:
+            k_hint = 1
+            shape = tuple(getattr(b, "shape", ()) or ())
+            if shape:
+                k_hint = int(np.prod(shape))
+            ex = examples_per_step * k_hint
+            if datastats_ids is not None:
+                try:
+                    # One-time D2H of one batch's ids: the modeled floor
+                    # needs the host-side unique count (setup cost only).
+                    # The slicer returns the whole dispatch's rows (all K
+                    # batches of a scan chunk); whole-window unique only
+                    # UNDERSTATES the per-batch RMW term — still a floor.
+                    ids_host = np.asarray(datastats_ids(b))
+                    modeled, _ = modeled_step_bytes(
+                        ids_host, max(1, row_dim), accum_cols
+                    )
+                except Exception:
+                    modeled = None
+        ledger.stage(
+            "train_step", step_fn, (state, b), examples=ex, modeled_bytes=modeled,
+        )
+
     # Pod liveness: this host's heartbeat (armed at bring-up) starts
     # carrying the step counter, and a peer-heartbeat monitor classifies a
     # stale host as a host-level kind=stall long before jax's own
@@ -697,6 +773,10 @@ def _run_training(
                 if b is None:
                     b = to_batch(parsed, w)
                 tracer.on_step()
+                if ledger is not None and ledger.want("train_step"):
+                    # Abstract shapes must be captured BEFORE the dispatch
+                    # donates the state buffers.
+                    _stage_step_profile(b, parsed)
                 with step_trace("train", step_num):
                     state, loss = step_fn(state, b)
                 # A fused call returns per-micro-step losses [K]; K=1
@@ -726,6 +806,14 @@ def _run_training(
                 monitor.on_dispatch(step_num, warmup=(epoch == start_epoch))
                 if heartbeat is not None:
                     heartbeat.set_step(step_num)
+                # Deep-observability hooks, all cheap no-ops when idle:
+                # the trace window check, the (once-per-program) measured
+                # cost flush, and the sampled id-stats reducer.
+                profiler.on_step(step_num)
+                if ledger is not None:
+                    ledger.flush(step_num)
+                if datastats is not None:
+                    datastats.note(step_num, parsed=parsed, batch=b)
                 if ckpt.delta_enabled:
                     # OR this batch's rows into the device bitmap; at a
                     # delta boundary, ship the touched window (writer
@@ -880,6 +968,11 @@ def _run_training(
         summary_extra.update(
             {f"fault_{k}": v for k, v in drain_fault_counters().items() if v}
         )
+        if ledger is not None:
+            summary_extra.update(ledger.summary())
+        if datastats is not None:
+            summary_extra.update(datastats.summary())
+        profiler.close(step_num)
         tracer.close()
         if host_monitor is not None:
             host_monitor.close()
@@ -1023,12 +1116,12 @@ def train(cfg: Config, *, resume: bool = False, log=print, step_hook=None):
         row_dim=model.row_dim,
     )
     if cfg.device_cache:
-        step_fn, train_stream, examples_per_step, mark_touched = _device_cached_input(
-            cfg, model, max_nnz, log, body=step_body
+        step_fn, train_stream, examples_per_step, mark_touched, ids_fn = (
+            _device_cached_input(cfg, model, max_nnz, log, body=step_body)
         )
         run_kwargs.update(
             train_stream=train_stream, examples_per_step=examples_per_step,
-            mark_touched=mark_touched,
+            mark_touched=mark_touched, datastats_ids=ids_fn,
         )
     # on_nan = rollback: a non-finite loss restores the last checkpoint
     # and resumes input AT the detection cursor — the diverged window's
@@ -1088,6 +1181,7 @@ def _device_cached_input(cfg: Config, model, max_nnz: int, log, body=None):
         epoch_index_chunks,
         full_epoch_perm,
         load_device_dataset,
+        make_cached_ids_slicer,
         make_cached_scan_train_step,
         make_cached_touched_marker,
         make_cached_train_step,
@@ -1169,7 +1263,18 @@ def _device_cached_input(cfg: Config, model, max_nnz: int, log, body=None):
                 return stepk_shuffled(state, perm_ref[0], idxs)
             return stepk(state, idxs)
 
-        return step_fn, train_stream, cfg.batch_size, mark_touched
+        def _lower_k(st, idxs):
+            # Measured-cost hook (profiling.CostLedger): expose the inner
+            # jit's .lower so the closure stays profileable.
+            if perm_ref[0] is not None:
+                return stepk_shuffled.lower(st, perm_ref[0], idxs)
+            return stepk.lower(st, idxs)
+
+        step_fn.lower = _lower_k
+        return (
+            step_fn, train_stream, cfg.batch_size, mark_touched,
+            make_cached_ids_slicer(data),
+        )
 
     cached_step, cached_step_shuffled = make_cached_train_step(
         model, cfg.learning_rate, data, body=body
@@ -1187,7 +1292,16 @@ def _device_cached_input(cfg: Config, model, max_nnz: int, log, body=None):
             return cached_step_shuffled(state, perm_ref[0], i)
         return cached_step(state, i)
 
-    return step_fn, train_stream, cfg.batch_size, mark_touched
+    def _lower(st, i):
+        if perm_ref[0] is not None:
+            return cached_step_shuffled.lower(st, perm_ref[0], i)
+        return cached_step.lower(st, i)
+
+    step_fn.lower = _lower
+    return (
+        step_fn, train_stream, cfg.batch_size, mark_touched,
+        make_cached_ids_slicer(data),
+    )
 
 
 def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None, step_hook=None):
@@ -1467,6 +1581,10 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None, step_
                 overflowed if overflow_sum[0] is None else overflow_sum[0] + overflowed
             )
             return state, loss
+
+        if hasattr(raw_step, "lower"):
+            # Keep the wrapped step profileable (measured cost ledger).
+            step_fn.lower = raw_step.lower
 
         def extra_metrics():
             n = int(overflow_sum[0]) if overflow_sum[0] is not None else 0

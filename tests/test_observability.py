@@ -1,0 +1,401 @@
+"""Deep-observability layer (ISSUE 9): step-phase profiling, id-traffic
+statistics, and freshness SLOs — end to end on real runs.
+
+The acceptance pins: kind=profile carries MEASURED bytes next to the
+modeled floor on the streamed AND device-cached paths, kind=datastats
+carries the dedup/heavy-hitter numbers, kind=freshness pins
+publish→applied on a live engine reload — and every instrumented path
+keeps ZERO steady-state recompiles (the stats/profiling programs
+attribute as warmup).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from fast_tffm_tpu.config import Config
+from fast_tffm_tpu.profiling import (
+    DataStatsCollector,
+    modeled_step_bytes,
+    parse_profile_steps,
+)
+from fast_tffm_tpu.telemetry import ENVELOPE_FIELDS, SCHEMAS
+from fast_tffm_tpu.training import train
+
+V = 200
+NNZ = 8
+
+
+def _read(path):
+    return [json.loads(l) for l in open(path).read().splitlines() if l.strip()]
+
+
+def _write_dataset(path, rng, n=320, vocab=V, nnz=NNZ):
+    lines = []
+    for _ in range(n):
+        ids = rng.choice(vocab, size=nnz, replace=False)
+        vals = np.round(np.abs(rng.normal(size=nnz)) + 0.1, 4)
+        lines.append(
+            f"{int(rng.random() < 0.5)} "
+            + " ".join(f"{i}:{v}" for i, v in zip(ids, vals))
+        )
+    path.write_text("\n".join(lines) + "\n")
+
+
+def _cfg(tmp_path, tag="run", **kw):
+    base = dict(
+        model="fm",
+        factor_num=4,
+        vocabulary_size=V,
+        model_file=str(tmp_path / f"model_{tag}.npz"),
+        train_files=(str(tmp_path / "train.libsvm"),),
+        epoch_num=2,
+        batch_size=32,
+        learning_rate=0.1,
+        log_every=4,
+        metrics_path=str(tmp_path / f"m_{tag}.jsonl"),
+    )
+    base.update(kw)
+    return Config(**base).validate()
+
+
+@pytest.fixture
+def dataset(tmp_path):
+    _write_dataset(tmp_path / "train.libsvm", np.random.default_rng(0))
+    return tmp_path
+
+
+def _assert_schema(records):
+    for r in records:
+        assert all(f in r for f in ENVELOPE_FIELDS), r
+        assert all(k in r for k in SCHEMAS[r["kind"]]), r
+
+
+def _steady(records):
+    return [r for r in records if r["kind"] == "compile" and not r["warmup"]]
+
+
+# -- measured cost ledger + datastats, per data path ----------------------
+
+
+def test_streamed_profile_and_datastats(dataset):
+    cfg = _cfg(dataset, tag="st", telemetry_datastats_every_steps=3)
+    train(cfg, log=lambda *_: None)
+    records = _read(cfg.metrics_path)
+    _assert_schema(records)
+    assert _steady(records) == []  # the instrumented-path pin
+
+    (prof,) = [
+        r for r in records if r["kind"] == "profile" and r["program"] == "train_step"
+    ]
+    assert prof["bytes_accessed"] > 0 and prof["flops"] > 0
+    assert prof["examples"] == cfg.batch_size
+    assert prof["bytes_per_example"] == pytest.approx(
+        prof["bytes_accessed"] / cfg.batch_size, rel=0.01
+    )
+    # measured next to modeled: the evidence column DESIGN §8.5 wants
+    assert prof["modeled_hbm_bytes"] > 0
+
+    ds = [r for r in records if r["kind"] == "datastats"]
+    assert ds, "no datastats records on a sampled run"
+    for r in ds:
+        assert r["ids"] == cfg.batch_size * NNZ
+        assert 0 < r["unique"] <= r["ids"]
+        assert r["dedup_ratio"] == pytest.approx(r["unique"] / r["ids"], abs=1e-3)
+        assert 0 < r["rows_seen"] <= V
+        assert 0.0 < r["hh_topk_mass"] <= 1.0
+    # rows_seen is cumulative — monotone across samples
+    seen = [r["rows_seen"] for r in ds]
+    assert seen == sorted(seen)
+    (summary,) = [r for r in records if r["kind"] == "summary"]
+    assert summary["datastats_samples"] == len(ds)
+    assert summary["profile_train_bytes_per_example"] == prof["bytes_per_example"]
+
+
+def test_device_cache_profile_and_datastats(dataset):
+    """The device-cached path (scan-fused): the cached step closures
+    delegate .lower to the inner jit, so the ledger still measures, and
+    the ids slicer feeds the stats reducer straight off the resident
+    arrays."""
+    cfg = _cfg(
+        dataset, tag="dc", device_cache=True, binary_cache=True,
+        steps_per_call=4, telemetry_datastats_every_steps=2,
+    )
+    train(cfg, log=lambda *_: None)
+    records = _read(cfg.metrics_path)
+    _assert_schema(records)
+    assert _steady(records) == []
+
+    (prof,) = [
+        r for r in records if r["kind"] == "profile" and r["program"] == "train_step"
+    ]
+    assert prof["bytes_accessed"] > 0 and prof["modeled_hbm_bytes"] > 0
+    assert prof["examples"] == cfg.batch_size * cfg.steps_per_call
+
+    ds = [r for r in records if r["kind"] == "datastats"]
+    assert ds
+    # The scan dispatch samples a whole [K·B, N] window of resident ids.
+    assert ds[0]["ids"] == cfg.batch_size * cfg.steps_per_call * NNZ
+
+
+def test_predict_profile_record(dataset):
+    cfg = _cfg(
+        dataset, tag="pr",
+        predict_files=(str(dataset / "train.libsvm"),),
+        score_path=str(dataset / "scores.txt"),
+    )
+    train(cfg, log=lambda *_: None)
+    from fast_tffm_tpu.prediction import predict
+
+    pcfg = _cfg(
+        dataset, tag="pr2",
+        model_file=cfg.model_file,
+        predict_files=(str(dataset / "train.libsvm"),),
+        score_path=str(dataset / "scores.txt"),
+        metrics_path=str(dataset / "m_predict.jsonl"),
+    )
+    predict(pcfg, log=lambda *_: None)
+    records = _read(pcfg.metrics_path)
+    _assert_schema(records)
+    (prof,) = [
+        r
+        for r in records
+        if r["kind"] == "profile" and r["program"] == "predict_step"
+    ]
+    assert prof["bytes_accessed"] > 0 and prof["flops"] > 0
+
+
+# -- trace capture --------------------------------------------------------
+
+
+def test_profile_steps_trace_window(dataset):
+    cfg = _cfg(dataset, tag="tr", telemetry_profile_steps="2:6")
+    train(cfg, log=lambda *_: None)
+    records = _read(cfg.metrics_path)
+    events = [
+        r for r in records if r["kind"] == "profile" and r["program"] == "trace"
+    ]
+    assert [e["event"] for e in events] == ["trace_start", "trace_stop"]
+    assert events[0]["step"] >= 2 and events[1]["step"] >= 6
+    trace_dir = cfg.model_file + ".profile"
+    assert events[0]["trace_dir"] == trace_dir
+    # jax wrote an actual trace under the dir
+    assert os.path.isdir(trace_dir) and any(os.walk(trace_dir))
+    assert _steady(records) == []
+
+
+def test_parse_profile_steps_validation():
+    assert parse_profile_steps("") is None
+    assert parse_profile_steps("2:6") == (2, 6)
+    for bad in ("6", "6:2", "-1:4", "a:b", "3:3"):
+        with pytest.raises(ValueError, match="profile_steps"):
+            parse_profile_steps(bad)
+    with pytest.raises(ValueError, match="profile_steps"):
+        Config(telemetry_profile_steps="9:1").validate()
+
+
+# -- datastats unit behavior ----------------------------------------------
+
+
+def test_modeled_step_bytes_floor_counts_unique_rmw():
+    ids = np.array([[1, 1, 2], [2, 3, 3]], np.int32)  # m=6, uniq=3
+    row_dim, accum_cols = 5, 1
+    total, uniq = modeled_step_bytes(ids, row_dim, accum_cols)
+    assert uniq == 3
+    row = row_dim * 4
+    assert total == 6 * 4 + 4 * 6 * row + 2 * 3 * row + 2 * 3 * accum_cols * 4
+
+
+def test_datastats_collector_skews_toward_heavy_hitters(tmp_path):
+    """A Zipf-skewed stream must show low dedup ratio (few unique rows
+    per batch) and high top-K sketch mass — the two numbers that size
+    ROADMAP item 3's dedup-before-gather and hot-id cache."""
+    from fast_tffm_tpu.telemetry import RunMonitor
+
+    path = str(tmp_path / "ds.jsonl")
+    mon = RunMonitor(path)
+    col = DataStatsCollector(
+        mon, vocab=1 << 14, row_dim=8, every_steps=1, heavy_hitter_k=16
+    )
+    rng = np.random.default_rng(0)
+
+    class P:
+        def __init__(self, ids):
+            self.ids = ids
+
+    zipf = np.minimum(rng.zipf(1.1, size=(8, 256, 16)) - 1, (1 << 14) - 1)
+    uni = rng.integers(0, 1 << 14, size=(8, 256, 16))
+    for i in range(8):
+        col.note(i + 1, parsed=P(zipf[i].astype(np.int32)))
+    zipf_summary = col.summary()
+    col2 = DataStatsCollector(
+        mon, vocab=1 << 14, row_dim=8, every_steps=1, heavy_hitter_k=16
+    )
+    for i in range(8):
+        col2.note(i + 1, parsed=P(uni[i].astype(np.int32)))
+    uni_summary = col2.summary()
+    mon.close()
+    # Skew compresses uniques and concentrates sketch mass.
+    assert zipf_summary["datastats_dedup_ratio"] < uni_summary["datastats_dedup_ratio"]
+    assert zipf_summary["datastats_hh_topk_mass"] > uni_summary["datastats_hh_topk_mass"]
+    records = [r for r in _read(path) if r["kind"] == "datastats"]
+    # note() arms on the first call, then samples every step
+    assert len(records) == 14
+    _assert_schema(records)
+
+
+# -- freshness SLO on a live engine reload --------------------------------
+
+
+def test_freshness_pinned_on_live_engine_reload(tmp_path):
+    """The satellite's e2e pin: a published checkpoint reaches a LIVE
+    engine via the watcher, and the swap emits kind=freshness whose
+    publish→applied and publish→first-scored both measure the real
+    publish→serve pipe (applied <= first-scored, both sane)."""
+    import jax
+
+    from fast_tffm_tpu.checkpoint import read_publish_time, save_checkpoint
+    from fast_tffm_tpu.config import build_model
+    from fast_tffm_tpu.serving.engine import ServingEngine
+    from fast_tffm_tpu.trainer import init_state
+
+    cfg = Config(
+        model="fm",
+        factor_num=4,
+        vocabulary_size=V,
+        max_nnz=NNZ,
+        model_file=str(tmp_path / "m.ckpt"),
+        serve_buckets=(1, 4),
+        serve_flush_deadline_ms=2.0,
+        serve_reload_interval_s=0.05,
+        metrics_path=str(tmp_path / "serve.jsonl"),
+    ).validate()
+    model = build_model(cfg)
+    state = init_state(model, jax.random.key(0), cfg.init_accumulator_value)
+    save_checkpoint(cfg.model_file, state)
+    assert read_publish_time(cfg.model_file) == pytest.approx(time.time(), abs=60)
+
+    line = "0 1:1.0"
+    with ServingEngine(cfg, log=lambda *_: None) as eng:
+        s0 = eng.submit_line(line).result(timeout=20)
+        state = state._replace(table=state.table.at[1].add(0.5), step=state.step + 1)
+        save_checkpoint(cfg.model_file, state)
+        t_pub = time.time()
+        deadline = time.time() + 20
+        s1 = s0
+        while time.time() < deadline and s1 == s0:
+            s1 = eng.submit_line(line).result(timeout=20)
+            time.sleep(0.01)
+        assert s1 != s0, "published checkpoint never reached scoring"
+        snap = eng.metrics_snapshot()
+    records = _read(cfg.metrics_path)
+    _assert_schema(records)
+    (fresh,) = [r for r in records if r["kind"] == "freshness"]
+    assert fresh["publish_step"] == 1
+    assert 0 <= fresh["publish_to_applied_ms"] <= fresh["publish_to_first_scored_ms"]
+    # sane upper bound: within the watcher poll + restore + test slack
+    assert fresh["publish_to_first_scored_ms"] <= (time.time() - t_pub + 25) * 1e3
+    # the snapshot carries the histograms the stats op / report read
+    assert snap["freshness_applied_ms"]["count"] == 1
+    assert snap["freshness_scored_ms"]["count"] == 1
+
+
+def test_read_publish_time_degrades_to_none(tmp_path):
+    from fast_tffm_tpu.checkpoint import read_publish_time
+
+    assert read_publish_time(str(tmp_path / "missing.npz")) is None
+    d = tmp_path / "dir.orbax"
+    d.mkdir()
+    assert read_publish_time(str(d)) is None
+    # pre-PR-9 npz (no published_at member): degrade, never raise
+    np.savez(tmp_path / "old.npz", step=np.int32(3), table=np.zeros((2, 2)))
+    assert read_publish_time(str(tmp_path / "old.npz")) is None
+
+
+# -- report rendering + gates ---------------------------------------------
+
+
+def _load_report_module():
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "report_tool", os.path.join(repo, "tools", "report.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _synth_run(path, *, fresh_p99=50.0, bytes_per_example=100.0, rate=1000.0):
+    from fast_tffm_tpu.telemetry import RunMonitor, new_run_id
+
+    mon = RunMonitor(str(path), run_id=new_run_id())
+    for i in range(1, 6):
+        mon.emit(
+            "train", step=i * 4, epoch=0, loss=0.7 - 0.01 * i,
+            examples_per_sec=rate, examples_per_sec_per_chip=rate,
+        )
+    mon.emit(
+        "profile", step=4, program="train_step", flops=1000,
+        bytes_accessed=int(bytes_per_example * 32), examples=32,
+        bytes_per_example=bytes_per_example, modeled_hbm_bytes=1000,
+    )
+    mon.emit(
+        "datastats", step=4, window_steps=4, ids=256, unique=100,
+        dedup_ratio=0.39, rows_seen=150, rows_seen_frac=0.1, hh_k=16,
+        hh_topk_mass=0.4, gather_bytes=8192, dedup_gather_bytes=3200,
+        projected_gather_savings_frac=0.61,
+    )
+    for ms in (fresh_p99 * 0.5, fresh_p99):
+        mon.emit(
+            "freshness", step=5, publish_step=7,
+            publish_to_applied_ms=ms * 0.9, publish_to_first_scored_ms=ms,
+        )
+    mon.close()
+    return str(path)
+
+
+def test_report_renders_and_gates_observability(tmp_path):
+    import subprocess
+    import sys
+
+    report = _load_report_module()
+    base = _synth_run(tmp_path / "base.jsonl")
+    same = _synth_run(tmp_path / "same.jsonl")
+    stale = _synth_run(tmp_path / "stale.jsonl", fresh_p99=500.0)
+    fat = _synth_run(tmp_path / "fat.jsonl", bytes_per_example=300.0)
+
+    s = report.summarize(report.load_run(base))
+    assert s["measured_bytes_per_example"] == 100.0
+    assert s["freshness_p99_ms"] == 50.0
+    assert s["dedup_ratio_mean"] == 0.39
+    text = report.render(s)
+    for needle in (
+        "Profiling (measured vs modeled)",
+        "Id-traffic statistics",
+        "Freshness (publish",
+        "train_step",
+        "dedup",
+    ):
+        assert needle in text, f"{needle} missing:\n{text}"
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tool = os.path.join(repo, "tools", "report.py")
+
+    def run(*args):
+        return subprocess.run(
+            [sys.executable, tool, *args], capture_output=True, text=True
+        )
+
+    # non-strict: freshness/bytes regressions do not gate
+    assert run(stale, "--compare", base).returncode == 0
+    # strict: each regression gates independently
+    assert run(same, "--compare", base, "--strict").returncode == 0
+    r = run(stale, "--compare", base, "--strict")
+    assert r.returncode == 1 and "freshness p99" in r.stdout
+    r = run(fat, "--compare", base, "--strict")
+    assert r.returncode == 1 and "bytes/example" in r.stdout

@@ -69,6 +69,23 @@ _DRIVER_PAYLOADS = {
     # emits restart records with the measured MTTR (null until a step).
     "fault": dict(event="crash", exit_code=-9, signal=9),
     "restart": dict(attempt=1, exit_code=-9, backoff_s=0.5, mttr_s=2.1),
+    # Deep observability (profiling.py / serving freshness, ISSUE 9).
+    # profile's bytes/flops may be null only on trace event records;
+    # freshness nulls first_scored where the emitter cannot see scoring
+    # (the router's fleet_staged aggregate).
+    "profile": dict(
+        program="train_step", flops=99361, bytes_accessed=646295,
+        examples=32, bytes_per_example=20196.7, modeled_hbm_bytes=33584,
+    ),
+    "datastats": dict(
+        window_steps=3, ids=256, unique=147, dedup_ratio=0.5742,
+        rows_seen=147, hh_k=16, hh_topk_mass=0.23,
+        projected_gather_savings_frac=0.43,
+    ),
+    "freshness": dict(
+        publish_step=12, publish_to_applied_ms=41.2,
+        publish_to_first_scored_ms=44.8, mode="delta",
+    ),
 }
 
 
@@ -127,6 +144,19 @@ def test_anomaly_names_first_nonfinite_tensor(tmp_path):
     (rec,) = [r for r in _read(path) if r["kind"] == "anomaly"]
     assert rec["step"] == 7
     assert "accum" in rec["first_nonfinite"]
+
+
+def test_check_telemetry_conformance():
+    """The satellite tripwire: the static check (no raw MetricsLogger
+    construction / raw kind= logs / unregistered emit kinds anywhere in
+    the package) must pass on the committed tree — schema drift fails
+    tier-1 loudly instead of silently forking the envelope."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "check_telemetry.py")],
+        capture_output=True,
+        text=True,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
 
 
 # -- compile sentinel -----------------------------------------------------

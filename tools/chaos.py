@@ -225,7 +225,7 @@ SERVE_REQUESTS = 600
 SERVE_QPS = 200.0
 
 
-def _serve_cfg(d: str) -> str:
+def _serve_cfg(d: str, run_id: str = "") -> str:
     cfg = os.path.join(d, "serve.cfg")
     with open(cfg, "w") as f:
         f.write(
@@ -239,6 +239,9 @@ model_file = {d}/m.ckpt
 [Train]
 max_nnz = 6
 metrics_path = {d}/serve.jsonl
+
+[Telemetry]
+run_id = {run_id}
 
 [Serving]
 buckets = 1 8 64
@@ -335,8 +338,13 @@ def _serve_chaos(args) -> int:
         print("chaos: --serve-plan has no serving faults", file=sys.stderr)
         return 1
     lines = _serve_lines(SERVE_REQUESTS, args.seed)
+    from fast_tffm_tpu.telemetry import artifact_stamp
+
     result: dict = {
         "probe": "SERVE_CHAOS",
+        # Envelope join keys (run_id + schema_version): this probe is
+        # joinable to the telemetry JSONL its serve tier wrote.
+        **artifact_stamp(),
         "seed": args.seed,
         "plan": json.loads(plan.to_json()),
         "replicas": SERVE_REPLICAS,
@@ -344,7 +352,9 @@ def _serve_chaos(args) -> int:
         "qps": SERVE_QPS,
     }
     with tempfile.TemporaryDirectory(prefix="chaos-serve-") as d:
-        cfg_path = _serve_cfg(d)
+        # The tier adopts the probe's run_id (written into [Telemetry]),
+        # so the stamp above genuinely joins this JSON to its JSONL.
+        cfg_path = _serve_cfg(d, run_id=result["run_id"])
         model_file = os.path.join(d, "m.ckpt")
         corrupt_bytes = _serve_checkpoint(model_file)
         with open(model_file, "rb") as f:
@@ -554,7 +564,13 @@ def main(argv=None) -> int:
         if pod
         else ["train"] + (["dist_train"] if args.sharded else [])
     )
+    from fast_tffm_tpu.telemetry import artifact_stamp
+
     result: dict = {
+        # Envelope identity keys: the chaos trials' JSONL lives (and dies)
+        # in per-trial tempdirs, so this stamp names the probe invocation;
+        # the serve probe's tier ADOPTS its run_id (see _serve_chaos).
+        **artifact_stamp(),
         "steps_total": STEPS,
         "delta_every_steps": DELTA_EVERY,
         "seed": args.seed,

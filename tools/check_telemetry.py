@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Static telemetry-envelope conformance check (ISSUE 9 satellite).
+
+The envelope only means something if EVERY record flows through
+``telemetry.RunMonitor`` and a kind registered in ``telemetry.SCHEMAS``.
+RunMonitor.emit raises on unknown kinds at runtime — but only on code
+paths a test actually drives; a new module quietly constructing its own
+``MetricsLogger`` (or calling ``.log(kind=...)`` raw) forks the schema
+without tripping anything.  This script makes that drift a LOUD tier-1
+failure instead (tests/test_telemetry.py runs it):
+
+  1. ``MetricsLogger(`` may only be constructed inside the telemetry
+     layer itself (telemetry.py owns it; utils/tracing.py defines it).
+  2. Raw ``.log(kind=...)`` emits may only appear in the documented
+     duck-type fallback (serving/metrics.py's log_to, for bare
+     MetricsLogger sinks) and in tracing.py itself.
+  3. Every string-literal kind passed to ``.emit("<kind>", ...)`` in the
+     package must be registered in SCHEMAS — an emit of an unregistered
+     kind would raise at runtime, but only on the code path a test
+     happens to drive; here it fails statically.
+
+Exit 0 = conformant; exit 1 prints every violation with file:line.
+Stdlib + the (jax-free) telemetry module only.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "fast_tffm_tpu")
+
+# Files allowed to CONSTRUCT a MetricsLogger (the envelope layer itself).
+ALLOW_LOGGER_CONSTRUCTION = {
+    "telemetry.py",  # RunMonitor owns the logger
+    "utils/tracing.py",  # defines MetricsLogger
+}
+
+# Files allowed a raw ``.log(kind=...)`` call.
+ALLOW_RAW_KIND_LOG = {
+    "utils/tracing.py",  # the logger's own implementation/tests surface
+    "serving/metrics.py",  # documented duck-type fallback: log_to() accepts
+    #   a bare MetricsLogger for envelope-less callers (tools/tests); every
+    #   in-tree engine passes a RunMonitor, which takes the emit() path
+}
+
+_RE_LOGGER = re.compile(r"\bMetricsLogger[ \t]*\(")  # same-line call only —
+#   a prose mention followed by a parenthetical on the next line is not a
+#   construction
+_RE_RAW_KIND = re.compile(r"\.log\s*\(\s*kind\s*=")
+_RE_EMIT_KIND = re.compile(r"\.emit\s*\(\s*\n?\s*[\"']([a-z_]+)[\"']")
+
+
+def _line_of(text: str, pos: int) -> int:
+    return text.count("\n", 0, pos) + 1
+
+
+def check(pkg_dir: str = PKG) -> list[str]:
+    sys.path.insert(0, REPO)
+    from fast_tffm_tpu.telemetry import SCHEMAS  # jax-free import
+
+    problems: list[str] = []
+    for root, _dirs, files in os.walk(pkg_dir):
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, pkg_dir).replace(os.sep, "/")
+            with open(path) as f:
+                text = f.read()
+            for m in _RE_LOGGER.finditer(text):
+                # Imports/annotations are fine; construction is the fork.
+                if rel not in ALLOW_LOGGER_CONSTRUCTION:
+                    problems.append(
+                        f"{rel}:{_line_of(text, m.start())}: MetricsLogger "
+                        "constructed outside the telemetry layer — emit "
+                        "through a RunMonitor (telemetry.py) so the record "
+                        "carries the envelope"
+                    )
+            for m in _RE_RAW_KIND.finditer(text):
+                if rel not in ALLOW_RAW_KIND_LOG:
+                    problems.append(
+                        f"{rel}:{_line_of(text, m.start())}: raw .log(kind=...) "
+                        "bypasses RunMonitor.emit — the record gets no "
+                        "envelope and no schema check"
+                    )
+            for m in _RE_EMIT_KIND.finditer(text):
+                kind = m.group(1)
+                if kind not in SCHEMAS:
+                    problems.append(
+                        f"{rel}:{_line_of(text, m.start())}: emit of "
+                        f"unregistered kind {kind!r} — register it (and its "
+                        "required keys) in telemetry.SCHEMAS"
+                    )
+    return problems
+
+
+def main(argv=None) -> int:
+    problems = check()
+    if problems:
+        print(f"check_telemetry: {len(problems)} violation(s):")
+        for p in problems:
+            print(f"  {p}")
+        return 1
+    print("check_telemetry: OK — every emitter rides the RunMonitor envelope")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

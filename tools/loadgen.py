@@ -368,6 +368,119 @@ def run_closed_socket(port, host, lines, args, mix, res: Results):
         t.join()
 
 
+def run_freshness_probe(args, cfg, log) -> int:
+    """Tagged-probe freshness SLO, measured BLACK-BOX through the socket
+    front end (ISSUE 9): per trial, score a sentinel id, atomically
+    publish a checkpoint whose sentinel row changed, then poll the
+    sentinel through the wire until its score flips.  flip-time − publish
+    -time IS publish→first-scored-with-new-rows as a client experiences
+    it — router reload poll, restore, collector swap, and micro-batch
+    flush all included.  The server-side kind=freshness records (engine +
+    router) measure the same pipe white-box; the probe JSON carries both,
+    stamped with the tier's run_id so it joins the telemetry streams."""
+    import jax
+
+    from fast_tffm_tpu.checkpoint import restore_checkpoint, save_checkpoint
+    from fast_tffm_tpu.config import build_model
+    from fast_tffm_tpu.telemetry import artifact_stamp
+    from fast_tffm_tpu.trainer import init_state
+
+    if cfg.serve_reload_interval_s <= 0:
+        print(
+            "probe-freshness: [Serving] reload_interval_s must be > 0 "
+            "(the router's checkpoint watcher drives the reload fan-out)",
+            file=sys.stderr,
+        )
+        return 2
+    model = build_model(cfg)
+    state = init_state(
+        model, jax.random.key(args.seed), cfg.init_accumulator_value,
+        cfg.adagrad_accumulator,
+    )
+    if os.path.exists(cfg.model_file.rstrip("/")):
+        state = restore_checkpoint(cfg.model_file, state)
+    else:
+        save_checkpoint(cfg.model_file, state)
+        log(f"probe-freshness: wrote fresh checkpoint {cfg.model_file}")
+    sentinel = 1  # any in-vocab id works; the probe only needs its row
+    line = f"0 {sentinel}:1.0"
+    proc, port = spawn_serve(args.config, log=log)
+    conn = ServeConnection(port)
+    flips_ms: list[float] = []
+    unanswered = 0
+    try:
+        for trial in range(args.probe_freshness):
+            s0 = float(conn.request({"line": line}, timeout=30)["score"])
+            # Perturb the sentinel row (bias + factors) and publish — the
+            # atomic tmp+rename the trainer's saves use, so the tier sees
+            # exactly a production publish.
+            state = state._replace(
+                table=state.table.at[sentinel].add(0.25),
+                step=state.step + 1,
+            )
+            save_checkpoint(cfg.model_file, state)
+            t_pub = time.time()
+            deadline = t_pub + 30.0
+            flipped = None
+            while time.time() < deadline:
+                s1 = float(conn.request({"line": line}, timeout=30)["score"])
+                if abs(s1 - s0) > 1e-9:
+                    flipped = (time.time() - t_pub) * 1e3
+                    break
+                time.sleep(0.002)
+            if flipped is None:
+                unanswered += 1
+                log(f"probe-freshness: trial {trial} never flipped (30s)")
+            else:
+                flips_ms.append(flipped)
+                log(f"probe-freshness: trial {trial} flipped in {flipped:.1f} ms")
+        stats = conn.request({"op": "stats"}, timeout=60)
+    finally:
+        conn.close()
+        proc.terminate()
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+    engines = stats.get("engines", {})
+    steady = [
+        e.get("steady_compiles")
+        for e in engines.values()
+        if isinstance(e.get("steady_compiles"), int)
+    ]
+    result = {
+        "probe": "PROBE_FRESHNESS",
+        **artifact_stamp(stats.get("run_id", "")),
+        "trials": args.probe_freshness,
+        "unanswered": unanswered,
+        "replicas": cfg.serve_replicas,
+        "reload_interval_s": cfg.serve_reload_interval_s,
+        "publish_to_first_scored_ms": percentiles_ms([x / 1e3 for x in flips_ms]),
+        "engine_freshness_scored_ms": {
+            k: (e.get("engine") or {}).get("freshness_scored_ms")
+            for k, e in sorted(engines.items())
+        },
+        "engine_freshness_applied_ms": {
+            k: (e.get("engine") or {}).get("freshness_applied_ms")
+            for k, e in sorted(engines.items())
+        },
+        "fleet_freshness": stats.get("freshness"),
+        "steady_state_recompiles": max(steady) if steady else None,
+        "note": (
+            "black-box SLO: sentinel scored through the 2-connection wire; "
+            "flip latency includes router reload poll + restore + swap + "
+            "flush.  engine_* histograms are the white-box twin measured "
+            "against the checkpoint's embedded publish stamp."
+        ),
+    }
+    out = json.dumps(result, indent=2)
+    print(out)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(out + "\n")
+    return 0 if flips_ms and not unanswered else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("config", help="INI config (uses [Serving] + model_file)")
@@ -407,7 +520,37 @@ def main(argv=None) -> int:
         action="store_true",
         help="write a fresh random checkpoint when model_file is absent",
     )
+    ap.add_argument(
+        "--stats",
+        action="store_true",
+        help="poll the live tier's `stats` admin op once (router + "
+        "per-replica counters + fleet freshness) and print it as ONE JSON "
+        "line — the operator path that needs no JSONL tailing.  Requires "
+        "--connect or --spawn",
+    )
+    ap.add_argument(
+        "--probe-freshness",
+        type=int,
+        default=0,
+        metavar="TRIALS",
+        help="tagged-probe freshness mode: per trial, score a sentinel id, "
+        "publish a checkpoint whose sentinel row changed, and poll the "
+        "sentinel's score through the front end until it flips — the "
+        "black-box publish→first-scored-with-new-rows SLO.  Emits a "
+        "PROBE_FRESHNESS JSON (use --out).  Requires --spawn (the probe "
+        "must own model_file to publish)",
+    )
     args = ap.parse_args(argv)
+    if args.stats and not (args.connect or args.spawn):
+        ap.error("--stats requires --connect or --spawn (a live front end)")
+    if args.probe_freshness:
+        if not args.spawn:
+            ap.error(
+                "--probe-freshness requires --spawn (the probe publishes "
+                "checkpoints into model_file, so it must own the tier)"
+            )
+        if args.probe_freshness < 2:
+            ap.error("--probe-freshness needs >= 2 trials for percentiles")
     if args.mode == "open" and args.qps <= 0:
         ap.error("--qps must be > 0 in open mode (it is the Poisson arrival rate)")
     if args.mode == "closed" and args.concurrency < 1:
@@ -438,6 +581,38 @@ def main(argv=None) -> int:
         )
         print(f"loadgen: wrote fresh checkpoint {cfg.model_file}", file=sys.stderr)
 
+    log = lambda *a: print(*a, file=sys.stderr)
+
+    if args.stats:
+        # One-shot operator poll: the `stats` admin op over the CONTROL
+        # path of a live tier, printed as ONE JSON line — router counters,
+        # per-replica engine snapshots, fleet freshness percentiles.
+        proc = None
+        if args.spawn:
+            proc, port = spawn_serve(args.config, log=log)
+            host = "127.0.0.1"
+        else:
+            host, _, port = args.connect.rpartition(":")
+            host, port = host or "127.0.0.1", int(port)
+        try:
+            c = ServeConnection(port, host=host)
+            try:
+                stats = c.request({"op": "stats"}, timeout=60)
+            finally:
+                c.close()
+            print(json.dumps(stats, separators=(",", ":")))
+        finally:
+            if proc is not None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+        return 0
+
+    if args.probe_freshness:
+        return run_freshness_probe(args, cfg, log)
+
     if args.input:
         lines = [l.strip() for l in open(args.input) if l.strip()]
     elif cfg.predict_files:
@@ -449,7 +624,6 @@ def main(argv=None) -> int:
         lines = synth_lines(cfg, 4096, width, args.seed)
         print(f"loadgen: synthesized {len(lines)} request lines", file=sys.stderr)
 
-    log = lambda *a: print(*a, file=sys.stderr)
     res = Results()
     result: dict = {
         "bench": "BENCH_SERVE",
@@ -502,6 +676,14 @@ def main(argv=None) -> int:
                 for e in engines.values()
                 if isinstance(e.get("steady_compiles"), int)
             ]
+            from fast_tffm_tpu.telemetry import artifact_stamp
+
+            result.update(
+                # Join keys: the tier's run_id + envelope schema version —
+                # this artifact is joinable to the replicas' JSONL streams.
+                **artifact_stamp(stats.get("run_id", "")),
+                freshness=stats.get("freshness"),
+            )
             result.update(
                 transport="socket",
                 connections=args.connections if args.mode == "open" else None,
@@ -554,8 +736,12 @@ def main(argv=None) -> int:
         wall = time.perf_counter() - t0
         end = engine.compile_count()
         snap = engine.metrics_snapshot()
+        run_id = engine.run_id
         engine.close()
+        from fast_tffm_tpu.telemetry import artifact_stamp
+
         result.update(
+            **artifact_stamp(run_id),
             transport="inprocess",
             warmup_s=round(warmup_s, 3),
             buckets=list(engine.buckets),

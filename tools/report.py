@@ -324,6 +324,82 @@ def summarize(records: list[dict]) -> dict:
                 ),
             }
 
+    # Deep observability (ISSUE 9).  profile: one record per measured
+    # compiled program (XLA cost analysis) — the MEASURED column DESIGN
+    # §8.5's "re-measure only with evidence" reads next to the modeled
+    # HBM floor; datastats: sampled id-traffic statistics; freshness:
+    # publish→applied / publish→first-scored SLO samples.
+    s["profiled_programs"] = {}
+    for r in kinds.get("profile", []):
+        if r.get("program") and r.get("program") != "trace":
+            s["profiled_programs"][r["program"]] = {
+                "bytes_accessed": r.get("bytes_accessed"),
+                "flops": r.get("flops"),
+                "examples": r.get("examples"),
+                "bytes_per_example": r.get("bytes_per_example"),
+                "modeled_hbm_bytes": r.get("modeled_hbm_bytes"),
+            }
+    s["trace_events"] = [
+        {"step": r.get("step"), "event": r.get("event"), "trace_dir": r.get("trace_dir")}
+        for r in kinds.get("profile", [])
+        if r.get("program") == "trace"
+    ]
+    t = s["profiled_programs"].get("train_step") or {}
+    s["measured_bytes_per_example"] = t.get("bytes_per_example")
+
+    ds = kinds.get("datastats", [])
+    s["datastats_samples"] = len(ds)
+    dedups = [r["dedup_ratio"] for r in ds if isinstance(r.get("dedup_ratio"), (int, float))]
+    s["dedup_ratio_mean"] = round(sum(dedups) / len(dedups), 4) if dedups else None
+    s["datastats_last"] = (
+        {
+            k: ds[-1].get(k)
+            for k in (
+                "ids", "unique", "dedup_ratio", "rows_seen", "rows_seen_frac",
+                "hh_k", "hh_topk_mass", "gather_bytes", "dedup_gather_bytes",
+                "projected_gather_savings_frac",
+            )
+        }
+        if ds
+        else None
+    )
+
+    def _pctl(vals, q):
+        # Nearest-rank over the (small, per-run) record lists; stdlib-only
+        # like everything in this tool.
+        if not vals:
+            return None
+        vals = sorted(vals)
+        return round(vals[min(len(vals) - 1, int(q * len(vals)))], 3)
+
+    fresh = kinds.get("freshness", [])
+    applied = [
+        r["publish_to_applied_ms"]
+        for r in fresh
+        if isinstance(r.get("publish_to_applied_ms"), (int, float))
+    ]
+    scored = [
+        r["publish_to_first_scored_ms"]
+        for r in fresh
+        if isinstance(r.get("publish_to_first_scored_ms"), (int, float))
+    ]
+    s["freshness_samples"] = len(fresh)
+    s["freshness_applied_p50_ms"] = _pctl(applied, 0.50)
+    s["freshness_applied_p99_ms"] = _pctl(applied, 0.99)
+    s["freshness_scored_p50_ms"] = _pctl(scored, 0.50)
+    s["freshness_scored_p99_ms"] = _pctl(scored, 0.99)
+    # The gate metric: end-to-end (first-scored) p99 where measured, the
+    # applied p99 otherwise (router-only streams see staging, not
+    # scoring).  `is None`, not truthiness: a clamped-to-0 scored p99
+    # (publisher clock ahead) is still the scored metric, and silently
+    # swapping to applied would gate two different metrics against each
+    # other in --compare.
+    s["freshness_p99_ms"] = (
+        s["freshness_scored_p99_ms"]
+        if s["freshness_scored_p99_ms"] is not None
+        else s["freshness_applied_p99_ms"]
+    )
+
     mems = kinds.get("mem", [])
     s["host_rss_peak_bytes"] = max(
         (r["host_rss_peak_bytes"] for r in mems if r.get("host_rss_peak_bytes")),
@@ -542,6 +618,81 @@ def render(s: dict, title: str = "run") -> str:
         if s.get("host_faults"):
             L.append(f"- host-level faults: {s['host_faults']}")
         L.append("")
+    if s.get("profiled_programs") or s.get("trace_events"):
+        L += ["## Profiling (measured vs modeled)", ""]
+        if s["profiled_programs"]:
+            L.append(
+                "| program | measured bytes/dispatch | modeled HBM floor | "
+                "× floor | bytes/example | MFLOPs |"
+            )
+            L.append("|---|---:|---:|---:|---:|---:|")
+            for name, p in sorted(s["profiled_programs"].items()):
+                meas, mod = p.get("bytes_accessed"), p.get("modeled_hbm_bytes")
+                ratio = (
+                    f"{meas / mod:.2f}"
+                    if isinstance(meas, (int, float))
+                    and isinstance(mod, (int, float))
+                    and mod > 0
+                    else "–"
+                )
+                fl = p.get("flops")
+                L.append(
+                    f"| {name} | {_fmt_bytes(meas)} | {_fmt_bytes(mod)} | "
+                    f"{ratio} | {_fmt(p.get('bytes_per_example'), 1)} | "
+                    f"{_fmt(round(fl / 1e6, 2) if isinstance(fl, (int, float)) else None)} |"
+                )
+            L.append(
+                "- measured = XLA cost analysis (bytes accessed) of the "
+                "compiled program; modeled = the driver's irreducible-HBM "
+                "floor for the same dispatch (DESIGN §8.5: re-measure only "
+                "with evidence — this is the evidence column)"
+            )
+        for e in s.get("trace_events", []):
+            L.append(
+                f"- trace {e['event']} at step {e['step']} → `{e['trace_dir']}`"
+            )
+        L.append("")
+    if s.get("datastats_samples"):
+        d = s["datastats_last"] or {}
+        L += ["## Id-traffic statistics", ""]
+        L.append(
+            f"- {s['datastats_samples']} sampled windows; dedup ratio "
+            f"(unique/slots) mean {_fmt(s['dedup_ratio_mean'], 4)}, "
+            f"last {_fmt(d.get('dedup_ratio'), 4)}"
+        )
+        L.append(
+            f"- last window: {_fmt(d.get('ids'))} id slots, "
+            f"{_fmt(d.get('unique'))} unique; rows seen (cumulative) "
+            f"{_fmt(d.get('rows_seen'))} ({_fmt(d.get('rows_seen_frac'), 4)} of vocab)"
+        )
+        if d.get("hh_topk_mass") is not None:
+            L.append(
+                f"- heavy hitters: top-{d.get('hh_k')} sketch buckets carry "
+                f"{100 * d['hh_topk_mass']:.1f}% of gather traffic (upper "
+                "bound — collisions overstate)"
+            )
+        if d.get("projected_gather_savings_frac") is not None:
+            L.append(
+                f"- projected dedup-before-gather saving: "
+                f"{100 * d['projected_gather_savings_frac']:.1f}% of gather bytes "
+                f"({_fmt_bytes(d.get('gather_bytes'))} → "
+                f"{_fmt_bytes(d.get('dedup_gather_bytes'))} per dispatch)"
+            )
+        L.append("")
+    if s.get("freshness_samples"):
+        L += ["## Freshness (publish → serving)", ""]
+        L.append(
+            f"- {s['freshness_samples']} reload(s): publish→applied p50/p99 "
+            f"{_fmt(s['freshness_applied_p50_ms'])}/"
+            f"{_fmt(s['freshness_applied_p99_ms'])} ms"
+        )
+        if s.get("freshness_scored_p50_ms") is not None:
+            L.append(
+                f"- publish→first-scored-with-new-rows p50/p99 "
+                f"{_fmt(s['freshness_scored_p50_ms'])}/"
+                f"{_fmt(s['freshness_scored_p99_ms'])} ms"
+            )
+        L.append("")
     L += ["## Memory", ""]
     L.append(f"- host RSS peak: {_fmt_bytes(s['host_rss_peak_bytes'])}")
     L.append(f"- device live-buffer peak: {_fmt_bytes(s['device_peak_bytes'])}")
@@ -641,6 +792,9 @@ _GATE_METRICS = [
     ("host_rss_peak_bytes", "host RSS peak", False),
     ("device_peak_bytes", "device mem peak", False),
     ("ckpt_stall_share", "ckpt stall share", False),
+    ("measured_bytes_per_example", "measured HBM bytes/example", False),
+    ("dedup_ratio_mean", "id dedup ratio (unique/slots)", False),
+    ("freshness_p99_ms", "freshness p99 (ms)", False),
 ]
 
 
@@ -714,6 +868,41 @@ def compare(run: dict, base: dict, threshold: float, strict: bool = False):
                     f"serving class {k!r} p99 regressed "
                     f"{(rp - bp) / bp * 100:.1f}% (> {threshold * 100:.0f}%): "
                     f"{bp}ms -> {rp}ms"
+                )
+        # The ISSUE-9 SLO gates: a freshness p99 regression (the model is
+        # measurably staler at the replicas) and a measured-bytes-per-
+        # example regression (the compiled step moves more HBM per row
+        # than the base did — the evidence ledger as an enforced budget).
+        # Freshness gates FLAVOR-MATCHED: scored-vs-scored when both runs
+        # measured end-to-end, else applied-vs-applied — a run that only
+        # saw staging must never be gated against one that saw scoring
+        # (applied <= scored by construction, so a mixed pair would mask
+        # a real regression or invent a spurious one).
+        if (
+            run.get("freshness_scored_p99_ms") is not None
+            and base.get("freshness_scored_p99_ms") is not None
+        ):
+            fresh_gate = (
+                "freshness_scored_p99_ms", "freshness p99 (publish→first-scored)",
+            )
+        else:
+            fresh_gate = (
+                "freshness_applied_p99_ms", "freshness p99 (publish→applied)",
+            )
+        for key, label, floor in (
+            (*fresh_gate, 1.0),
+            ("measured_bytes_per_example", "measured HBM bytes/example", 0.0),
+        ):
+            rv, bv = run.get(key), base.get(key)
+            if (
+                isinstance(rv, (int, float))
+                and isinstance(bv, (int, float))
+                and bv > floor
+                and rv > bv * (1 + threshold)
+            ):
+                regressions.append(
+                    f"{label} regressed {(rv - bv) / bv * 100:.1f}% "
+                    f"(> {threshold * 100:.0f}%): {bv} -> {rv}"
                 )
         # Checkpoint stall share regression: the run spends a meaningfully
         # larger fraction of wall clock blocked on saves than the base did.
@@ -819,7 +1008,8 @@ def main(argv=None) -> int:
         "--strict",
         action="store_true",
         help="also fail on NEW steady-state compiles / stalls / anomalies / "
-        "faults / restarts / rollbacks",
+        "faults / restarts / rollbacks, and on freshness-p99 or "
+        "measured-bytes-per-example regressions past --threshold",
     )
     ap.add_argument("--out", metavar="PATH", help="write the report here instead of stdout")
     args = ap.parse_args(argv)
