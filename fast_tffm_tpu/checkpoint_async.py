@@ -154,6 +154,8 @@ class AsyncCheckpointer:
         async_save: bool = False,
         delta_every_steps: int = 0,
         delta_chain_max: int = 16,
+        full_every_s: float = 0.0,
+        chain_max_bytes: int = 0,
         vocab: int = 0,
         table_layout: str = "rows",
         row_dim: int = 0,
@@ -171,6 +173,22 @@ class AsyncCheckpointer:
         self._async = bool(async_save) and fmt == "npz"
         self._delta_every = int(delta_every_steps) if fmt == "npz" else 0
         self._chain_max = max(1, int(delta_chain_max))
+        # Age/size-based chain compaction ([Checkpoint] full_every_s /
+        # chain_max_bytes): an hours-long online run (delta_every_steps
+        # publishing continuously) must not grow unbounded disk — a full
+        # save unlinks the whole chain, so promoting a delta boundary once
+        # the chain is OLD or FAT bounds both restore-replay length and
+        # on-disk footprint.  Single-writer-pod runs ignore the knobs: the
+        # promote decision selects which COLLECTIVE every host dispatches,
+        # and a wall-clock threshold read on each host independently could
+        # disagree near the boundary (step-count promotion stays exact).
+        self._full_every_s = float(full_every_s)
+        self._chain_bytes_max = int(chain_max_bytes)
+        if runtime is not None and getattr(runtime, "active", False):
+            self._full_every_s = 0.0
+            self._chain_bytes_max = 0
+        self._last_full_t = time.monotonic()
+        self._chain_bytes = 0
         self._vocab = int(vocab)
         self._lock = threading.Lock()
         self._thread: threading.Thread | None = None
@@ -231,6 +249,15 @@ class AsyncCheckpointer:
                     self._parent_sig = chain[-1]["save_id"]
                     self._next_seq = len(chain) + 1
                     self._chain_len = len(chain)
+                    # Size-based compaction must count the RESUMED chain's
+                    # existing files, not start from zero.
+                    import os as _os
+
+                    self._chain_bytes = sum(
+                        _os.path.getsize(m["path"])
+                        for m in chain
+                        if _os.path.isfile(m.get("path", ""))
+                    )
                 else:
                     self._parent_sig = base_sig
         # Counters (ride the kind=summary record via summary()).
@@ -453,7 +480,18 @@ class AsyncCheckpointer:
         t0 = time.perf_counter()
         self._drain(count=True)
         self._await_pending(count=True)
-        if self._parent_sig is None or self._chain_len >= self._chain_max:
+        if (
+            self._parent_sig is None
+            or self._chain_len >= self._chain_max
+            or (
+                self._full_every_s > 0
+                and time.monotonic() - self._last_full_t >= self._full_every_s
+            )
+            or (
+                self._chain_bytes_max > 0
+                and self._chain_bytes >= self._chain_bytes_max
+            )
+        ):
             return self.save_boundary(state, saveable, step)
         import jax.numpy as jnp
 
@@ -611,6 +649,7 @@ class AsyncCheckpointer:
                 self._parent_sig = sid
                 self._next_seq = seq + 1
                 self._chain_len += 1
+                self._chain_bytes += int(nbytes)
             self._publish_outcome(bseq, sid, "delta")
             self.delta_saves += 1
             timings["d2h_ms"] = timings.get("d2h_ms", 0.0) + d2h_ms
@@ -633,6 +672,8 @@ class AsyncCheckpointer:
             self._parent_sig = sid
             self._next_seq = 1
             self._chain_len = 0
+            self._chain_bytes = 0
+            self._last_full_t = time.monotonic()
 
     def _on_write_failed(self) -> None:
         """A failed write DROPPED its window's rows (the boundary already

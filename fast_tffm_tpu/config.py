@@ -47,6 +47,15 @@ class Config:
     #   chained to the base; restore replays base+chain; 0 = off
     delta_chain_max: int = 16  # deltas per chain before the next boundary
     #   promotes itself to a full save (bounds restore replay length)
+    delta_full_every_s: float = 0.0  # [Checkpoint] full_every_s: AGE-based
+    #   chain compaction — a delta boundary promotes itself to a full save
+    #   once this many seconds passed since the last full publish, so an
+    #   hours-long online run compacts (full saves unlink old deltas) even
+    #   when the chain count stays under delta_chain_max (0 = off)
+    delta_chain_max_bytes: int = 0  # [Checkpoint] chain_max_bytes: SIZE-based
+    #   chain compaction — promote to full once the current chain's delta
+    #   files total this many bytes (0 = off); together with full_every_s
+    #   this bounds the delta chain's disk footprint for unbounded runs
     checkpoint_chunk_mb: int = 64  # save/restore host-staging bound: arrays
     #   stream D2H/disk in this many MB per slice (never 2x table on host)
     # [Train]
@@ -167,6 +176,24 @@ class Config:
     #   client class -> tier ("gold:2,std:1"); under overload the queue
     #   sheds strictly-lower tiers first (oldest of the lowest present),
     #   so degradation follows priority.  Unknown/absent class = tier 0
+    # [Online] — online learning from an append-only event stream
+    online_follow: bool = False  # tail-follow the FMS train stream: at EOF
+    #   the reader polls for growth instead of ending the epoch
+    #   (data/stream.py; train only, one FMS train file, epoch_num = 1)
+    online_poll_s: float = 0.2  # bounded EOF poll interval (seconds)
+    online_idle_timeout_s: float = 0.0  # >0: end the stream after this much
+    #   continuous writer silence (bounded tools/tests); 0 = follow until
+    #   the process is stopped (SIGTERM checkpoints + exits as usual)
+    online_max_batches: int = 0  # >0: end the stream once the TOTAL emitted
+    #   batch index reaches N (resume-skipped batches count — the
+    #   pad_to_batches convention, so --resume composes); 0 = unbounded
+    online_adagrad_decay: float = 1.0  # γ: touched-row accumulator decay
+    #   (accum = γ·accum + g²) so old gradient history can't freeze the
+    #   step size on a moving distribution; 1.0 = classic Adagrad,
+    #   bit-identical program; γ < 1 requires table_layout = rows
+    online_accum_restart_steps: int = 0  # window-restart alternative to
+    #   decay: every N steps (K-aligned) reset EVERY accumulator to
+    #   init_accumulator_value; 0 = off; exclusive with adagrad_decay < 1
     # [Resilience] — crash recovery + fault handling (resilience.py)
     on_nan: str = "abort"  # non-finite loss policy: abort (raise before the
     #   next save overwrites good state — the historical behavior) |
@@ -348,6 +375,77 @@ class Config:
                 f"{self.serve_deadline_ms}"
             )
         self.serve_classes = validate_classes(self.serve_classes)
+        if self.online_poll_s <= 0:
+            raise ValueError(f"[Online] poll_s must be > 0, got {self.online_poll_s}")
+        if self.online_idle_timeout_s < 0 or self.online_max_batches < 0:
+            raise ValueError(
+                "[Online] idle_timeout_s and max_batches must be >= 0 (0 = off)"
+            )
+        if not (0.0 < self.online_adagrad_decay <= 1.0):
+            raise ValueError(
+                f"[Online] adagrad_decay must be in (0, 1], got "
+                f"{self.online_adagrad_decay}"
+            )
+        if self.online_adagrad_decay != 1.0 and self.table_layout != "rows":
+            # The packed tile-row RMWs rely on the zero-grad accumulator
+            # identity (untouched logical rows sharing a tile row must not
+            # change); a lane-blind decay would break it silently.
+            raise ValueError(
+                "[Online] adagrad_decay < 1 requires table_layout = rows"
+            )
+        if self.online_accum_restart_steps < 0:
+            raise ValueError(
+                f"[Online] accum_restart_steps must be >= 0, got "
+                f"{self.online_accum_restart_steps}"
+            )
+        if self.online_accum_restart_steps > 0 and self.adagrad_accumulator == "fused":
+            # The fused layout stores the accumulator inside the table's
+            # own tile rows — there is no separate array to reset.
+            raise ValueError(
+                "[Online] accum_restart_steps requires adagrad_accumulator "
+                "= element or row (the fused layout has no separate "
+                "accumulator array to reset)"
+            )
+        if self.online_accum_restart_steps > 0 and self.delta_every_steps > 0:
+            # The reset rewrites EVERY accumulator row, but delta saves
+            # ship only the touched-row window — a crash-resume would
+            # replay PRE-reset accumulators for every untouched row,
+            # silently breaking the exact-position-resume invariant.
+            raise ValueError(
+                "[Online] accum_restart_steps cannot combine with "
+                "delta_every_steps: a global accumulator reset is not "
+                "representable in a touched-row delta (resume would "
+                "restore stale accumulators) — use full saves, or "
+                "adagrad_decay"
+            )
+        if self.online_accum_restart_steps > 0 and self.online_adagrad_decay != 1.0:
+            # Two competing forgetting mechanisms make every A/B reading
+            # ambiguous — pick one per run.
+            raise ValueError(
+                "[Online] adagrad_decay < 1 and accum_restart_steps > 0 are "
+                "exclusive — choose one forgetting mechanism"
+            )
+        if self.online_follow:
+            if self.shuffle:
+                raise ValueError(
+                    "[Online] follow = true cannot shuffle: an append-only "
+                    "stream has no fixed row count to permute"
+                )
+            if self.device_cache:
+                raise ValueError(
+                    "[Online] follow = true is a streamed input mode — "
+                    "device_cache loads a FIXED dataset to HBM once"
+                )
+            if self.epoch_num != 1:
+                raise ValueError(
+                    "[Online] follow = true runs ONE endless epoch — set "
+                    f"epoch_num = 1 (got {self.epoch_num})"
+                )
+        if self.delta_full_every_s < 0 or self.delta_chain_max_bytes < 0:
+            raise ValueError(
+                "[Checkpoint] full_every_s and chain_max_bytes must be >= 0 "
+                "(0 = off)"
+            )
         if self.on_nan not in ("abort", "rollback"):
             raise ValueError(f"unknown on_nan {self.on_nan!r} (abort | rollback)")
         if self.max_rollbacks < 0:
@@ -597,6 +695,10 @@ def load_config(path: str) -> Config:
     cfg.async_save = get(c, "async_save", ini._convert_to_boolean, cfg.async_save)
     cfg.delta_every_steps = get(c, "delta_every_steps", int, cfg.delta_every_steps)
     cfg.delta_chain_max = get(c, "delta_chain_max", int, cfg.delta_chain_max)
+    cfg.delta_full_every_s = get(c, "full_every_s", float, cfg.delta_full_every_s)
+    cfg.delta_chain_max_bytes = get(
+        c, "chain_max_bytes", int, cfg.delta_chain_max_bytes
+    )
     cfg.checkpoint_chunk_mb = get(c, "chunk_mb", int, cfg.checkpoint_chunk_mb)
 
     p = "Predict"
@@ -626,6 +728,20 @@ def load_config(path: str) -> Config:
     cfg.serve_replicas = get(s, "replicas", int, cfg.serve_replicas)
     cfg.serve_deadline_ms = get(s, "deadline_ms", float, cfg.serve_deadline_ms)
     cfg.serve_classes = get(s, "classes", str, cfg.serve_classes)
+
+    o = "Online"
+    cfg.online_follow = get(o, "follow", ini._convert_to_boolean, cfg.online_follow)
+    cfg.online_poll_s = get(o, "poll_s", float, cfg.online_poll_s)
+    cfg.online_idle_timeout_s = get(
+        o, "idle_timeout_s", float, cfg.online_idle_timeout_s
+    )
+    cfg.online_max_batches = get(o, "max_batches", int, cfg.online_max_batches)
+    cfg.online_adagrad_decay = get(
+        o, "adagrad_decay", float, cfg.online_adagrad_decay
+    )
+    cfg.online_accum_restart_steps = get(
+        o, "accum_restart_steps", int, cfg.online_accum_restart_steps
+    )
 
     r = "Resilience"
     cfg.on_nan = get(r, "on_nan", str, cfg.on_nan).lower()

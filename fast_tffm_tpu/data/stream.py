@@ -1,0 +1,493 @@
+"""FMS append-only stream container + the tail-following reader.
+
+The online-learning input shape (`[Online] follow = true`): production CTR
+events arrive continuously, and the trainer that serves traffic must
+follow them.  FMB (data/binary.py) cannot be that file — its columnar
+sections are sized by ``n_rows`` at write time, so appending one row would
+shift every later section.  FMS is the row-major sibling: one 64-byte
+header, then fixed-size row RECORDS appended forever::
+
+    header  64 B   magic 'FMS1', version, width, vocabulary_size, hashed
+    record         label f32 | nnz i32 | ids i32[W] | vals f32[W]
+                   | fields i32[W]          (8 + 12·W bytes, little-endian)
+
+Append = write one record's bytes + flush; the row count is derived from
+the FILE SIZE, so a reader never needs a header rewrite to see new rows.
+A partial trailing record (a writer crash, or a slow append caught
+mid-write — the ``append_torn`` chaos fault) simply doesn't count toward
+``(size - 64) // record_bytes`` and is re-examined on the next poll: the
+reader waits it out and NEVER parses half a record.
+
+``fms_follow_stream`` is the tail-following batch reader: at EOF it polls
+the file size at a bounded interval instead of ending the epoch, marks
+itself idle (the telemetry stall watchdog classifies a starved loop as
+``input-starved (stream-idle)``), and resumes cleanly when bytes land.
+It only ever emits FULL batches — every emitted batch consumed exactly
+``batch_size`` rows, which is what keeps the exact-position resume cursor
+(PR 6) a pure multiplication; leftover rows below one batch stay in the
+file for the next poll (or the next resumed process).
+
+Identity for resume is PREFIX-based, not size-based: an append-only file
+GROWS between save and resume by design, so the PR-6 size fingerprint
+would always mismatch.  ``stream_prefix_fingerprint`` hashes the header
+plus the first 64 KiB of records — immutable under append — and a resume
+against a file whose prefix changed (replaced, truncated, rewritten)
+fails LOUDLY instead of silently misaligning the cursor.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import struct
+import time
+from collections.abc import Sequence
+
+import numpy as np
+
+from fast_tffm_tpu.data.libsvm import ParsedBatch
+
+__all__ = [
+    "FMS_MAGIC",
+    "FMS_VERSION",
+    "FMS_HEADER_BYTES",
+    "is_fms",
+    "fms_record_bytes",
+    "read_fms_header",
+    "fms_row_count",
+    "StreamWriter",
+    "read_fms_rows",
+    "fms_follow_stream",
+    "stream_prefix_fingerprint",
+    "stream_prefix_matches",
+]
+
+FMS_MAGIC = b"FMS1"
+FMS_VERSION = 1
+FMS_HEADER_BYTES = 64
+# magic, version, width, vocabulary_size, hashed, flags (reserved 0)
+_HEADER = struct.Struct("<4sIqqBB")
+assert _HEADER.size <= FMS_HEADER_BYTES
+_PREFIX_HASH_BYTES = 64 << 10  # immutable-under-append identity window
+
+
+def fms_record_bytes(width: int) -> int:
+    """label f32 + nnz i32 + (ids + vals + fields) i32/f32[width]."""
+    return 8 + 12 * int(width)
+
+
+def is_fms(path) -> bool:
+    """True when ``path`` starts with the FMS magic (missing file → False)."""
+    try:
+        with open(path, "rb") as f:
+            return f.read(4) == FMS_MAGIC
+    except OSError:
+        return False
+
+
+def read_fms_header(path) -> dict:
+    with open(path, "rb") as f:
+        raw = f.read(FMS_HEADER_BYTES)
+    if len(raw) < FMS_HEADER_BYTES:
+        raise ValueError(f"{path}: truncated FMS header")
+    magic, version, width, vocab, hashed, _flags = _HEADER.unpack(
+        raw[: _HEADER.size]
+    )
+    if magic != FMS_MAGIC:
+        raise ValueError(f"{path}: not an FMS stream file")
+    if version != FMS_VERSION:
+        raise ValueError(f"{path}: unsupported FMS version {version}")
+    if width < 1:
+        raise ValueError(f"{path}: bad FMS width {width}")
+    return {
+        "path": os.fspath(path),
+        "width": int(width),
+        "vocabulary_size": int(vocab),
+        "hashed": bool(hashed),
+        "record_bytes": fms_record_bytes(width),
+    }
+
+
+def fms_row_count(path, width: int) -> int:
+    """COMPLETE records currently in the file.  A partial trailing record
+    (torn append) does not count — floor division is the wait-it-out."""
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return 0
+    return max(0, (size - FMS_HEADER_BYTES)) // fms_record_bytes(width)
+
+
+class StreamWriter:
+    """Append-side of the FMS contract (tools/soak.py, tools/backtest.py,
+    tests).  Creates the file with its header if absent; ``append``
+    writes whole records + flush, so a reader polling the size only ever
+    sees complete rows — except through ``append_torn``, the deliberate
+    chaos hook that leaves a partial trailing record on disk (flushed!)
+    until ``complete_torn`` lands the remainder, which is exactly the
+    window the follow reader must wait out, never parse."""
+
+    def __init__(
+        self,
+        path,
+        *,
+        width: int,
+        vocabulary_size: int,
+        hash_feature_id: bool = False,
+    ):
+        self.path = os.fspath(path)
+        self.width = int(width)
+        self.vocabulary_size = int(vocabulary_size)
+        self.record_bytes = fms_record_bytes(self.width)
+        self.appends = 0  # append ordinal (the append_torn@K counter)
+        self._torn_rest: bytes | None = None
+        if os.path.exists(self.path):
+            hdr = read_fms_header(self.path)
+            if hdr["width"] != self.width or hdr["vocabulary_size"] != self.vocabulary_size:
+                raise ValueError(
+                    f"{self.path}: existing stream has width={hdr['width']} "
+                    f"vocab={hdr['vocabulary_size']}, writer wants "
+                    f"{self.width}/{self.vocabulary_size}"
+                )
+            self._f = open(self.path, "ab")
+        else:
+            self._f = open(self.path, "wb")
+            hdr = _HEADER.pack(
+                FMS_MAGIC, FMS_VERSION, self.width, self.vocabulary_size,
+                1 if hash_feature_id else 0, 0,
+            )
+            self._f.write(hdr + b"\0" * (FMS_HEADER_BYTES - len(hdr)))
+            self._f.flush()
+
+    def _encode(self, labels, ids, vals, fields, nnz) -> bytes:
+        n = len(labels)
+        w = self.width
+        rec = np.zeros((n, self.record_bytes), np.uint8)
+        rec[:, 0:4] = np.asarray(labels, "<f4").reshape(n, 1).view(np.uint8)
+        nnz = np.asarray(nnz, "<i4")
+        if nnz.size and (int(nnz.max()) > w or int(nnz.min()) < 0):
+            raise ValueError(
+                f"stream append: nnz out of [0, {w}] (got max {int(nnz.max())})"
+            )
+        id_arr = np.asarray(ids)
+        if id_arr.size and (
+            int(id_arr.max()) >= self.vocabulary_size or int(id_arr.min()) < 0
+        ):
+            # Same loud range rule as the text parsers: a clamped gather
+            # downstream would train the wrong embedding row silently.
+            raise ValueError(
+                f"stream append: id out of [0, {self.vocabulary_size}) "
+                f"(got max {int(id_arr.max())}, min {int(id_arr.min())})"
+            )
+        rec[:, 4:8] = nnz.reshape(n, 1).view(np.uint8)
+
+        def put(col, arr, dtype):
+            a = np.zeros((n, w), dtype)
+            src = np.asarray(arr, dtype)
+            cw = min(w, src.shape[1]) if src.ndim == 2 else 0
+            if cw:
+                a[:, :cw] = src[:, :cw]
+            rec[:, col : col + 4 * w] = a.view(np.uint8).reshape(n, 4 * w)
+
+        put(8, ids, "<i4")
+        put(8 + 4 * w, vals, "<f4")
+        put(8 + 8 * w, fields if fields is not None else np.zeros((n, w)), "<i4")
+        return rec.tobytes()
+
+    def append(self, labels, ids, vals, fields=None, nnz=None) -> int:
+        """Append ``n`` whole rows; returns the append ordinal (1-based).
+        A pending torn record (``append_torn``) is completed FIRST —
+        appending into the middle of a partial record would misalign
+        every later record in the file."""
+        self.complete_torn()
+        if nnz is None:
+            nnz = (np.asarray(vals) != 0).sum(axis=1)
+        self._f.write(self._encode(labels, ids, vals, fields, nnz))
+        self._f.flush()
+        self.appends += 1
+        return self.appends
+
+    def append_torn(self, labels, ids, vals, fields=None, nnz=None) -> int:
+        """Chaos hook (``append_torn@K``): write only the FIRST HALF of
+        the final record's bytes and flush — a torn trailing record a
+        reader must never parse.  ``complete_torn`` lands the rest.  A
+        PREVIOUS pending torn record is completed first (same alignment
+        rule as ``append`` — dropping its remainder would misalign
+        every later record)."""
+        self.complete_torn()
+        if nnz is None:
+            nnz = (np.asarray(vals) != 0).sum(axis=1)
+        blob = self._encode(labels, ids, vals, fields, nnz)
+        cut = len(blob) - self.record_bytes // 2
+        self._f.write(blob[:cut])
+        self._f.flush()
+        self._torn_rest = blob[cut:]
+        self.appends += 1
+        return self.appends
+
+    def complete_torn(self) -> None:
+        if self._torn_rest is not None:
+            self._f.write(self._torn_rest)
+            self._f.flush()
+            self._torn_rest = None
+
+    def close(self) -> None:
+        self.complete_torn()
+        try:
+            self._f.close()
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def read_fms_rows(path, start: int, count: int, *, header: dict | None = None):
+    """Decode ``count`` complete records starting at row ``start`` into
+    (labels, nnz, ids, vals, fields) host arrays.  The caller is
+    responsible for ``start + count`` being within ``fms_row_count`` —
+    this is a plain positional read, no tailing."""
+    hdr = header or read_fms_header(path)
+    w, rb = hdr["width"], hdr["record_bytes"]
+    with open(path, "rb") as f:
+        f.seek(FMS_HEADER_BYTES + start * rb)
+        raw = f.read(count * rb)
+    if len(raw) < count * rb:
+        raise ValueError(
+            f"{path}: short read at row {start} (+{count}) — writer "
+            "truncated an append-only stream?"
+        )
+    rec = np.frombuffer(raw, np.uint8).reshape(count, rb)
+    labels = rec[:, 0:4].copy().view("<f4").reshape(count)
+    nnz = rec[:, 4:8].copy().view("<i4").reshape(count)
+    ids = rec[:, 8 : 8 + 4 * w].copy().view("<i4").reshape(count, w)
+    vals = rec[:, 8 + 4 * w : 8 + 8 * w].copy().view("<f4").reshape(count, w)
+    fields = rec[:, 8 + 8 * w : 8 + 12 * w].copy().view("<i4").reshape(count, w)
+    if count and (int(nnz.max()) > w or int(nnz.min()) < 0):
+        # A complete-size record with an insane nnz is CORRUPTION, not a
+        # torn tail (floor division already excluded partial records) —
+        # fail loudly naming the row rather than train on garbage.
+        bad = int(np.argmax((nnz > w) | (nnz < 0)))
+        raise ValueError(
+            f"{path}: corrupt stream record at row {start + bad} "
+            f"(nnz {int(nnz[bad])} outside [0, {w}])"
+        )
+    vocab = hdr["vocabulary_size"]
+    if count and (int(ids.max()) >= vocab or int(ids.min()) < 0):
+        # Same rule the text parsers enforce: an out-of-range id would
+        # silently clamp in the jitted gather and train the wrong row.
+        bad = int(np.argmax(((ids >= vocab) | (ids < 0)).any(axis=1)))
+        raise ValueError(
+            f"{path}: corrupt stream record at row {start + bad} "
+            f"(feature id outside [0, {vocab}))"
+        )
+    return labels, nnz, ids, vals, fields
+
+
+def stream_prefix_fingerprint(files: Sequence[str]) -> str:
+    """Append-stable input identity for the follow-mode resume cursor.
+
+    Per file: ``<bytes-hashed>:<md5-prefix>`` over the header plus the
+    first (up to 64 KiB of) record bytes AT FINGERPRINT TIME.  The
+    hashed length rides inside the fingerprint because an append-only
+    file GROWS: a later verification must re-hash exactly the same
+    prefix window, not "the first 64 KiB of whatever is there now" —
+    ``stream_prefix_matches`` is that verifier.  The PR-6 size
+    fingerprint cannot serve here (growth is the normal case), but a
+    REPLACED, rewritten, or truncated file still fails the prefix
+    re-hash, and training._resolve_cursor fails loudly on it instead of
+    resuming at a meaningless offset."""
+    parts = []
+    for p in files:
+        try:
+            with open(os.fspath(p), "rb") as f:
+                blob = f.read(FMS_HEADER_BYTES + _PREFIX_HASH_BYTES)
+        except OSError:
+            blob = b""
+        parts.append(f"{len(blob)}:{hashlib.md5(blob).hexdigest()[:16]}")
+    return "fms1," + ",".join(parts)
+
+
+def stream_prefix_matches(files: Sequence[str], fingerprint: str) -> bool:
+    """Verify a ``stream_prefix_fingerprint`` against the CURRENT files:
+    re-hash exactly the recorded prefix window of each.  False for a
+    malformed/foreign fingerprint, a changed file count, a file now
+    SHORTER than the recorded window (truncated — append-only files
+    never shrink), or any hash mismatch."""
+    if not isinstance(fingerprint, str) or not fingerprint.startswith("fms1,"):
+        return False
+    entries = fingerprint[len("fms1,") :].split(",")
+    if len(entries) != len(files):
+        return False
+    for p, ent in zip(files, entries):
+        n_s, sep, want = ent.partition(":")
+        if not sep:
+            return False
+        try:
+            n = int(n_s)
+        except ValueError:
+            return False
+        try:
+            with open(os.fspath(p), "rb") as f:
+                blob = f.read(n)
+        except OSError:
+            return False
+        if len(blob) != n or hashlib.md5(blob).hexdigest()[:16] != want:
+            return False
+    return True
+
+
+def fms_follow_stream(
+    path,
+    *,
+    batch_size: int,
+    vocabulary_size: int,
+    hash_feature_id: bool = False,
+    max_nnz: int | None = None,
+    poll_s: float = 0.2,
+    idle_timeout_s: float = 0.0,
+    max_batches: int = 0,
+    skip_batches: int = 0,
+    weight: float = 1.0,
+    stop=None,
+    idle_flag=None,
+):
+    """Tail-follow ``path``, yielding ``(ParsedBatch, weights)`` full
+    batches forever (or until bounded).
+
+    Contract (the online-learning input mode):
+
+    * Only FULL batches are emitted — batch k consumed rows
+      ``[k·B, (k+1)·B)`` exactly, so the resume cursor's batch count maps
+      to a byte offset by pure multiplication.  Rows below one batch stay
+      in the file for the next poll (or the next resumed process).
+    * At EOF the reader POLLS the file size every ``poll_s`` seconds
+      instead of ending the epoch; ``idle_flag.set()/.clear()`` (any
+      object with those methods) tracks the idle state so the telemetry
+      watchdog can classify a starved train loop as
+      ``input-starved (stream-idle)``.
+    * A partial trailing record never parses (floor division of the size).
+    * The file's IDENTITY is re-verified while following: the prefix
+      fingerprint captured at open is re-hashed on every transition into
+      idle and every few hundred batches, and a size that ever drops
+      below the consumed offset fails immediately — a stream REPLACED,
+      rewritten, or truncated mid-run (log rotation, an operator
+      re-seeding the file) raises loudly instead of being silently
+      consumed at a now-meaningless byte offset (the live twin of the
+      resume-time ``stream_prefix_matches`` check).
+    * ``skip_batches`` reopens mid-stream at that batch offset — the
+      exact-position resume seek, O(1) (one file seek).
+    * Bounds, for tools and tests: ``max_batches`` > 0 ends the stream
+      once the TOTAL emitted batch index (skip included — the
+      pad_to_batches convention) reaches it; ``idle_timeout_s`` > 0 ends
+      it after that much continuous idleness; ``stop`` (an Event-like
+      with ``is_set``) ends it at the next poll.  0/None = follow until
+      the process is told to stop.
+    """
+    hdr = read_fms_header(path)
+    if hdr["hashed"] != bool(hash_feature_id):
+        raise ValueError(
+            f"{path}: stream written with hash_feature_id={hdr['hashed']}, "
+            f"requested {bool(hash_feature_id)}"
+        )
+    if hdr["hashed"] and hdr["vocabulary_size"] != vocabulary_size:
+        raise ValueError(
+            f"{path}: stream hashed into vocabulary_size="
+            f"{hdr['vocabulary_size']}, requested {vocabulary_size}"
+        )
+    if not hdr["hashed"] and hdr["vocabulary_size"] > vocabulary_size:
+        raise ValueError(
+            f"{path}: stream ids validated against vocabulary_size="
+            f"{hdr['vocabulary_size']} > requested {vocabulary_size}"
+        )
+    fw = hdr["width"]
+    width = int(max_nnz) if max_nnz else fw
+    cw = min(fw, width)
+    if skip_batches < 0:
+        raise ValueError(f"skip_batches must be >= 0, got {skip_batches}")
+    poll_s = max(0.01, float(poll_s))
+    fingerprint = stream_prefix_fingerprint([path])
+
+    def check_identity():
+        if not stream_prefix_matches([path], fingerprint):
+            raise ValueError(
+                f"{path}: stream PREFIX changed while following (file "
+                "replaced/rewritten mid-run?) — the current byte offset "
+                "no longer names the data it was advanced over"
+            )
+
+    emitted = skip_batches  # skipped batches COUNT (pad_to_batches rule)
+    pos = skip_batches * batch_size
+    idle_since = None
+    since_check = 0
+    while True:
+        if max_batches and emitted >= max_batches:
+            return
+        avail = fms_row_count(path, fw)
+        if avail < pos:
+            # Append-only files never shrink: the consumed offset now
+            # points past the end — truncated or replaced underneath us.
+            raise ValueError(
+                f"{path}: stream shrank below the consumed offset "
+                f"({avail} rows < position {pos}) — truncated/replaced "
+                "mid-run; append-only streams never shrink"
+            )
+        if avail - pos >= batch_size:
+            if stop is not None and stop.is_set():
+                # Checked on the data path too: an abandoned stream with
+                # backlog must stop producing, not just stop polling.
+                return
+            since_check += 1
+            if since_check >= 512:
+                # Cheap periodic identity re-hash even while data flows
+                # (a same-or-larger replacement never hits the EOF path).
+                since_check = 0
+                check_identity()
+            if idle_flag is not None and idle_since is not None:
+                idle_flag.clear()
+            idle_since = None
+            labels, nnz, ids, vals, fields = read_fms_rows(
+                path, pos, batch_size, header=hdr
+            )
+            if cw < fw and int(nnz.max(initial=0)) > cw:
+                raise ValueError(
+                    f"{path}: stream rows up to {int(nnz.max())} features "
+                    f"> max_nnz={width}"
+                )
+            out_ids = np.zeros((batch_size, width), np.int32)
+            out_vals = np.zeros((batch_size, width), np.float32)
+            out_flds = np.zeros((batch_size, width), np.int32)
+            out_ids[:, :cw] = ids[:, :cw]
+            out_vals[:, :cw] = vals[:, :cw]
+            out_flds[:, :cw] = fields[:, :cw]
+            w = np.full((batch_size,), float(weight), np.float32)
+            pos += batch_size
+            emitted += 1
+            yield (
+                ParsedBatch(
+                    labels.astype(np.float32, copy=False),
+                    out_ids,
+                    out_vals,
+                    out_flds,
+                    nnz.astype(np.int32, copy=False),
+                ),
+                w,
+            )
+            continue
+        # EOF (or a torn trailing record): poll for growth.
+        if stop is not None and stop.is_set():
+            return
+        now = time.monotonic()
+        if idle_since is None:
+            idle_since = now
+            if idle_flag is not None:
+                idle_flag.set()
+            # Entering idle: re-verify the file is still the one we have
+            # been consuming (the cheap moment — no data is flowing).
+            check_identity()
+        elif idle_timeout_s > 0 and now - idle_since >= idle_timeout_s:
+            return
+        time.sleep(poll_s)

@@ -391,6 +391,7 @@ class InputStats:
         self._lock = threading.Lock()
         self._queue = None  # bound by prefetch(); live-depth probe
         self._producer = None  # bound by prefetch(); liveness probe
+        self._stream_idle = None  # bound by follow streams; idle probe
         self.last_depth = None  # most recent consumer-pop sample
         self._reset()
 
@@ -405,6 +406,17 @@ class InputStats:
         watchdog can distinguish 'input-starved because the producer is
         slow' from 'input-starved because the producer is DEAD'."""
         self._producer = thread
+
+    def bind_stream_idle(self, event) -> None:
+        """Follow-mode streams (data/stream.py) hand over their idle
+        Event so a starved loop can classify as
+        'input-starved (stream-idle)': producer alive, upstream writer
+        quiet — wait, don't restart."""
+        self._stream_idle = event
+
+    def stream_idle(self) -> bool | None:
+        e = self._stream_idle
+        return e.is_set() if e is not None else None
 
     def producer_alive(self) -> bool | None:
         t = self._producer

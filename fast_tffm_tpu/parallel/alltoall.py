@@ -174,6 +174,7 @@ def routed_update(
     packed_mode: str | None = None,
     fused: bool = False,
     compact_cap: int = 0,
+    decay: float = 1.0,
 ):
     """Sparse Adagrad update via routed gradients (the all-to-all analog of
     ``embedding.sharded_sparse_adagrad_update``).
@@ -271,7 +272,7 @@ def routed_update(
         from fast_tffm_tpu.parallel.embedding import apply_shard_adagrad
 
         table_shard, accum_shard = apply_shard_adagrad(
-            table_shard, accum_shard, guids, ggsum, lr, base
+            table_shard, accum_shard, guids, ggsum, lr, base, decay=decay
         )
     overflow = lax.psum(overflow.astype(jnp.int32), (DATA_AXIS, ROW_AXIS)) > 0
     return table_shard, accum_shard, overflow

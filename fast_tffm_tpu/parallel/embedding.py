@@ -58,20 +58,27 @@ def owned_local_ids(global_ids, shard_logical_rows: int, sentinel: int):
     return jnp.where(owned, local, sentinel), owned
 
 
-def apply_shard_adagrad(table_shard, accum_shard, guids, ggsum, lr, base):
+def apply_shard_adagrad(table_shard, accum_shard, guids, ggsum, lr, base, decay=1.0):
     """Adagrad on this shard's rows from globally-combined unique grads.
 
     The one place the sharded Adagrad math lives — the all-gather update
     below and the all-to-all routed update (parallel/alltoall.py) must
     stay numerically identical, and both end here.  ``guids`` out of this
-    shard's range (other shards' rows, dedup sentinels) drop."""
+    shard's range (other shards' rows, dedup sentinels) drop.
+
+    ``decay`` γ < 1 is the lazy touched-row accumulator decay
+    (``[Online] adagrad_decay`` — optim.sparse_adagrad_update's sharded
+    twin); γ=1.0 is a trace-time branch to the exact classic program."""
     from fast_tffm_tpu.optim import accum_sq
 
     shard_rows = table_shard.shape[0]
     local = guids - base
     owned = (local >= 0) & (local < shard_rows)
     local = jnp.where(owned, local, shard_rows)  # out of range → mode='drop'
-    acc_rows = accum_shard[jnp.minimum(local, shard_rows - 1)] + accum_sq(accum_shard, ggsum)
+    acc_prev = accum_shard[jnp.minimum(local, shard_rows - 1)]
+    if decay != 1.0:
+        acc_prev = decay * acc_prev
+    acc_rows = acc_prev + accum_sq(accum_shard, ggsum)
     upd_rows = table_shard[jnp.minimum(local, shard_rows - 1)] - lr * ggsum / jnp.sqrt(acc_rows)
     accum_shard = accum_shard.at[local].set(acc_rows, mode="drop")
     table_shard = table_shard.at[local].set(upd_rows, mode="drop")
@@ -120,6 +127,7 @@ def sharded_sparse_adagrad_update(
     row_grads: jax.Array,
     lr: float,
     num_rows_global: int,
+    decay: float = 1.0,
 ):
     """Sparse Adagrad on the local row shard from global per-occurrence grads.
 
@@ -137,7 +145,9 @@ def sharded_sparse_adagrad_update(
         guids, ggsum = dedup_rows(
             ids.reshape(-1), row_grads.reshape(-1, D), num_rows_global
         )
-        return apply_shard_adagrad(table_shard, accum_shard, guids, ggsum, lr, 0)
+        return apply_shard_adagrad(
+            table_shard, accum_shard, guids, ggsum, lr, 0, decay=decay
+        )
     uids, gsum = dedup_rows(ids.reshape(-1), row_grads.reshape(-1, D), num_rows_global)
     all_uids = lax.all_gather(uids, (DATA_AXIS, ROW_AXIS), tiled=True)  # [P*M]
     all_gsum = lax.all_gather(gsum, (DATA_AXIS, ROW_AXIS), tiled=True)  # [P*M, D]
@@ -146,7 +156,9 @@ def sharded_sparse_adagrad_update(
     guids, ggsum = dedup_rows(all_uids, all_gsum, num_rows_global)
 
     base = lax.axis_index(ROW_AXIS) * table_shard.shape[0]
-    return apply_shard_adagrad(table_shard, accum_shard, guids, ggsum, lr, base)
+    return apply_shard_adagrad(
+        table_shard, accum_shard, guids, ggsum, lr, base, decay=decay
+    )
 
 
 # --- lane-packed shard variants (ops/packed_table.py; DESIGN §6) ---------

@@ -560,7 +560,7 @@ def make_sharded_train_step(
     capacity_factor: float = 2.0, overflow_mode: str = "abort",
     table_layout: str = "rows", packed_update: str = "auto",
     accumulator: str = "element", compact_cap: int = 0,
-    steps_per_call: int = 1,
+    steps_per_call: int = 1, adagrad_decay: float = 1.0,
 ):
     """Returns jitted SPMD ``step(state, batch) -> (state, global mean loss)``.
 
@@ -615,6 +615,16 @@ def make_sharded_train_step(
         raise ValueError(f"unknown overflow_mode {overflow_mode!r} (abort | fallback)")
     fallback = lookup == "alltoall" and overflow_mode == "fallback"
     packed_meta = (d_row, shard_logical_rows) if packed else None
+    # [Online] adagrad_decay: touched-row accumulator decay, rows layout
+    # only (config.validate enforces the restriction — the packed tile-row
+    # RMWs rely on the zero-grad identity a lane-blind decay would break).
+    # γ=1.0 is a trace-time no-op, so the default program is unchanged.
+    decay = float(adagrad_decay)
+    if decay != 1.0 and (packed or fused):
+        raise ValueError(
+            "adagrad_decay != 1.0 requires table_layout = rows (the packed "
+            "tile-row updates rely on the zero-grad accumulator identity)"
+        )
 
     def shard_body(table, accum, dense, dense_acc, batch: Batch):
         # Built per trace: the capacity is sized from THIS trace's batch
@@ -686,7 +696,8 @@ def make_sharded_train_step(
             rows = sharded_gather(table, batch.ids)
             (_, dl), (g_rows, g_dense) = grad_fn(rows, dense)
             t2, a2 = sharded_sparse_adagrad_update(
-                table, accum, batch.ids, g_rows, learning_rate, num_rows_global
+                table, accum, batch.ids, g_rows, learning_rate,
+                num_rows_global, decay=decay,
             )
             return t2, a2, g_dense, dl
 
@@ -720,7 +731,7 @@ def make_sharded_train_step(
                 else:
                     t2, a2, overflow = routed_update(
                         table, accum, batch.ids, g_rows, learning_rate,
-                        num_rows_global, cap,
+                        num_rows_global, cap, decay=decay,
                     )
                 if not fallback:
                     # A dropped contribution must never persist silently:
@@ -749,7 +760,8 @@ def make_sharded_train_step(
         if jax.tree.leaves(dense):
             g_dense = lax.psum(g_dense, _BOTH)
             dense, dense_acc = dense_adagrad_update(
-                dense, AdagradState(dense_acc), g_dense, learning_rate
+                dense, AdagradState(dense_acc), g_dense, learning_rate,
+                decay=decay,
             )
             dense_acc = dense_acc.accum
         data_loss = lax.psum(data_loss_local, _BOTH)

@@ -53,6 +53,7 @@ import time
 __all__ = [
     "FAULT_KINDS",
     "SERVING_FAULT_KINDS",
+    "STREAM_FAULT_KINDS",
     "FaultPlan",
     "RestartPolicy",
     "FaultInjector",
@@ -142,6 +143,8 @@ FAULT_KINDS = (
     "replica_kill",
     "replica_slow",
     "reload_corrupt",
+    "stream_stall",
+    "append_torn",
 )
 
 # Which ordinal each kind's ``@N`` counts (documented here, enforced by
@@ -158,6 +161,15 @@ FAULT_KINDS = (
 # reload_corrupt@N = corrupt the checkpoint file so the watcher fan-out's
 # Nth reload wave fails (replicas must keep serving the loaded state).
 SERVING_FAULT_KINDS = ("replica_kill", "replica_slow", "reload_corrupt")
+
+# STREAM kinds (ISSUE 11; executed by the soak harness's stream WRITER —
+# tools/soak.py / data/stream.py's StreamWriter — not by the in-process
+# FaultInjector): stream_stall@N = the writer pauses N SECONDS mid-run
+# (the trainer's follow reader must go idle, classify the starved loop
+# as input-starved (stream-idle), and resume cleanly when bytes land);
+# append_torn@K = the Kth append leaves a PARTIAL trailing record on
+# disk for a while (the reader must wait it out, never parse it).
+STREAM_FAULT_KINDS = ("stream_stall", "append_torn")
 
 
 class FaultPlan:
@@ -228,11 +240,16 @@ class FaultPlan:
                             e["until"] = rng.randrange(50, 501)
                         events.append(e)
                         continue
-                    # Per-write/publish ordinals are small numbers; step
-                    # ordinals span the horizon.
+                    if kind == "stream_stall":
+                        # ``at`` is a pause in SECONDS — keep seeded
+                        # schedules short enough for bounded soak runs.
+                        events.append({"kind": kind, "at": rng.randrange(1, 6)})
+                        continue
+                    # Per-write/publish/append ordinals are small numbers;
+                    # step ordinals span the horizon.
                     hi = (
                         max(2, horizon // 50)
-                        if kind in ("torn_delta", "kill_publish")
+                        if kind in ("torn_delta", "kill_publish", "append_torn")
                         else max(2, horizon)
                     )
                     events.append({"kind": kind, "at": rng.randrange(1, hi)})
@@ -293,6 +310,12 @@ class FaultPlan:
         schedule order — tools/chaos.py --serve executes these against a
         live front end; the in-process FaultInjector ignores them."""
         return [e for e in self.events if e["kind"] in SERVING_FAULT_KINDS]
+
+    def stream_events(self) -> list[dict]:
+        """The stream-writer faults (stream_stall, append_torn) in
+        schedule order — executed by the soak harness's event writer
+        (tools/soak.py); the in-process FaultInjector ignores them."""
+        return [e for e in self.events if e["kind"] in STREAM_FAULT_KINDS]
 
 
 class FaultInjector:

@@ -197,6 +197,17 @@ class ServingEngine:
         # here; the collector SWAPS it in between flushes.  One lock, two
         # one-line critical sections.
         self._reload_lock = threading.Lock()
+        # Reload ticks SERIALIZE on this engine-level lock: under
+        # continuous publish, a delta landing while a tick is mid-apply of
+        # its PARENT can trigger a second reload_once from another thread
+        # (a router reconnect's fresh control connection, a poll tick
+        # racing a router command) — two concurrent ticks would both pass
+        # the staged-state check and race _applied_deltas/_loaded_sig,
+        # applying the chain out of order.  A blocking lock makes the
+        # second caller QUEUE: it re-reads the (advanced) signature after
+        # the first apply completes, so deltas apply strictly in chain
+        # order (test-pinned under concurrent publish).
+        self._tick_lock = threading.Lock()
         self._staged_state = None
         self._staged_step = None
         self._staged_is_delta = False
@@ -776,22 +787,29 @@ class ServingEngine:
         and by ``reload_once`` (a router fanning out ONE reload command
         to every replica).  Returns the outcome for the caller's ack:
         ``noop`` | ``staged`` | ``staged_delta`` | ``failed`` |
-        ``backoff`` | ``busy``."""
-        with self._reload_lock:
-            if self._staged_state is not None:
-                # The collector hasn't swapped the previous stage yet;
-                # applying deltas onto _state now would drop that stage.
-                return "busy"
-        sig = checkpoint_signature(self._cfg.model_file)
-        if sig is None or sig == self._loaded_sig:
-            return "noop"
-        if sig == self._fail_sig:
-            if self._gave_up or time.monotonic() < self._next_retry_t:
-                return "backoff"  # backing off / abandoned until a new write
-        else:
-            self._fail_sig, self._fail_count, self._gave_up = None, 0, False
-        with self._monitor.warmup_window():
-            return self._reload_attempt(sig)
+        ``backoff`` | ``busy``.
+
+        Whole-tick serialization (``_tick_lock``): a second caller landing
+        while a tick is mid-apply BLOCKS until that apply completes, then
+        observes the advanced chain state — a delta published while the
+        watcher is mid-apply of its parent QUEUES behind it instead of
+        racing the bookkeeping (apply-in-order under continuous publish)."""
+        with self._tick_lock:
+            with self._reload_lock:
+                if self._staged_state is not None:
+                    # The collector hasn't swapped the previous stage yet;
+                    # applying deltas onto _state now would drop that stage.
+                    return "busy"
+            sig = checkpoint_signature(self._cfg.model_file)
+            if sig is None or sig == self._loaded_sig:
+                return "noop"
+            if sig == self._fail_sig:
+                if self._gave_up or time.monotonic() < self._next_retry_t:
+                    return "backoff"  # backing off / abandoned until a new write
+            else:
+                self._fail_sig, self._fail_count, self._gave_up = None, 0, False
+            with self._monitor.warmup_window():
+                return self._reload_attempt(sig)
 
     def _reload_attempt(self, sig) -> str:
         """The actual restore/apply work of one reload tick.  Runs inside

@@ -154,6 +154,14 @@ SCHEMAS: dict[str, tuple[str, ...]] = {
         "publish_to_applied_ms",
         "publish_to_first_scored_ms",
     ),
+    # Online-learning loop (ISSUE 11).  quality: one record per replayed
+    # backtest hour — the online trainer's held-out AUC next to the
+    # batch-retrain reference's on the same hour (tools/backtest.py;
+    # report.py --compare --strict gates on the gap).  soak: one record
+    # per soak-harness sentinel tick — phase names the check window, ok
+    # is the conjunction of that tick's sentinels (tools/soak.py).
+    "quality": ("hour", "auc_online", "auc_batch"),
+    "soak": ("phase", "elapsed_s", "ok"),
     "summary": ("total_compiles", "steady_compiles", "stalls", "anomalies"),
 }
 
@@ -413,18 +421,25 @@ def compiling_now(stacks: dict[str, str]) -> bool:
 
 
 def classify_stall(
-    queue_depth: int | None, stacks: dict[str, str], producer_alive=None
+    queue_depth: int | None, stacks: dict[str, str], producer_alive=None,
+    stream_idle=None,
 ) -> str:
     """input-starved: the prefetch queue is empty, so the producer (parse
     / disk / conversion) is what everyone is waiting on — and when the
     producer THREAD is known dead, the classification says so (a dead
     producer is a fault to restart from, not a slow parse to wait out).
+    ``stream_idle`` True (a tail-following input stream polling a quiet
+    append-only file — data/stream.py) is the third flavor: the producer
+    is alive and healthy, the UPSTREAM WRITER is what stopped — wait (or
+    page whoever owns the event feed), don't restart.
     device-bound: data is ready (or there is no input queue) and a thread
     is inside the device runtime — the dispatch/compile/transfer is
     what's wedged."""
     if queue_depth == 0:
         if producer_alive is False:
             return "input-starved (producer-thread dead)"
+        if stream_idle:
+            return "input-starved (stream-idle)"
         return "input-starved"
     blob = "\n".join(stacks.values())
     if any(m in blob for m in _DEVICE_MARKERS):
@@ -518,6 +533,7 @@ class RunMonitor:
         self._stall_timeout = float(stall_timeout_s)
         self._queue_depth_fn = queue_depth_fn
         self._producer_alive_fn = None
+        self._stream_idle_fn = None
         # Armed by the FIRST heartbeat: the gap before dispatch 1 is
         # dominated by XLA compile (legitimately >> any stall deadline),
         # and startup hangs are arm_hang_exit's department.
@@ -547,6 +563,14 @@ class RunMonitor:
         cadence as the depth probe): lets a stall classify as
         'input-starved (producer-thread dead)' instead of merely depth 0."""
         self._producer_alive_fn = fn
+
+    def set_stream_idle_fn(self, fn) -> None:
+        """Swap the tail-follow idleness probe (follow-mode input streams
+        only — data/stream.py): a starved loop whose stream is idle-
+        polling a quiet append-only file classifies as
+        'input-starved (stream-idle)' — wait for the writer, don't
+        restart the producer."""
+        self._stream_idle_fn = fn
 
     # -- emission ---------------------------------------------------------
 
@@ -710,8 +734,16 @@ class RunMonitor:
                     alive = self._producer_alive_fn()
                 except Exception:
                     alive = None
+            s_idle = None
+            if self._stream_idle_fn is not None:
+                try:
+                    s_idle = self._stream_idle_fn()
+                except Exception:
+                    s_idle = None
             cls = (
-                "compiling" if compiling else classify_stall(depth, stacks, alive)
+                "compiling"
+                if compiling
+                else classify_stall(depth, stacks, alive, s_idle)
             )
             try:
                 self.emit(

@@ -32,6 +32,8 @@ __all__ = [
     "init_state",
     "train_step_body",
     "make_train_step",
+    "make_decayed_body",
+    "make_accum_restart",
     "make_scanned_train_step",
     "make_predict_step",
     "pack_state",
@@ -80,11 +82,18 @@ def batch_loss(model, table_rows, dense, batch: Batch):
     return data_loss + reg, data_loss
 
 
-def train_step_body(model, learning_rate: float, state: TrainState, batch: Batch):
+def train_step_body(
+    model, learning_rate: float, state: TrainState, batch: Batch,
+    decay: float = 1.0,
+):
     """The (unjitted) single-device step: gather → fused scorer → loss →
     dedup → sparse Adagrad.  Shared verbatim by ``make_train_step`` and the
     device-cache step (data/device_cache.py) so the two paths are the SAME
-    math on the same values — the bit-identity their parity test pins."""
+    math on the same values — the bit-identity their parity test pins.
+
+    ``decay`` is the online-learning ``[Online] adagrad_decay`` γ (lazy
+    touched-row accumulator decay — optim.sparse_adagrad_update); γ=1.0
+    branches back to the exact classic program at trace time."""
     rows = state.table[batch.ids]  # [B, N, D] gather of touched rows only
 
     grad_fn = jax.value_and_grad(
@@ -93,12 +102,13 @@ def train_step_body(model, learning_rate: float, state: TrainState, batch: Batch
     (_, data_loss), (g_rows, g_dense) = grad_fn(rows, state.dense, batch)
 
     table, table_opt = sparse_adagrad_update(
-        state.table, state.table_opt, batch.ids, g_rows, learning_rate
+        state.table, state.table_opt, batch.ids, g_rows, learning_rate,
+        decay=decay,
     )
     dense, dense_opt = state.dense, state.dense_opt
     if jax.tree.leaves(state.dense):
         dense, dense_opt = dense_adagrad_update(
-            state.dense, state.dense_opt, g_dense, learning_rate
+            state.dense, state.dense_opt, g_dense, learning_rate, decay=decay
         )
     return (
         TrainState(table, table_opt, dense, dense_opt, state.step + 1),
@@ -106,7 +116,7 @@ def train_step_body(model, learning_rate: float, state: TrainState, batch: Batch
     )
 
 
-def make_train_step(model, learning_rate: float):
+def make_train_step(model, learning_rate: float, decay: float = 1.0):
     """Returns jitted ``step(state, batch) -> (state, data_loss)``.
 
     The state is donated: the table/accumulator buffers update in place
@@ -117,9 +127,49 @@ def make_train_step(model, learning_rate: float):
 
     @partial(jax.jit, donate_argnums=(0,))
     def step(state: TrainState, batch: Batch):
-        return train_step_body(model, learning_rate, state, batch)
+        return train_step_body(model, learning_rate, state, batch, decay)
 
     return step
+
+
+def make_decayed_body(decay: float):
+    """``train_step_body`` with ``[Online] adagrad_decay`` γ baked in — the
+    ``body`` shape the scanned and device-cache step factories take."""
+
+    def body(model, learning_rate, state, batch):
+        return train_step_body(model, learning_rate, state, batch, decay)
+
+    return body
+
+
+def make_accum_restart(init_accumulator_value: float):
+    """Jitted ``state -> state`` resetting every Adagrad accumulator to
+    the init value — the window-restart alternative to ``adagrad_decay``
+    (``[Online] accum_restart_steps``): on a moving distribution, a hard
+    periodic restart re-opens the step size for EVERY row at once.
+
+    Exact for the rows layout and for packed element/row accumulators
+    alike: ``pack_accum*`` fills padding slots with the init value, so a
+    full ``full_like(accum, init)`` reproduces the packed init state
+    bit-for-bit.  (The fused layout stores its accumulator inside the
+    table's own tile rows — config.validate rejects the combination.)
+    Donated, so the reset is an in-place sweep, no table copy."""
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def reset(state: TrainState):
+        table_acc = jnp.full_like(
+            state.table_opt.accum, init_accumulator_value
+        )
+        dense_acc = jax.tree.map(
+            lambda a: jnp.full_like(a, init_accumulator_value),
+            state.dense_opt.accum,
+        )
+        return state._replace(
+            table_opt=state.table_opt._replace(accum=table_acc),
+            dense_opt=state.dense_opt._replace(accum=dense_acc),
+        )
+
+    return reset
 
 
 def make_scanned_train_step(model, learning_rate: float, body=None):

@@ -45,15 +45,20 @@ __all__ = ["train", "dist_train", "scan_max_nnz"]
 
 def scan_max_nnz(cfg: Config) -> int:
     """Fix the static feature width: cfg.max_nnz, or a scan of the files
-    (one C++ streaming pass per file when the native parser is built)."""
+    (one C++ streaming pass per file when the native parser is built).
+    FMS stream files (online follow input — data/stream.py) contribute
+    their header width instead of a scan: an append-only stream's widest
+    FUTURE row is unknowable, so the writer-declared width is the bound."""
     if cfg.max_nnz > 0:
         return cfg.max_nnz
     from fast_tffm_tpu.data.native import scan_files
+    from fast_tffm_tpu.data.stream import is_fms, read_fms_header
 
-    _, widest = scan_files(
-        (*cfg.train_files, *cfg.validation_files, *cfg.predict_files)
-    )
-    return max(1, widest)
+    paths = (*cfg.train_files, *cfg.validation_files, *cfg.predict_files)
+    fms_widths = [read_fms_header(p)["width"] for p in paths if is_fms(p)]
+    rest = tuple(p for p in paths if not is_fms(p))
+    widest = scan_files(rest)[1] if rest else 0
+    return max(1, widest, *fms_widths)
 
 
 def _check_finite(
@@ -247,13 +252,9 @@ def _stream(
         **shard_kw,
     )
     if steps_per_call > 1:
-        from fast_tffm_tpu.utils.prefetch import chunk
+        from fast_tffm_tpu.utils.prefetch import grouped_pairs
 
-        def _grouped(pairs, k):
-            for items in chunk(pairs, k):
-                yield [p for p, _ in items], [w for _, w in items]
-
-        raw = _grouped(raw, steps_per_call)
+        raw = grouped_pairs(raw, steps_per_call)
     from fast_tffm_tpu.data.wire import InputStats
     from fast_tffm_tpu.utils.prefetch import InputStream
 
@@ -324,6 +325,48 @@ def _evaluate(
         lab, ww = fetch(b, parsed, w)
         meter.add(lab, scores, ww)
     return meter.value()
+
+
+def _follow_stream(cfg: Config, files, max_nnz, to_batch, skip_batches=0, stop=None):
+    """Tail-following input stream for ``[Online] follow = true``: the
+    FMS reader (data/stream.py) polls the append-only train file for
+    growth at EOF instead of ending the epoch; conversion runs in the
+    prefetch thread (the memmap-cheap producer, like FMB input), and the
+    stream's idle Event feeds the stall watchdog so a starved loop
+    classifies ``input-starved (stream-idle)``.  ``skip_batches`` is the
+    exact-position resume seek — one O(1) file seek."""
+    from fast_tffm_tpu.data.stream import fms_follow_stream
+    from fast_tffm_tpu.data.wire import InputStats
+    from fast_tffm_tpu.utils.prefetch import InputStream, prefetch
+
+    idle = threading.Event()
+    raw = fms_follow_stream(
+        files[0],
+        batch_size=cfg.batch_size,
+        vocabulary_size=cfg.vocabulary_size,
+        hash_feature_id=cfg.hash_feature_id,
+        max_nnz=max_nnz,
+        poll_s=cfg.online_poll_s,
+        idle_timeout_s=cfg.online_idle_timeout_s,
+        max_batches=cfg.online_max_batches,
+        skip_batches=skip_batches,
+        idle_flag=idle,
+        # The driver's SIGTERM handler sets this: an UNBOUNDED follow
+        # stream (idle_timeout_s = 0) must end at the next poll so the
+        # graceful checkpoint-and-exit path actually runs — without it a
+        # stop request while the stream is idle would block forever on
+        # an empty prefetch queue.
+        stop=stop,
+    )
+    if cfg.steps_per_call > 1:
+        from fast_tffm_tpu.utils.prefetch import grouped_pairs
+
+        raw = grouped_pairs(raw, cfg.steps_per_call)
+    stats = InputStats()
+    stats.bind_stream_idle(idle)
+    gen = stats.timed(raw, to_batch)
+    depth = max(1, cfg.queue_size // max(1, cfg.steps_per_call))
+    return InputStream(prefetch(gen, depth=depth, stats=stats), stats)
 
 
 def _files_fingerprint(files) -> str:
@@ -398,13 +441,46 @@ def _resolve_cursor(cfg: Config, cursor, log) -> tuple[int, int]:
         if mine.get("epoch") is not None:
             cursor["epoch"] = int(mine["epoch"])
             cursor["batch_in_epoch"] = int(mine.get("batch_in_epoch") or 0)
+    follow = bool(cfg.online_follow)
+    if follow and cursor.get("follow"):
+        # Append-only stream identity is PREFIX-based (growth is the
+        # normal case — data/stream.py): re-hash exactly the prefix
+        # window the cursor recorded.  A mismatch means the file was
+        # REPLACED, rewritten, or truncated: the cursor's batch offset
+        # now points into different data, and "resume at the start"
+        # would silently re-train the whole stream — fail LOUDLY instead
+        # (unlike the batch paths' warn-and-restart, there is no safe
+        # fallback here).
+        from fast_tffm_tpu.data.stream import stream_prefix_matches
+
+        if cursor.get("files") is not None and not stream_prefix_matches(
+            cfg.train_files, cursor["files"]
+        ):
+            raise ValueError(
+                "online resume: the train stream's PREFIX changed since "
+                "this cursor was saved (file replaced/rewritten/truncated, "
+                "not appended?) — the saved batch offset no longer names "
+                "the same data.  Start fresh (drop --resume) or restore "
+                "the original stream file."
+            )
+        # Prefix verified; exclude "files" from the equality table below
+        # (the re-hash IS the check — fingerprints of a grown file
+        # legitimately differ).
+        want_files = cursor.get("files")
+    elif follow:
+        # A batch-run cursor under a follow config (mode switch): the
+        # fingerprint flavors can never match — legacy fallback below.
+        want_files = object()
+    else:
+        want_files = _files_fingerprint(cfg.train_files)
     mismatched = [
         f"{key} {cursor.get(key)!r} != {want!r}"
         for key, want in (
             ("batch_size", int(cfg.batch_size)),
             ("shuffle", bool(cfg.shuffle)),
             ("shuffle_seed", int(cfg.shuffle_seed) if cfg.shuffle else cursor.get("shuffle_seed")),
-            ("files", _files_fingerprint(cfg.train_files)),
+            ("follow", follow if "follow" in cursor else follow or None),
+            ("files", want_files),
         )
         if cursor.get(key) != want
     ]
@@ -449,6 +525,8 @@ def _run_training(
     runtime=None,
     mesh=None,
     datastats_ids=None,
+    accum_restart=None,
+    stream_stop=None,
 ):
     """Shared step loop.  ``train_stream(epoch)`` overrides the per-epoch
     input stream, ``to_batch(parsed, w)`` the host→device batch assembly,
@@ -680,6 +758,16 @@ def _run_training(
     # poison the loss below; io/torn faults fire inside the reader and
     # checkpoint writer.  ``faults`` is None on every normal run.
     faults = active_faults()
+    # Accumulator window-restart grid: ABSOLUTE step multiples of N, so a
+    # crash-resumed run fires its resets at the same steps the
+    # uninterrupted run would have (an anchor relative to the resumed
+    # start would shift every later reset).  K-aligned like every other
+    # boundary: the reset fires at the first dispatch crossing a multiple.
+    if accum_restart is not None:
+        _n = max(1, int(cfg.online_accum_restart_steps))
+        next_restart = (start_step // _n + 1) * _n
+    else:
+        next_restart = None
     # Exact-position input cursor: tracked per dispatch, embedded in
     # every checkpoint (full, delta, final) so a crash-resume reopens
     # the input mid-epoch at the precise saved batch.
@@ -687,10 +775,17 @@ def _run_training(
     cur = {"epoch": start_epoch, "batch": start_batch}
     # Dataset identity, stamped once: cursors saved by this run describe
     # THIS file set; a resume against changed files must not trust them.
-    files_fp = _files_fingerprint(cfg.train_files)
+    # Follow mode uses the append-stable PREFIX fingerprint (growth is
+    # the normal case); the batch paths keep the size-based one.
+    if cfg.online_follow:
+        from fast_tffm_tpu.data.stream import stream_prefix_fingerprint
+
+        files_fp = stream_prefix_fingerprint(cfg.train_files)
+    else:
+        files_fp = _files_fingerprint(cfg.train_files)
 
     def input_cursor() -> dict:
-        return {
+        c = {
             "version": 1,
             "epoch": int(cur["epoch"]),
             "batch_in_epoch": int(cur["batch"]),
@@ -700,6 +795,9 @@ def _run_training(
             "steps_per_call": int(cfg.steps_per_call),
             "files": files_fp,
         }
+        if cfg.online_follow:
+            c["follow"] = True
+        return c
     # Save boundaries (full + delta) go through ONE owner: async full saves
     # snapshot on device and hand the convert/D2H/write to a writer thread
     # (at most one in flight, back-pressure counted); delta saves ship only
@@ -726,6 +824,8 @@ def _run_training(
         async_save=cfg.async_save,
         delta_every_steps=cfg.delta_every_steps,
         delta_chain_max=cfg.delta_chain_max,
+        full_every_s=cfg.delta_full_every_s,
+        chain_max_bytes=cfg.delta_chain_max_bytes,
         vocab=cfg.vocabulary_size,
         table_layout=cfg.table_layout,
         row_dim=row_dim,
@@ -745,6 +845,13 @@ def _run_training(
         def _on_signal(signum, frame):
             log(f"received signal {signum}: checkpointing after current step")
             stop_requested.set()
+            if stream_stop is not None:
+                # Unbounded follow streams end at their next poll so the
+                # loop (blocked on an idle stream's empty queue) wakes up
+                # to take the graceful checkpoint-and-exit path.
+                # ``stream_stop`` is a one-slot holder of the LIVE
+                # stream's Event (a fresh one per stream — see train()).
+                stream_stop[0].set()
             signal.signal(signum, restore_handlers[signum])
 
         for sig in (signal.SIGTERM, signal.SIGINT):
@@ -769,6 +876,7 @@ def _run_training(
             monitor.set_producer_alive_fn(
                 getattr(epoch_stream, "producer_alive", None)
             )
+            monitor.set_stream_idle_fn(getattr(epoch_stream, "stream_idle", None))
             for b, parsed, w in epoch_stream:
                 if b is None:
                     b = to_batch(parsed, w)
@@ -814,6 +922,13 @@ def _run_training(
                     ledger.flush(step_num)
                 if datastats is not None:
                     datastats.note(step_num, parsed=parsed, batch=b)
+                if next_restart is not None and step_num >= next_restart:
+                    # Window restart ([Online] accum_restart_steps): reset
+                    # every Adagrad accumulator to the init value.  The
+                    # reset program's one-time compile is priced as warmup.
+                    next_restart = (step_num // _n + 1) * _n
+                    with monitor.warmup_window():
+                        state = accum_restart(state)
                 if ckpt.delta_enabled:
                     # OR this batch's rows into the device bitmap; at a
                     # delta boundary, ship the touched window (writer
@@ -886,8 +1001,14 @@ def _run_training(
             if stop_requested.is_set():
                 break
             # Epoch complete: the cursor now names the NEXT epoch's start
-            # (the position the epoch-end save below must embed).
-            cur["epoch"], cur["batch"] = epoch + 1, 0
+            # (the position the epoch-end save below must embed).  Follow
+            # mode is the exception: its one endless epoch never
+            # "completes" — the stream merely went quiet (idle timeout /
+            # max_batches bound), and the cursor must keep naming the
+            # batch offset so the next ``--resume`` continues EXACTLY
+            # where this run stopped once more rows land.
+            if not cfg.online_follow:
+                cur["epoch"], cur["batch"] = epoch + 1, 0
             if input_stats is not None:
                 # Epoch-tail drain: the stream (and its stats) dies here,
                 # and a run (or tail) shorter than log_every would
@@ -948,6 +1069,10 @@ def _run_training(
         )
         raise
     finally:
+        if stream_stop is not None:
+            # Abandoned follow producers (exception paths) must stop
+            # polling/producing rather than linger for the process's life.
+            stream_stop[0].set()
         summary_extra = {}
         if extra_metrics is not None:
             # Drain events from the final partial log window (run end,
@@ -1101,8 +1226,15 @@ def train(cfg: Config, *, resume: bool = False, log=print, step_hook=None):
         )
     else:
         predict_step = make_predict_step(model)
-        step_body = None
-        step_fn = make_train_step(model, cfg.learning_rate)
+        # [Online] adagrad_decay: γ bakes into the step at trace time
+        # (γ=1.0 leaves the classic program byte-for-byte — the
+        # bit-identity the online tests pin).  Packed layouts reject
+        # γ < 1 at config.validate, so the packed bodies stay untouched.
+        decay = float(cfg.online_adagrad_decay)
+        from fast_tffm_tpu.trainer import make_decayed_body
+
+        step_body = make_decayed_body(decay) if decay != 1.0 else None
+        step_fn = make_train_step(model, cfg.learning_rate, decay=decay)
     if cfg.steps_per_call > 1 and not cfg.device_cache:
         # Streamed step fusion: ONE dispatch (and one H2D superbatch
         # transfer) per K steps.  The scan body is the same step body the
@@ -1115,6 +1247,46 @@ def train(cfg: Config, *, resume: bool = False, log=print, step_hook=None):
         to_batch=to_batch, saveable=saveable, step_hook=step_hook,
         row_dim=model.row_dim,
     )
+    if cfg.online_accum_restart_steps > 0:
+        from fast_tffm_tpu.trainer import make_accum_restart
+
+        run_kwargs["accum_restart"] = make_accum_restart(
+            cfg.init_accumulator_value
+        )
+    if cfg.online_follow:
+        # Tail-following online mode: the train file is an append-only
+        # FMS stream (data/stream.py) — at EOF the reader polls for
+        # growth instead of ending the epoch; bounded by
+        # [Online] max_batches / idle_timeout_s, or by SIGTERM.
+        from fast_tffm_tpu.data.stream import is_fms
+
+        if len(cfg.train_files) != 1:
+            raise ValueError(
+                "[Online] follow = true takes exactly ONE train file (an "
+                f"append-only FMS stream), got {len(cfg.train_files)}"
+            )
+        if not is_fms(cfg.train_files[0]):
+            raise ValueError(
+                f"[Online] follow = true needs an FMS stream file; "
+                f"{cfg.train_files[0]!r} is not one (create and append "
+                "with fast_tffm_tpu.data.stream.StreamWriter)"
+            )
+        # One stop Event PER STREAM, published through a shared holder:
+        # the signal handler sets whichever stream is live, and an
+        # abandoned stream (rollback re-entry) keeps its own latched
+        # event — no clear() that could race the old producer's next
+        # check.
+        follow_stop_ref = [threading.Event()]
+        run_kwargs["stream_stop"] = follow_stop_ref
+
+        def _follow_train_stream(epoch, skip_batches=0):
+            follow_stop_ref[0] = threading.Event()
+            return _follow_stream(
+                cfg, cfg.train_files, max_nnz, to_batch, skip_batches,
+                stop=follow_stop_ref[0],
+            )
+
+        run_kwargs["train_stream"] = _follow_train_stream
     if cfg.device_cache:
         step_fn, train_stream, examples_per_step, mark_touched, ids_fn = (
             _device_cached_input(cfg, model, max_nnz, log, body=step_body)
@@ -1345,6 +1517,23 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None, step_
     # pod supervisor — the generation watcher that re-execs this host into
     # the next pod incarnation when a peer is replaced.
     runtime = initialize_runtime(cfg, log=log)
+    if cfg.online_follow:
+        # The follow reader is single-process by construction: an
+        # append-only stream has no stable row count to shard, and the
+        # fixed-steps-per-epoch padding multi-host input relies on cannot
+        # exist for a file that grows.  (ROADMAP item 5's per-tenant delta
+        # streams are the multi-host follow-up.)
+        raise ValueError(
+            "[Online] follow = true is single-process (train); dist_train "
+            "cannot shard an append-only stream"
+        )
+    if cfg.online_accum_restart_steps > 0:
+        # The reset program's output sharding is not pinned to the mesh
+        # layout yet — reject loudly rather than risk a silent reshard.
+        raise ValueError(
+            "[Online] accum_restart_steps is single-process (train) for "
+            "now; use adagrad_decay on pods"
+        )
     if cfg.device_cache and cfg.shuffle:
         # A shuffled gather across the mesh-sharded batch dim would move
         # rows between chips every step — exactly the per-step traffic
@@ -1463,6 +1652,7 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None, step_
         # With device_cache the scan lives in the cached wrapper below
         # (it slices resident batches); the raw SPMD step stays per-batch.
         steps_per_call=(1 if cfg.device_cache else cfg.steps_per_call),
+        adagrad_decay=cfg.online_adagrad_decay,
     )
     predict_step = make_sharded_predict_step(
         model, mesh, lookup=cfg.lookup, capacity_factor=cfg.lookup_capacity_factor,

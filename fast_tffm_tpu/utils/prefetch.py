@@ -16,7 +16,7 @@ import queue
 import threading
 from collections.abc import Iterable, Iterator
 
-__all__ = ["prefetch", "chunk", "InputStream", "PrefetchError"]
+__all__ = ["prefetch", "chunk", "grouped_pairs", "InputStream", "PrefetchError"]
 
 _SENTINEL = object()
 
@@ -57,6 +57,13 @@ class InputStream:
         fn = getattr(self.stats, "producer_alive", None)
         return fn() if fn is not None else None
 
+    def stream_idle(self) -> bool | None:
+        """Whether a tail-following input stream is idle-polling a quiet
+        append-only file (None for non-follow streams) — the watchdog's
+        'input-starved (stream-idle)' signal (data/stream.py)."""
+        fn = getattr(self.stats, "stream_idle", None)
+        return fn() if fn is not None else None
+
 
 def chunk(it: Iterable, k: int) -> Iterator[list]:
     """Group consecutive items into lists of length ``k`` (the final list
@@ -77,6 +84,15 @@ def chunk(it: Iterable, k: int) -> Iterator[list]:
             buf = []
     if buf:
         yield buf
+
+
+def grouped_pairs(pairs: Iterable, k: int) -> Iterator[tuple[list, list]]:
+    """Group a ``(parsed, weights)`` stream into ``([parsed]*k, [w]*k)``
+    lists — THE steps_per_call grouping rule, shared by every input
+    stream builder (batch _stream and the online follow stream) so the
+    superbatch pairing cannot diverge between them."""
+    for items in chunk(pairs, k):
+        yield [p for p, _ in items], [w for _, w in items]
 
 
 def prefetch(it: Iterable, depth: int = 8, stats=None) -> Iterator:

@@ -1,0 +1,884 @@
+"""Online-learning loop tests (ISSUE 11): the FMS append-only stream
+container + tail-following reader, follow-mode training with exact
+mid-stream resume, time-decayed Adagrad (γ=1.0 bit-identity on all three
+train paths), the accumulator window-restart, age/size delta-chain
+compaction, the new stream-tier FaultPlan kinds, and the serving
+apply-in-order pin under continuous delta publish."""
+
+import json
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fast_tffm_tpu.config import Config
+from fast_tffm_tpu.data.stream import (
+    StreamWriter,
+    fms_follow_stream,
+    fms_row_count,
+    is_fms,
+    read_fms_header,
+    read_fms_rows,
+    stream_prefix_fingerprint,
+    stream_prefix_matches,
+)
+from fast_tffm_tpu.models import Batch, FMModel
+from fast_tffm_tpu.trainer import (
+    init_state,
+    make_accum_restart,
+    make_train_step,
+)
+from fast_tffm_tpu.training import train
+
+V = 256
+W = 4
+B = 64
+
+
+def _rows(rng, n, vocab=V, width=W):
+    return (
+        rng.integers(0, 2, size=n),
+        rng.integers(0, vocab, size=(n, width)),
+        np.round(np.abs(rng.normal(size=(n, width))) + 0.1, 4).astype(np.float32),
+        np.full(n, width, np.int64),
+    )
+
+
+def _new_stream(path, rng, batches, vocab=V, width=W):
+    w = StreamWriter(path, width=width, vocabulary_size=vocab)
+    data = [_rows(rng, B) for _ in range(batches)]
+    for l, i, v, z in data:
+        w.append(l, i, v, nnz=z)
+    return w, data
+
+
+def _follow_cfg(stream_path, model_file, max_batches, **kw):
+    return Config(
+        model="fm", factor_num=4, vocabulary_size=V, max_nnz=W,
+        model_file=model_file, train_files=(stream_path,),
+        epoch_num=1, batch_size=B, learning_rate=0.1, log_every=2,
+        online_follow=True, online_max_batches=max_batches,
+        online_poll_s=0.02, online_idle_timeout_s=10.0, **kw,
+    ).validate()
+
+
+# -- FMS container --------------------------------------------------------
+
+
+def test_fms_round_trip_and_row_count(tmp_path):
+    p = str(tmp_path / "s.fms")
+    rng = np.random.default_rng(0)
+    w = StreamWriter(p, width=W, vocabulary_size=V)
+    l, i, v, z = _rows(rng, 40)
+    w.append(l, i, v, nnz=z)
+    assert is_fms(p)
+    hdr = read_fms_header(p)
+    assert hdr["width"] == W and hdr["vocabulary_size"] == V
+    assert fms_row_count(p, W) == 40
+    lab, nz, ids, vals, flds = read_fms_rows(p, 0, 40)
+    np.testing.assert_array_equal(ids, i)
+    np.testing.assert_allclose(vals, v)
+    np.testing.assert_array_equal(lab, l.astype(np.float32))
+    np.testing.assert_array_equal(nz, z)
+    assert not flds.any()
+    # Positional read mid-stream.
+    lab2, _, ids2, _, _ = read_fms_rows(p, 10, 5)
+    np.testing.assert_array_equal(ids2, i[10:15])
+    w.close()
+
+
+def test_fms_torn_trailing_record_never_counts(tmp_path):
+    p = str(tmp_path / "s.fms")
+    rng = np.random.default_rng(1)
+    w = StreamWriter(p, width=W, vocabulary_size=V)
+    l, i, v, z = _rows(rng, 8)
+    w.append(l, i, v, nnz=z)
+    l2, i2, v2, z2 = _rows(rng, 1)
+    w.append_torn(l2, i2, v2, nnz=z2)  # partial trailing record, flushed
+    assert fms_row_count(p, W) == 8  # floor division waits it out
+    # The complete prefix stays fully readable around the torn tail.
+    _, _, ids, _, _ = read_fms_rows(p, 0, 8)
+    np.testing.assert_array_equal(ids, i)
+    w.complete_torn()
+    assert fms_row_count(p, W) == 9
+    # append() while a torn record is pending must complete it first —
+    # appending into the middle of a partial record would misalign every
+    # later record in the file.
+    l3, i3, v3, z3 = _rows(rng, 1)
+    w.append_torn(l3, i3, v3, nnz=z3)
+    l4, i4, v4, z4 = _rows(rng, 2)
+    w.append(l4, i4, v4, nnz=z4)
+    assert fms_row_count(p, W) == 12
+    _, _, ids_tail, _, _ = read_fms_rows(p, 10, 2)
+    np.testing.assert_array_equal(ids_tail, i4)
+    w.close()
+
+
+def test_fms_id_range_validated(tmp_path):
+    p = str(tmp_path / "s.fms")
+    rng = np.random.default_rng(30)
+    w = StreamWriter(p, width=W, vocabulary_size=V)
+    l, i, v, z = _rows(rng, 2)
+    i[1, 0] = V  # out of range
+    with pytest.raises(ValueError, match="id out of"):
+        w.append(l, i, v, nnz=z)
+    # The reader enforces the same rule on foreign/corrupt streams.
+    i[1, 0] = 0
+    w.append(l, i, v, nnz=z)
+    w.close()
+    rb = read_fms_header(p)["record_bytes"]
+    with open(p, "r+b") as f:
+        f.seek(64 + rb + 8)  # row 1's first id
+        f.write(np.int32(V + 7).tobytes())
+    with pytest.raises(ValueError, match="row 1"):
+        read_fms_rows(p, 0, 2)
+
+
+def test_fms_writer_rejects_mismatched_reopen(tmp_path):
+    p = str(tmp_path / "s.fms")
+    StreamWriter(p, width=W, vocabulary_size=V).close()
+    with pytest.raises(ValueError, match="width"):
+        StreamWriter(p, width=W + 2, vocabulary_size=V)
+
+
+def test_fms_corrupt_record_fails_loudly(tmp_path):
+    p = str(tmp_path / "s.fms")
+    rng = np.random.default_rng(2)
+    w = StreamWriter(p, width=W, vocabulary_size=V)
+    l, i, v, z = _rows(rng, 4)
+    w.append(l, i, v, nnz=z)
+    w.close()
+    # Smash row 2's nnz to an insane value: complete-size record, corrupt
+    # content — must raise naming the row, never train on garbage.
+    rb = read_fms_header(p)["record_bytes"]
+    with open(p, "r+b") as f:
+        f.seek(64 + 2 * rb + 4)
+        f.write(np.int32(999).tobytes())
+    with pytest.raises(ValueError, match="row 2"):
+        read_fms_rows(p, 0, 4)
+
+
+def test_prefix_fingerprint_append_stable_replace_detected(tmp_path):
+    p = str(tmp_path / "s.fms")
+    rng = np.random.default_rng(3)
+    w, _ = _new_stream(p, rng, 2)
+    fp = stream_prefix_fingerprint([p])
+    l, i, v, z = _rows(rng, 32)
+    w.append(l, i, v, nnz=z)  # growth must keep the fingerprint valid
+    assert stream_prefix_matches([p], fp)
+    w.close()
+    os.remove(p)
+    w2, _ = _new_stream(p, np.random.default_rng(99), 3)
+    w2.close()
+    assert not stream_prefix_matches([p], fp)  # replaced file
+    assert not stream_prefix_matches([p], "garbage")
+    assert not stream_prefix_matches([p], None)
+
+
+# -- tail-following reader ------------------------------------------------
+
+
+def test_follow_stream_tails_and_resumes_on_growth(tmp_path):
+    p = str(tmp_path / "s.fms")
+    rng = np.random.default_rng(4)
+    w, data = _new_stream(p, rng, 2)
+    idle = threading.Event()
+    got = []
+
+    def consume():
+        for pb, wts in fms_follow_stream(
+            p, batch_size=B, vocabulary_size=V, poll_s=0.01,
+            max_batches=4, idle_flag=idle,
+        ):
+            got.append((pb, wts))
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 5
+    while len(got) < 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert len(got) == 2
+    assert idle.wait(5)  # EOF: the reader is idle-polling, not done
+    # Bytes land -> the reader resumes cleanly, in order.
+    tail = [_rows(rng, B) for _ in range(2)]
+    for l, i, v, z in tail:
+        w.append(l, i, v, nnz=z)
+    t.join(5)
+    assert not t.is_alive() and len(got) == 4  # max_batches bound
+    assert not idle.is_set()
+    np.testing.assert_array_equal(got[2][0].ids, tail[0][1])
+    np.testing.assert_array_equal(got[0][0].ids, data[0][1])
+    assert all((wts == 1.0).all() for _, wts in got)  # full batches only
+    w.close()
+
+
+def test_follow_stream_skip_batches_is_exact(tmp_path):
+    p = str(tmp_path / "s.fms")
+    w, data = _new_stream(p, np.random.default_rng(5), 3)
+    w.close()
+    out = list(
+        fms_follow_stream(
+            p, batch_size=B, vocabulary_size=V, poll_s=0.01,
+            max_batches=3, skip_batches=2,
+        )
+    )
+    # Skipped batches COUNT toward max_batches (the pad_to_batches rule).
+    assert len(out) == 1
+    np.testing.assert_array_equal(out[0][0].ids, data[2][1])
+
+
+def test_follow_stream_idle_timeout_and_stop(tmp_path):
+    p = str(tmp_path / "s.fms")
+    w, _ = _new_stream(p, np.random.default_rng(6), 1)
+    w.close()
+    t0 = time.monotonic()
+    out = list(
+        fms_follow_stream(
+            p, batch_size=B, vocabulary_size=V, poll_s=0.01,
+            idle_timeout_s=0.15,
+        )
+    )
+    assert len(out) == 1 and time.monotonic() - t0 < 5
+    stop = threading.Event()
+    stop.set()
+    assert (
+        list(
+            fms_follow_stream(
+                p, batch_size=B, vocabulary_size=V, poll_s=0.01,
+                skip_batches=1, stop=stop,
+            )
+        )
+        == []
+    )
+
+
+def test_follow_stream_detects_truncation_and_replacement(tmp_path):
+    """The live twin of the resume-time prefix check: a stream that
+    SHRINKS below the consumed offset, or whose prefix changes while
+    the reader idles, must raise — never be silently consumed at a
+    now-meaningless byte offset."""
+    p = str(tmp_path / "s.fms")
+    w, _ = _new_stream(p, np.random.default_rng(40), 3)
+    w.close()
+    gen = fms_follow_stream(
+        p, batch_size=B, vocabulary_size=V, poll_s=0.01, max_batches=10,
+    )
+    next(gen)
+    next(gen)
+    # Truncate below the consumed offset (2 batches in).
+    with open(p, "r+b") as f:
+        f.truncate(64 + read_fms_header(p)["record_bytes"] * B)
+    with pytest.raises(ValueError, match="shrank"):
+        for _ in gen:
+            pass
+    # Replacement with a same-length-or-longer DIFFERENT stream: caught
+    # by the idle-entry prefix re-hash.
+    os.remove(p)
+    w2, _ = _new_stream(p, np.random.default_rng(41), 2)
+    w2.close()
+    gen2 = fms_follow_stream(
+        p, batch_size=B, vocabulary_size=V, poll_s=0.01, max_batches=10,
+    )
+    next(gen2)
+    next(gen2)
+    os.remove(p)
+    w3, _ = _new_stream(p, np.random.default_rng(42), 2)
+    w3.close()
+    with pytest.raises(ValueError, match="PREFIX changed"):
+        for _ in gen2:
+            pass
+
+
+def test_classify_stall_stream_idle():
+    from fast_tffm_tpu.telemetry import classify_stall
+
+    assert (
+        classify_stall(0, {}, producer_alive=True, stream_idle=True)
+        == "input-starved (stream-idle)"
+    )
+    # Dead producer outranks idle (a fault, not a quiet writer).
+    assert (
+        classify_stall(0, {}, producer_alive=False, stream_idle=True)
+        == "input-starved (producer-thread dead)"
+    )
+    assert classify_stall(0, {}, producer_alive=True) == "input-starved"
+
+
+# -- follow-mode training -------------------------------------------------
+
+
+def test_follow_train_e2e_and_cursor(tmp_path):
+    p = str(tmp_path / "s.fms")
+    w, _ = _new_stream(p, np.random.default_rng(7), 4)
+    w.close()
+    mf = str(tmp_path / "m.npz")
+    jl = str(tmp_path / "m.jsonl")
+    cfg = _follow_cfg(p, mf, 4, metrics_path=jl)
+    train(cfg, log=lambda *_: None)
+    from fast_tffm_tpu.checkpoint import read_input_cursor
+
+    cur = read_input_cursor(mf)
+    assert cur["follow"] is True
+    assert cur["epoch"] == 0 and cur["batch_in_epoch"] == 4
+    assert stream_prefix_matches((p,), cur["files"])
+    losses = [
+        r["loss"]
+        for r in map(json.loads, open(jl).read().splitlines())
+        if r.get("kind") == "train"
+    ]
+    assert losses and all(np.isfinite(losses))
+
+
+def test_follow_resume_mid_stream_bit_identical(tmp_path):
+    """The acceptance pin: --resume mid-stream with a GROWN file is
+    bit-identical to one uninterrupted run over the same rows."""
+    rng = np.random.default_rng(8)
+    data = [_rows(rng, B) for _ in range(6)]
+
+    pa = str(tmp_path / "a.fms")
+    wa = StreamWriter(pa, width=W, vocabulary_size=V)
+    for l, i, v, z in data:
+        wa.append(l, i, v, nnz=z)
+    wa.close()
+    ma = str(tmp_path / "ma.npz")
+    train(_follow_cfg(pa, ma, 6), log=lambda *_: None)
+
+    pb = str(tmp_path / "b.fms")
+    wb = StreamWriter(pb, width=W, vocabulary_size=V)
+    for l, i, v, z in data[:3]:
+        wb.append(l, i, v, nnz=z)
+    mb = str(tmp_path / "mb.npz")
+    train(_follow_cfg(pb, mb, 3), log=lambda *_: None)
+    for l, i, v, z in data[3:]:
+        wb.append(l, i, v, nnz=z)  # rows land AFTER the first run saved
+    wb.close()
+    train(_follow_cfg(pb, mb, 6), resume=True, log=lambda *_: None)
+
+    a, b = np.load(ma), np.load(mb)
+    for key in a.files:
+        if key in ("save_id", "parent_sig", "published_at", "input_cursor"):
+            continue
+        np.testing.assert_array_equal(a[key], b[key], err_msg=key)
+
+
+def test_follow_resume_changed_prefix_fails_loudly(tmp_path):
+    p = str(tmp_path / "s.fms")
+    rng = np.random.default_rng(9)
+    w, _ = _new_stream(p, rng, 3)
+    w.close()
+    mf = str(tmp_path / "m.npz")
+    train(_follow_cfg(p, mf, 3), log=lambda *_: None)
+    # Replace the stream with DIFFERENT rows (same length): the saved
+    # batch offset now points into other data — must raise, not resume.
+    os.remove(p)
+    w2, _ = _new_stream(p, np.random.default_rng(1234), 3)
+    w2.close()
+    with pytest.raises(ValueError, match="PREFIX changed"):
+        train(_follow_cfg(p, mf, 3), resume=True, log=lambda *_: None)
+
+
+def test_follow_rejects_non_stream_input(tmp_path):
+    txt = tmp_path / "t.libsvm"
+    txt.write_text("1 3:1.0 5:1.0\n0 2:1.0 4:1.0\n")
+    cfg = _follow_cfg(str(txt), str(tmp_path / "m.npz"), 1)
+    with pytest.raises(ValueError, match="FMS"):
+        train(cfg, log=lambda *_: None)
+
+
+def test_config_rejects_bad_online_combos(tmp_path):
+    with pytest.raises(ValueError, match="shuffle"):
+        Config(online_follow=True, shuffle=True).validate()
+    with pytest.raises(ValueError, match="epoch_num"):
+        Config(online_follow=True, epoch_num=2).validate()
+    with pytest.raises(ValueError, match="device_cache"):
+        Config(online_follow=True, device_cache=True).validate()
+    with pytest.raises(ValueError, match="rows"):
+        Config(online_adagrad_decay=0.9, table_layout="packed").validate()
+    with pytest.raises(ValueError, match="exclusive"):
+        Config(online_adagrad_decay=0.9, online_accum_restart_steps=5).validate()
+    with pytest.raises(ValueError, match="delta"):
+        # A global accumulator reset is not representable in a
+        # touched-row delta — resume would restore stale accumulators.
+        Config(online_accum_restart_steps=5, delta_every_steps=10).validate()
+    with pytest.raises(ValueError, match="fused"):
+        Config(
+            online_accum_restart_steps=5, adagrad_accumulator="fused",
+            table_layout="packed",
+        ).validate()
+    with pytest.raises(ValueError, match="adagrad_decay"):
+        Config(online_adagrad_decay=0.0).validate()
+    with pytest.raises(ValueError, match="single-process|dist"):
+        from fast_tffm_tpu.training import dist_train
+
+        dist_train(
+            Config(
+                online_follow=True,
+                train_files=(str(tmp_path / "x.fms"),),
+            ).validate()
+        )
+
+
+# -- time-decayed Adagrad -------------------------------------------------
+
+
+def _one_batch(rng, n=B):
+    l, i, v, z = _rows(rng, n)
+    return Batch(
+        labels=jnp.asarray(l.astype(np.float32)),
+        ids=jnp.asarray(i.astype(np.int32)),
+        vals=jnp.asarray(v),
+        fields=jnp.zeros((n, W), jnp.int32),
+        weights=jnp.ones((n,), jnp.float32),
+    )
+
+
+def test_decay_gamma1_bit_identical_streamed():
+    model = FMModel(vocabulary_size=V, factor_num=4, order=2)
+    rng = np.random.default_rng(10)
+    batches = [_one_batch(rng) for _ in range(3)]
+    s0 = init_state(model, jax.random.key(1))
+    s1 = init_state(model, jax.random.key(1))
+    step0 = make_train_step(model, 0.1)
+    step1 = make_train_step(model, 0.1, decay=1.0)
+    for b in batches:
+        s0, l0 = step0(s0, b)
+        s1, l1 = step1(s1, b)
+        assert float(l0) == float(l1)
+    for x, y in zip(jax.tree.leaves(s0), jax.tree.leaves(s1)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_decay_gamma1_bit_identical_device_cached(tmp_path):
+    from fast_tffm_tpu.data.binary import write_fmb
+    from fast_tffm_tpu.data.device_cache import (
+        load_device_dataset,
+        make_cached_train_step,
+    )
+    from fast_tffm_tpu.trainer import make_decayed_body
+
+    src = tmp_path / "t.libsvm"
+    rng = np.random.default_rng(11)
+    with open(src, "w") as f:
+        for _ in range(4 * 32):
+            k = int(rng.integers(1, W + 1))
+            ids = rng.choice(V, size=k, replace=False)
+            toks = " ".join(f"{i}:{rng.random():.4f}" for i in ids)
+            f.write(f"{int(rng.integers(0, 2))} {toks}\n")
+    fmb = write_fmb(str(src), str(src) + ".fmb", vocabulary_size=V)
+    data = load_device_dataset(
+        (fmb,), batch_size=32, vocabulary_size=V, max_nnz=W,
+    )
+    model = FMModel(vocabulary_size=V, factor_num=4, order=2)
+    s0 = init_state(model, jax.random.key(2))
+    s1 = init_state(model, jax.random.key(2))
+    step0, _ = make_cached_train_step(model, 0.1, data)
+    step1, _ = make_cached_train_step(model, 0.1, data, body=make_decayed_body(1.0))
+    for i in range(data.batches):
+        s0, l0 = step0(s0, jnp.int32(i))
+        s1, l1 = step1(s1, jnp.int32(i))
+        assert float(l0) == float(l1)
+    for x, y in zip(jax.tree.leaves(s0), jax.tree.leaves(s1)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs the 8-device mesh")
+def test_decay_gamma1_bit_identical_sharded():
+    from fast_tffm_tpu.parallel import (
+        init_sharded_state,
+        make_mesh,
+        make_sharded_train_step,
+    )
+
+    model = FMModel(vocabulary_size=V, factor_num=4, order=2)
+    mesh = make_mesh(2, 4)
+    rng = np.random.default_rng(12)
+    batches = [_one_batch(rng, n=64) for _ in range(2)]
+    s0 = init_sharded_state(model, mesh, jax.random.key(3))
+    s1 = init_sharded_state(model, mesh, jax.random.key(3))
+    step0 = make_sharded_train_step(model, 0.1, mesh)
+    step1 = make_sharded_train_step(model, 0.1, mesh, adagrad_decay=1.0)
+    for b in batches:
+        s0, l0 = step0(s0, b)
+        s1, l1 = step1(s1, b)
+        assert float(l0) == float(l1)
+    for x, y in zip(jax.tree.leaves(s0), jax.tree.leaves(s1)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_decay_monotone_and_touched_rows_only():
+    model = FMModel(vocabulary_size=V, factor_num=4, order=2)
+    rng = np.random.default_rng(13)
+    b = _one_batch(rng)
+    touched = np.unique(np.asarray(b.ids))
+    untouched = np.setdiff1d(np.arange(V), touched)
+    s_plain = init_state(model, jax.random.key(4), 0.1)
+    s_decay = init_state(model, jax.random.key(4), 0.1)
+    step_p = make_train_step(model, 0.1)
+    step_d = make_train_step(model, 0.1, decay=0.5)
+    for _ in range(3):
+        s_plain, _ = step_p(s_plain, b)
+        s_decay, _ = step_d(s_decay, b)
+    acc_p = np.asarray(s_plain.table_opt.accum)
+    acc_d = np.asarray(s_decay.table_opt.accum)
+    # Decay shrinks accumulated history on the rows the batch touches...
+    assert (acc_d[touched] <= acc_p[touched]).all()
+    assert (acc_d[touched] < acc_p[touched]).any()
+    # ...and is LAZY: untouched rows keep the exact init value.
+    assert (acc_d[untouched] == np.float32(0.1)).all()
+    # Decayed steps are LARGER (smaller denominator) — the accumulator
+    # can no longer freeze the model.
+    assert float(np.abs(np.asarray(s_decay.table)).sum()) >= float(
+        np.abs(np.asarray(s_plain.table)).sum()
+    )
+
+
+def test_decay_sharded_rejects_packed():
+    from fast_tffm_tpu.parallel import make_mesh, make_sharded_train_step
+
+    model = FMModel(vocabulary_size=V, factor_num=4, order=2)
+    mesh = make_mesh(1, 1)
+    with pytest.raises(ValueError, match="rows"):
+        make_sharded_train_step(
+            model, 0.1, mesh, table_layout="packed", adagrad_decay=0.9
+        )
+
+
+# -- accumulator window restart -------------------------------------------
+
+
+def test_accum_restart_resets_to_init():
+    model = FMModel(vocabulary_size=V, factor_num=4, order=2)
+    rng = np.random.default_rng(14)
+    state = init_state(model, jax.random.key(5), 0.1)
+    step = make_train_step(model, 0.1)
+    for _ in range(2):
+        state, _ = step(state, _one_batch(rng))
+    table_before = np.asarray(state.table).copy()
+    step_before = int(state.step)
+    assert (np.asarray(state.table_opt.accum) != np.float32(0.1)).any()
+    state = make_accum_restart(0.1)(state)
+    assert (np.asarray(state.table_opt.accum) == np.float32(0.1)).all()
+    # Only the optimizer history resets — parameters and step survive.
+    np.testing.assert_array_equal(np.asarray(state.table), table_before)
+    assert int(state.step) == step_before
+
+
+def test_accum_restart_e2e_via_config(tmp_path):
+    p = str(tmp_path / "s.fms")
+    w, _ = _new_stream(p, np.random.default_rng(15), 4)
+    w.close()
+    mf = str(tmp_path / "m.npz")
+    cfg = _follow_cfg(p, mf, 4, online_accum_restart_steps=3)
+    train(cfg, log=lambda *_: None)
+    z = np.load(mf)
+    # Restart fired at step 3; step 4 ran after it, so the accumulator
+    # is NOT the 4-step accumulation (spot check: strictly smaller sum
+    # than a no-restart run's).
+    cfg2 = _follow_cfg(p, str(tmp_path / "m2.npz"), 4)
+    train(cfg2, log=lambda *_: None)
+    z2 = np.load(str(tmp_path / "m2.npz"))
+    assert np.asarray(z["table_accum"]).sum() < np.asarray(z2["table_accum"]).sum()
+
+
+# -- delta-chain compaction -----------------------------------------------
+
+
+def _ckpt_modes(jl):
+    """kind=ckpt mode counts from a run's telemetry JSONL (the on-disk
+    chain is no witness here: every full save — including the run-end
+    sync save — unlinks the delta files, which is the POINT)."""
+    out = {}
+    for r in map(json.loads, open(jl).read().splitlines()):
+        if r.get("kind") == "ckpt":
+            out[r["mode"]] = out.get(r["mode"], 0) + 1
+    return out
+
+
+def test_chain_compaction_by_age(tmp_path):
+    """full_every_s: an old chain promotes the next delta boundary to a
+    FULL save (which unlinks the chain) — bounded disk for endless runs."""
+    p = str(tmp_path / "s.fms")
+    w, _ = _new_stream(p, np.random.default_rng(16), 8)
+    w.close()
+    cfg = _follow_cfg(
+        p, str(tmp_path / "m.npz"), 8,
+        delta_every_steps=2, delta_chain_max=100,
+        delta_full_every_s=0.0,  # OFF: the chain grows freely
+        metrics_path=str(tmp_path / "a.jsonl"),
+    )
+    train(cfg, log=lambda *_: None)
+    modes = _ckpt_modes(str(tmp_path / "a.jsonl"))
+    # Boundary 1 promotes (no signed base yet); later boundaries stay
+    # deltas with compaction off.
+    assert modes.get("delta", 0) >= 2
+
+    cfg2 = _follow_cfg(
+        p, str(tmp_path / "m2.npz"), 8,
+        delta_every_steps=2, delta_chain_max=100,
+        delta_full_every_s=0.001,  # every boundary is "old" -> full save
+        metrics_path=str(tmp_path / "b.jsonl"),
+    )
+    # The step hook paces the loop past the (tiny) age threshold — on a
+    # fast box two steps can otherwise finish inside 1 ms and land a
+    # legitimate delta, making the all-promoted assertion flaky.
+    train(cfg2, log=lambda *_: None, step_hook=lambda s: time.sleep(0.002))
+    modes2 = _ckpt_modes(str(tmp_path / "b.jsonl"))
+    assert modes2.get("delta", 0) == 0  # every boundary promoted
+    # Promoted boundaries land as full saves ("sync" on this non-async
+    # run; "full" when async_save is on).
+    assert modes2.get("sync", 0) + modes2.get("full", 0) >= 2
+
+
+def test_chain_compaction_by_size(tmp_path):
+    p = str(tmp_path / "s.fms")
+    w, _ = _new_stream(p, np.random.default_rng(17), 8)
+    w.close()
+    cfg = _follow_cfg(
+        p, str(tmp_path / "m.npz"), 8,
+        delta_every_steps=2, delta_chain_max=100,
+        delta_chain_max_bytes=1,  # any delta trips the size bound
+        metrics_path=str(tmp_path / "a.jsonl"),
+    )
+    train(cfg, log=lambda *_: None)
+    modes = _ckpt_modes(str(tmp_path / "a.jsonl"))
+    # Boundary 2 writes the chain's single delta (bytes 0 -> >1); every
+    # boundary after it promotes to full — the chain never exceeds one
+    # link, so delta saves and full promotions must alternate.
+    fulls = modes.get("sync", 0) + modes.get("full", 0)
+    assert modes.get("delta", 0) <= fulls + 1
+    assert fulls >= 1
+
+
+# -- stream-tier fault plan kinds -----------------------------------------
+
+
+def test_fault_plan_stream_kinds():
+    from fast_tffm_tpu.resilience import FaultPlan
+
+    p = FaultPlan.parse("stream_stall@3,append_torn@2,kill@10")
+    assert p.stream_events() == [
+        {"kind": "append_torn", "at": 2},
+        {"kind": "stream_stall", "at": 3},
+    ]
+    assert p.serving_events() == []
+    # Seeded draws exist and are deterministic.
+    a = FaultPlan.parse("random:stream_stall=1,append_torn=2", seed=5)
+    b = FaultPlan.parse("random:stream_stall=1,append_torn=2", seed=5)
+    assert a.to_json() == b.to_json()
+    assert len(a.stream_events()) == 3
+    assert all(1 <= e["at"] <= 5 for e in a.events if e["kind"] == "stream_stall")
+    with pytest.raises(ValueError):
+        FaultPlan.parse("stream_stall@0")  # floor 1, like the train kinds
+
+
+def test_fault_plan_existing_seeds_byte_identical():
+    """Appending the stream kinds LAST must not reshuffle any existing
+    seeded schedule (the PR-6 byte-identity contract)."""
+    from fast_tffm_tpu.resilience import FaultPlan
+
+    # Pinned from the pre-ISSUE-11 grammar (seed 7, horizon 1000).
+    assert FaultPlan.parse(
+        "random:kill=2,io_error=3,nan=1", seed=7
+    ).to_json() == (
+        '{"events":[{"at":50,"kind":"nan"},{"at":155,"kind":"io_error"},'
+        '{"at":332,"kind":"kill"},{"at":405,"kind":"io_error"},'
+        '{"at":667,"kind":"io_error"},{"at":971,"kind":"kill"}],'
+        '"seed":7,"spec":"random:kill=2,io_error=3,nan=1"}'
+    )
+
+
+def test_follow_sigterm_while_idle_checkpoints_and_exits(tmp_path):
+    """The production stop path: an UNBOUNDED follow trainer (no idle
+    timeout) whose stream has gone quiet must still honor SIGTERM —
+    checkpoint and exit cleanly — not hang on the idle stream."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    p = str(tmp_path / "s.fms")
+    w, _ = _new_stream(p, np.random.default_rng(50), 2)
+    w.close()
+    cfgp = tmp_path / "run.cfg"
+    cfgp.write_text(
+        f"""
+[General]
+model = fm
+factor_num = 4
+vocabulary_size = {V}
+model_file = {tmp_path}/m.npz
+[Train]
+train_files = {p}
+max_nnz = {W}
+batch_size = {B}
+epoch_num = 1
+log_every = 1
+[Online]
+follow = true
+poll_s = 0.05
+"""
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(repo, "fast_tffm.py"), "train", str(cfgp)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=repo,
+    )
+    try:
+        # Wait until training made progress and the stream is idle.
+        deadline = time.monotonic() + 120
+        for line in proc.stdout:
+            if line.startswith("step ") or time.monotonic() > deadline:
+                break
+        time.sleep(0.5)  # both batches consumed; the reader is idle-polling
+        proc.send_signal(__import__("signal").SIGTERM)
+        out = proc.stdout.read()
+        rc = proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert rc == 0, out[-2000:]
+    assert "stopped on signal" in out
+    from fast_tffm_tpu.checkpoint import read_input_cursor
+
+    cur = read_input_cursor(str(tmp_path / "m.npz"))
+    assert cur["batch_in_epoch"] == 2 and cur["follow"] is True
+
+
+# -- report: quality/soak sections + strict gates -------------------------
+
+
+def test_report_quality_and_soak_gates(tmp_path):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "report_tool",
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools", "report.py",
+        ),
+    )
+    report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(report)
+
+    def recs(quality, soak_ok=True):
+        base = dict(run_id="r", schema_version=1, step=1, t=0.0, ts=0.0,
+                    process_index=0, process_count=1)
+        out = [
+            {**base, "kind": "quality", "hour": h,
+             "auc_online": on, "auc_batch": ba}
+            for h, (on, ba) in enumerate(quality, start=1)
+        ]
+        out.append(
+            {**base, "kind": "soak", "phase": "steady", "elapsed_s": 10.0,
+             "ok": soak_ok}
+        )
+        return out
+
+    good = report.summarize(recs([(0.83, 0.835), (0.82, 0.825)]))
+    assert good["quality_hours"] == 2
+    assert good["quality_auc_gap_max"] == pytest.approx(0.005)
+    text = report.render(good)
+    assert "Online quality" in text and "Soak sentinels" in text
+    _, regressions = report.compare(good, good, threshold=0.05, strict=True)
+    assert not regressions
+
+    # Worst-hour gap past the threshold gates, even against itself.
+    bad = report.summarize(recs([(0.70, 0.83)]))
+    _, regressions = report.compare(bad, bad, threshold=0.05, strict=True)
+    assert any("batch-retrain" in r for r in regressions)
+
+    # Online AUC collapsing vs the BASE gates.
+    worse = report.summarize(recs([(0.55, 0.56)]))
+    _, regressions = report.compare(worse, good, threshold=0.05, strict=True)
+    assert any("backtest AUC" in r for r in regressions)
+
+    # A failed soak sentinel tick gates outright.
+    soak_fail = report.summarize(recs([(0.83, 0.835)], soak_ok=False))
+    assert soak_fail["soak_failures"] == 1
+    _, regressions = report.compare(soak_fail, good, threshold=0.05, strict=True)
+    assert any("soak sentinel" in r for r in regressions)
+
+
+# -- serving: apply-in-order under continuous publish ---------------------
+
+
+def test_reload_apply_in_order_under_continuous_publish(tmp_path):
+    """A delta published while the watcher is mid-apply of its parent
+    must QUEUE, not race: hammer reload_once from several threads while
+    deltas publish continuously, then pin the engine's final state
+    bit-identical to the chain replayed through restore_checkpoint."""
+    from fast_tffm_tpu.checkpoint import (
+        restore_checkpoint,
+        save_checkpoint,
+        save_delta,
+    )
+    from fast_tffm_tpu.serving.engine import ServingEngine
+
+    model = FMModel(vocabulary_size=V, factor_num=4, order=2)
+    state = init_state(model, jax.random.key(6), 0.1)
+    mf = str(tmp_path / "m.npz")
+    sid = "base0"
+    save_checkpoint(mf, state, "npz", save_id=sid)
+    cfg = Config(
+        model="fm", factor_num=4, vocabulary_size=V, max_nnz=W,
+        model_file=mf, serve_buckets=(1, 8), serve_flush_deadline_ms=1.0,
+        serve_reload_interval_s=0.0,  # reload_once-driven, like a router
+    ).validate()
+    eng = ServingEngine(cfg, log=lambda *_: None)
+    try:
+        rng = np.random.default_rng(18)
+        parent = sid
+        n_deltas = 6
+        stop = threading.Event()
+        outcomes = []
+
+        def hammer():
+            while not stop.is_set():
+                try:
+                    outcomes.append(eng.reload_once()["status"])
+                except Exception as e:  # pragma: no cover
+                    outcomes.append(f"raise:{e!r}")
+                # Keep the collector draining swaps between ticks.
+                eng.submit(np.asarray([1, 2]), np.asarray([1.0, 1.0])).result(5)
+                time.sleep(0.002)
+
+        threads = [threading.Thread(target=hammer, daemon=True) for _ in range(4)]
+        for t in threads:
+            t.start()
+        step_arr = np.asarray(np.int32(0))
+        for seq in range(1, n_deltas + 1):
+            idx = np.sort(rng.choice(V, size=8, replace=False)).astype(np.int64)
+            rows = np.full((8, W + 1), float(seq), np.float32)
+            step_arr = np.asarray(np.int32(seq))
+            _, parent, _ = save_delta(
+                mf, seq, idx=idx, table_rows=rows,
+                accum_rows=np.ones((8, W + 1), np.float32),
+                dense_leaves=[], dense_accum_leaves=[],
+                step=step_arr, parent_sig=parent,
+            )
+            time.sleep(0.01)
+        # Let the hammer threads finish applying the tail of the chain.
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and eng._applied_deltas < n_deltas:
+            time.sleep(0.02)
+        stop.set()
+        for t in threads:
+            t.join(5)
+        assert not any(str(o).startswith("raise:") for o in outcomes), outcomes
+        assert eng._applied_deltas == n_deltas
+        # Swap in whatever is still staged, then compare against the
+        # ground truth: base + full chain replay.
+        eng.submit(np.asarray([1]), np.asarray([1.0])).result(5)
+        expect = restore_checkpoint(mf, init_state(model, jax.random.key(6), 0.1))
+        np.testing.assert_array_equal(
+            np.asarray(eng._state.table), np.asarray(expect.table)
+        )
+        assert int(eng._state.step) == n_deltas
+    finally:
+        eng.close()

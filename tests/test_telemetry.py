@@ -86,6 +86,16 @@ _DRIVER_PAYLOADS = {
         publish_step=12, publish_to_applied_ms=41.2,
         publish_to_first_scored_ms=44.8, mode="delta",
     ),
+    # Online-learning loop (ISSUE 11): the rolling backtest's per-hour
+    # AUC pair (tools/backtest.py) and the soak harness's sentinel tick
+    # (tools/soak.py).
+    "quality": dict(
+        hour=3, auc_online=0.8312, auc_batch=0.8297, auc_gap=-0.0015,
+    ),
+    "soak": dict(
+        phase="steady", elapsed_s=61.2, ok=True, unanswered=0,
+        freshness_scored_p99_ms=212.4, chain_len=5, disk_bytes=1048576,
+    ),
 }
 
 

@@ -1,0 +1,324 @@
+#!/usr/bin/env python
+"""Rolling quality backtest: replay a drifting day, online vs batch-retrain.
+
+The online-learning quality question ROADMAP item 4 asks: does a trainer
+that tail-follows the event stream (``[Online] follow = true``) actually
+TRACK a moving distribution, or does it silently decay relative to the
+"just retrain from scratch" reference?  This tool answers it with the
+machinery the repo already trusts:
+
+  * a synthetic DAY of timestamped events whose planted FM model DRIFTS
+    hour by hour (a rotation between two planted parameter sets — the
+    gen_synthetic planted-model idiom, made time-varying);
+  * the ONLINE trainer consumes the day as a real append-only FMS stream
+    through the real driver: hour h's rows are APPENDED, then the trainer
+    ``--resume``s and follows until its max_batches bound — every hour
+    boundary exercises the exact-position mid-stream cursor for real;
+  * the BATCH reference retrains from scratch each hour on all data so
+    far (the expensive thing production cannot afford to do hourly —
+    that cost asymmetry is the point of the comparison);
+  * after each hour both models score the NEXT hour's held-out rows
+    (prequential evaluation) and one ``kind=quality`` record lands in
+    the online run's telemetry JSONL: (hour, auc_online, auc_batch).
+
+``tools/report.py ONLINE.jsonl --compare BATCH.jsonl --strict`` then
+renders the AUC-by-hour table and gates on the worst-hour gap; this tool
+runs that comparison itself, writes the committed artifact
+(BACKTEST_r11.json), and exits nonzero if the online trainer trails the
+batch reference by more than ``--threshold`` AUC at any hour.
+
+Usage:
+    python tools/backtest.py [--hours 24] [--rows-per-hour 4096] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from fast_tffm_tpu.telemetry import arm_hang_exit
+
+_HANG_TIMER = arm_hang_exit(3600, what="backtest")
+
+import numpy as np  # noqa: E402
+
+from gen_synthetic import _id_normal, _zipf_ids, planted_score  # noqa: E402
+
+VOCAB = 1 << 12
+FIELDS = 8
+K = 4
+SPREAD = 2.2  # label-noise calibration (quality_zoo rationale)
+
+
+def _draw_rows(rng, rows: int):
+    bounds = np.linspace(0, VOCAB, FIELDS + 1).astype(np.int64)
+    ids = np.stack(
+        [_zipf_ids(rng, rows, bounds[f], bounds[f + 1]) for f in range(FIELDS)],
+        axis=1,
+    )
+    vals = np.round(
+        np.abs(rng.normal(0.5, 0.35, size=(rows, FIELDS))) + 0.05, 4
+    ).astype(np.float32)
+    return ids.astype(np.int64), vals
+
+
+def drifted_score(ids, vals, hour: int, hours: int):
+    """Planted score that ROTATES between two planted FMs over the day:
+    s_h = cos(θ_h)·s_A + sin(θ_h)·s_B, θ sweeping 60° — gradual concept
+    drift, the regime online learning exists for.  Pure function of
+    (ids, vals, hour), so train and held-out splits share the hour's
+    model exactly (the _id_normal determinism rule)."""
+    theta = (hour / max(1, hours - 1)) * (np.pi / 3.0)
+    s_a = planted_score(ids, vals, factor_num=K, model_seed=4242)
+    s_b = planted_score(ids, vals, factor_num=K, model_seed=8383)
+    return np.cos(theta) * s_a + np.sin(theta) * s_b
+
+
+def _labels(rng, score):
+    s = (score - score.mean()) / (score.std() + 1e-6) * SPREAD
+    return (rng.random(s.shape[0]) < 1.0 / (1.0 + np.exp(-s))).astype(np.int64)
+
+
+def _write_libsvm(path, labels, ids, vals):
+    with open(path, "w") as f:
+        for r in range(ids.shape[0]):
+            toks = " ".join(
+                f"{ids[r, c]}:{vals[r, c]:.4f}" for c in range(ids.shape[1])
+            )
+            f.write(f"{labels[r]} {toks}\n")
+
+
+def _gen_hour(hour: int, hours: int, rows: int, seed: int):
+    rng = np.random.default_rng((seed, hour))
+    ids, vals = _draw_rows(rng, rows)
+    labels = _labels(rng, drifted_score(ids, vals, hour, hours))
+    return labels, ids, vals
+
+
+def _auc_on(cfg, heldout_file: str, max_nnz: int) -> float:
+    """Held-out AUC of cfg.model_file's CURRENT checkpoint on one file,
+    through the real restore + predict-step + streaming-AUC path."""
+    import jax
+
+    from fast_tffm_tpu.checkpoint import restore_checkpoint
+    from fast_tffm_tpu.config import build_model
+    from fast_tffm_tpu.trainer import init_state, make_predict_step
+    from fast_tffm_tpu.training import _evaluate
+
+    model = build_model(cfg)
+    state = restore_checkpoint(
+        cfg.model_file,
+        init_state(model, jax.random.key(0), cfg.init_accumulator_value),
+    )
+    return _evaluate(
+        cfg, make_predict_step(model), state, (heldout_file,), max_nnz
+    )
+
+
+def main(argv=None) -> int:
+    from fast_tffm_tpu.config import Config
+    from fast_tffm_tpu.data.stream import StreamWriter
+    from fast_tffm_tpu.telemetry import RunMonitor, new_run_id
+    from fast_tffm_tpu.training import train
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hours", type=int, default=24, help="replayed 'day' length")
+    ap.add_argument("--rows-per-hour", type=int, default=4096)
+    ap.add_argument("--heldout-rows", type=int, default=2048)
+    ap.add_argument("--batch-size", type=int, default=512)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--decay", type=float, default=1.0,
+                    help="[Online] adagrad_decay for the online trainer")
+    ap.add_argument("--batch-epochs", type=int, default=1,
+                    help="epochs per batch-retrain reference run")
+    ap.add_argument("--threshold", type=float, default=0.05,
+                    help="max tolerated (batch - online) held-out AUC gap")
+    ap.add_argument("--seed", type=int, default=11)
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny smoke shapes (3 hours)")
+    ap.add_argument("--keep-dir", default=None,
+                    help="work in this dir (kept) instead of a tempdir")
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BACKTEST_r11.json"))
+    args = ap.parse_args(argv)
+    if args.quick:
+        args.hours, args.rows_per_hour, args.heldout_rows = 3, 1024, 512
+        args.batch_size = 256
+
+    # Batch-align every hour: the follow stream only emits FULL batches
+    # (data/stream.py's exactness rule), so hour boundaries must land on
+    # batch boundaries for the per-hour max_batches bound to be exact.
+    args.rows_per_hour -= args.rows_per_hour % args.batch_size
+    assert args.rows_per_hour > 0
+    batches_per_hour = args.rows_per_hour // args.batch_size
+
+    run_id = new_run_id()
+    tmp_ctx = None
+    if args.keep_dir:
+        os.makedirs(args.keep_dir, exist_ok=True)
+        tmp = args.keep_dir
+    else:
+        tmp_ctx = tempfile.TemporaryDirectory()
+        tmp = tmp_ctx.name
+    try:
+        stream_path = os.path.join(tmp, "day.fms")
+        writer = StreamWriter(
+            stream_path, width=FIELDS, vocabulary_size=VOCAB
+        )
+        online_jsonl = os.path.join(tmp, "online.jsonl")
+        batch_jsonl = os.path.join(tmp, "batch.jsonl")
+
+        def online_cfg(max_batches: int) -> Config:
+            return Config(
+                model="fm", factor_num=K, vocabulary_size=VOCAB,
+                model_file=os.path.join(tmp, "m_online.npz"),
+                train_files=(stream_path,),
+                epoch_num=1, batch_size=args.batch_size, max_nnz=FIELDS,
+                # Low enough that ONE hour's handful of batches still
+                # emits a kind=train record (the throughput gate reads
+                # them; an hour is only a few batches at these shapes).
+                learning_rate=args.lr, log_every=4,
+                online_follow=True, online_max_batches=max_batches,
+                online_poll_s=0.05, online_idle_timeout_s=30.0,
+                online_adagrad_decay=args.decay,
+                metrics_path=online_jsonl, telemetry_run_id=run_id,
+            ).validate()
+
+        def batch_cfg(hour_files) -> Config:
+            return Config(
+                model="fm", factor_num=K, vocabulary_size=VOCAB,
+                model_file=os.path.join(tmp, "m_batch.npz"),
+                train_files=tuple(hour_files),
+                epoch_num=args.batch_epochs, batch_size=args.batch_size,
+                max_nnz=FIELDS, learning_rate=args.lr, log_every=50,
+                binary_cache=True,
+                metrics_path=batch_jsonl, telemetry_run_id=run_id,
+            ).validate()
+
+        hour_files = []
+        heldout = {}
+        rows = []
+        quiet = lambda *_: None
+        for h in range(args.hours):
+            labels, ids, vals = _gen_hour(h, args.hours, args.rows_per_hour, args.seed)
+            # The online trainer's stream: APPEND hour h (timestamped
+            # arrival), then follow up to the cumulative batch bound —
+            # each hour after the first resumes MID-STREAM at the cursor.
+            writer.append(labels, ids, vals.astype(np.float32))
+            hf = os.path.join(tmp, f"hour_{h:02d}.libsvm")
+            _write_libsvm(hf, labels, ids, vals)
+            hour_files.append(hf)
+            te_l, te_i, te_v = _gen_hour(
+                h, args.hours, args.heldout_rows, args.seed + 1_000_003
+            )
+            te = os.path.join(tmp, f"heldout_{h:02d}.libsvm")
+            _write_libsvm(te, te_l, te_i, te_v)
+            heldout[h] = te
+
+            cfg_on = online_cfg((h + 1) * batches_per_hour)
+            train(cfg_on, resume=h > 0, log=quiet)
+            cfg_ba = batch_cfg(hour_files)
+            train(cfg_ba, log=quiet)
+
+            if h + 1 >= args.hours:
+                break
+            # Prequential: both models score the NEXT hour before its
+            # data arrives — the freshest question a CTR model answers.
+            nh_l, nh_i, nh_v = _gen_hour(
+                h + 1, args.hours, args.heldout_rows, args.seed + 1_000_003
+            )
+            nxt = os.path.join(tmp, f"heldout_{h + 1:02d}.libsvm")
+            _write_libsvm(nxt, nh_l, nh_i, nh_v)
+            heldout[h + 1] = nxt
+            auc_on = float(_auc_on(cfg_on, nxt, FIELDS))
+            auc_ba = float(_auc_on(cfg_ba, nxt, FIELDS))
+            rows.append(
+                {
+                    "hour": h + 1,
+                    "auc_online": round(auc_on, 5),
+                    "auc_batch": round(auc_ba, 5),
+                    "auc_gap": round(auc_ba - auc_on, 5),
+                }
+            )
+            print(
+                f"hour {h + 1:02d}: online {auc_on:.4f}  batch {auc_ba:.4f}  "
+                f"gap {auc_ba - auc_on:+.4f}",
+                flush=True,
+            )
+        writer.close()
+
+        # kind=quality records ride the ONLINE run's telemetry stream —
+        # report.py renders the table and --compare --strict gates it.
+        mon = RunMonitor(online_jsonl, run_id=run_id, source="train")
+        for r in rows:
+            mon.emit("quality", step=r["hour"], **r)
+        mon.close()
+
+        # The report gate, run exactly as an operator would: online run
+        # vs the batch reference's stream, strict.
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "report_tool",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)), "report.py"),
+        )
+        report = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(report)
+        s_on = report.summarize(report.load_run(online_jsonl))
+        s_ba = report.summarize(report.load_run(batch_jsonl))
+        cmp_text, regressions = report.compare(
+            s_on, s_ba, threshold=args.threshold, strict=True
+        )
+        print(cmp_text)
+
+        worst = max((r["auc_gap"] for r in rows), default=0.0)
+        gate_ok = worst <= args.threshold and not any(
+            "backtest" in r or "online" in r for r in regressions
+        )
+        from fast_tffm_tpu.telemetry import artifact_stamp
+
+        result = {
+            **artifact_stamp(run_id),
+            "tool": "backtest",
+            "hours": args.hours,
+            "rows_per_hour": args.rows_per_hour,
+            "heldout_rows": args.heldout_rows,
+            "batch_size": args.batch_size,
+            "vocab": VOCAB,
+            "fields": FIELDS,
+            "factor_num": K,
+            "lr": args.lr,
+            "adagrad_decay": args.decay,
+            "batch_epochs": args.batch_epochs,
+            "drift": "60-degree planted-FM rotation over the day",
+            "auc_by_hour": rows,
+            "auc_online_mean": round(
+                sum(r["auc_online"] for r in rows) / max(1, len(rows)), 5
+            ),
+            "auc_batch_mean": round(
+                sum(r["auc_batch"] for r in rows) / max(1, len(rows)), 5
+            ),
+            "worst_hour_gap": round(worst, 5),
+            "threshold": args.threshold,
+            "gate": "OK" if gate_ok else "REGRESSED",
+            "report_regressions": regressions,
+        }
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
+        print(f"wrote {args.out} (gate: {result['gate']})")
+        return 0 if gate_ok else 1
+    finally:
+        _HANG_TIMER.cancel()
+        if tmp_ctx is not None:
+            tmp_ctx.cleanup()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

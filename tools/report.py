@@ -414,6 +414,42 @@ def summarize(records: list[dict]) -> dict:
     s["validation_aucs"] = [
         r["validation_auc"] for r in vals if r.get("validation_auc") is not None
     ]
+
+    # Online-learning loop (ISSUE 11).  quality: the rolling backtest's
+    # per-hour held-out AUC for the online trainer vs its batch-retrain
+    # reference (tools/backtest.py); the worst-hour gap (batch − online)
+    # is the single-run regression signal --strict gates on.  soak: the
+    # sustained-soak harness's sentinel ticks (tools/soak.py) — any
+    # ok=false tick is a soak failure.
+    qual = kinds.get("quality", [])
+    s["quality_hours"] = len(qual)
+    s["quality_auc_by_hour"] = [
+        {
+            "hour": r.get("hour"),
+            "online": r.get("auc_online"),
+            "batch": r.get("auc_batch"),
+        }
+        for r in qual
+    ]
+    q_on = [r["auc_online"] for r in qual if isinstance(r.get("auc_online"), (int, float))]
+    q_ba = [r["auc_batch"] for r in qual if isinstance(r.get("auc_batch"), (int, float))]
+    s["quality_auc_online_mean"] = (
+        round(sum(q_on) / len(q_on), 5) if q_on else None
+    )
+    s["quality_auc_batch_mean"] = round(sum(q_ba) / len(q_ba), 5) if q_ba else None
+    gaps = [
+        r["auc_batch"] - r["auc_online"]
+        for r in qual
+        if isinstance(r.get("auc_online"), (int, float))
+        and isinstance(r.get("auc_batch"), (int, float))
+    ]
+    s["quality_auc_gap_max"] = round(max(gaps), 5) if gaps else None
+    soak = kinds.get("soak", [])
+    s["soak_ticks"] = len(soak)
+    s["soak_failures"] = sum(1 for r in soak if r.get("ok") is False)
+    s["soak_failed_phases"] = sorted(
+        {str(r.get("phase")) for r in soak if r.get("ok") is False}
+    )
     serving = kinds.get("serving", [])
     s["serving_last"] = serving[-1] if serving else None
 
@@ -693,6 +729,38 @@ def render(s: dict, title: str = "run") -> str:
                 f"{_fmt(s['freshness_scored_p99_ms'])} ms"
             )
         L.append("")
+    if s.get("quality_hours"):
+        L += ["## Online quality (rolling backtest)", ""]
+        L.append("| hour | online AUC | batch-retrain AUC | gap |")
+        L.append("|---:|---:|---:|---:|")
+        for row in s["quality_auc_by_hour"]:
+            gap = (
+                round(row["batch"] - row["online"], 5)
+                if isinstance(row.get("online"), (int, float))
+                and isinstance(row.get("batch"), (int, float))
+                else None
+            )
+            L.append(
+                f"| {row['hour']} | {row['online']} | {row['batch']} | {gap} |"
+            )
+        L.append(
+            f"- mean online {s['quality_auc_online_mean']} vs batch "
+            f"{s['quality_auc_batch_mean']}; worst-hour gap "
+            f"{s['quality_auc_gap_max']}"
+        )
+        L.append("")
+    if s.get("soak_ticks"):
+        L += ["## Soak sentinels", ""]
+        L.append(
+            f"- {s['soak_ticks']} sentinel tick(s), "
+            f"{s['soak_failures']} failed"
+            + (
+                f" (phases: {', '.join(s['soak_failed_phases'])})"
+                if s.get("soak_failed_phases")
+                else ""
+            )
+        )
+        L.append("")
     L += ["## Memory", ""]
     L.append(f"- host RSS peak: {_fmt_bytes(s['host_rss_peak_bytes'])}")
     L.append(f"- device live-buffer peak: {_fmt_bytes(s['device_peak_bytes'])}")
@@ -795,6 +863,9 @@ _GATE_METRICS = [
     ("measured_bytes_per_example", "measured HBM bytes/example", False),
     ("dedup_ratio_mean", "id dedup ratio (unique/slots)", False),
     ("freshness_p99_ms", "freshness p99 (ms)", False),
+    ("quality_auc_online_mean", "backtest online AUC (mean)", True),
+    ("quality_auc_gap_max", "backtest worst-hour AUC gap", False),
+    ("soak_failures", "failed soak sentinel ticks", False),
 ]
 
 
@@ -904,6 +975,35 @@ def compare(run: dict, base: dict, threshold: float, strict: bool = False):
                     f"{label} regressed {(rv - bv) / bv * 100:.1f}% "
                     f"(> {threshold * 100:.0f}%): {bv} -> {rv}"
                 )
+        # Online-quality gates (ISSUE 11).  Against a BASE with backtest
+        # records: the online trainer's mean held-out AUC must not drop
+        # more than the threshold fraction.  Within the RUN alone: the
+        # worst-hour gap to its OWN batch-retrain reference must stay
+        # under the threshold (read as absolute AUC points here — AUC is
+        # already a [0.5, 1] fraction), and any failed soak sentinel tick
+        # is a regression outright.
+        rq, bq = run.get("quality_auc_online_mean"), base.get("quality_auc_online_mean")
+        if (
+            isinstance(rq, (int, float))
+            and isinstance(bq, (int, float))
+            and bq > 0
+            and rq < bq * (1 - threshold)
+        ):
+            regressions.append(
+                f"online backtest AUC regressed {(bq - rq) / bq * 100:.1f}% "
+                f"(> {threshold * 100:.0f}%): {bq} -> {rq}"
+            )
+        gap = run.get("quality_auc_gap_max")
+        if isinstance(gap, (int, float)) and gap > threshold:
+            regressions.append(
+                f"online trainer trails its batch-retrain reference by "
+                f"{gap:.4f} AUC at the worst hour (> {threshold:.2f})"
+            )
+        if (run.get("soak_failures") or 0) > 0:
+            regressions.append(
+                f"{run['soak_failures']} soak sentinel tick(s) failed "
+                f"(phases: {', '.join(run.get('soak_failed_phases') or [])})"
+            )
         # Checkpoint stall share regression: the run spends a meaningfully
         # larger fraction of wall clock blocked on saves than the base did.
         # The 1% absolute floor keeps end-of-run sync saves (every run has
