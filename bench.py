@@ -1323,6 +1323,17 @@ def bench_dist(
 if __name__ == "__main__":
     import sys as _sys
 
+    if len(_sys.argv) >= 2 and _sys.argv[1] == "--tier":
+        # Beyond-HBM paramstore rung (`python bench.py --tier [args]`):
+        # delegates to tools/probe_tier.py — one source of truth for the
+        # Zipf(1.1) workload, the coverage-curve comparison, and the
+        # committed PROBE_TIER artifact.
+        import subprocess as _sp
+
+        _script = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools", "probe_tier.py"
+        )
+        _sys.exit(_sp.call([_sys.executable, _script, *_sys.argv[2:]]))
     if len(_sys.argv) == 3 and _sys.argv[1] == "--probe-rung":
         _probe_rung(int(_sys.argv[2]))
     if len(_sys.argv) >= 2 and _sys.argv[1] == "--dist":

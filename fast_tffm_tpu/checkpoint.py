@@ -310,6 +310,17 @@ def _npz_string(z, key) -> str | None:
 
 def _load_npz(path: str, like: TrainState):
     with _open_npz(path) as z:
+        if "tier_hot_ids" in getattr(z, "files", ()):
+            # A tiered (paramstore) checkpoint's ``table`` member is only
+            # the HOT tier — loading it as a full table would silently
+            # score/train on a sliver of the model.
+            raise ValueError(
+                f"{path!r} is a TIERED parameter-store checkpoint (its "
+                "'table' member holds only the device-resident hot rows; "
+                "the cold tier lives in the run's .store directory) — "
+                "resume it with [ParamStore] enabled; predict/serve need "
+                "a resident export"
+            )
         dense_leaves, _ = jax.tree.flatten(like.dense)
         try:
             return (

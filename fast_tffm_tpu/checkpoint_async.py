@@ -57,6 +57,12 @@ from fast_tffm_tpu.checkpoint import (
 __all__ = ["AsyncCheckpointer", "device_snapshot", "make_row_gather", "make_touched_marker"]
 
 
+def _tree_leaves(tree):
+    import jax
+
+    return jax.tree.leaves(tree)
+
+
 def _device_copy(x):
     """Fresh device buffer with x's exact bits, dispatch-only.  A full
     ``lax.slice`` is a real primitive (never a jax-level passthrough, and
@@ -164,13 +170,20 @@ class AsyncCheckpointer:
         cursor_fn=None,
         runtime=None,
         mesh=None,
+        paramstore=None,
     ):
         self._path = path
         self._fmt = fmt
         self._monitor = monitor
         self._log = log
         self._chunk = int(chunk_bytes)
-        self._async = bool(async_save) and fmt == "npz"
+        # Tiered runs (paramstore.TieredParamServer): every boundary is
+        # synchronous and spans BOTH tiers — publish the npz (hot rows +
+        # pending cold rows through the same chain), THEN apply pending
+        # to the cold store (invariant 7: store writes are always
+        # chain-replayable redo).  config.validate rejects async_save.
+        self._ps = paramstore
+        self._async = bool(async_save) and fmt == "npz" and paramstore is None
         self._delta_every = int(delta_every_steps) if fmt == "npz" else 0
         self._chain_max = max(1, int(delta_chain_max))
         # Age/size-based chain compaction ([Checkpoint] full_every_s /
@@ -414,6 +427,8 @@ class AsyncCheckpointer:
             self._last_boundary_step = int(step)
         bseq = self._bump_seq()
         cursor = self._merged_cursor(bseq)
+        if self._ps is not None:
+            return self._tiered_full(state, step, cursor, t0, emit)
         if sync or not self._async:
             sid = uuid.uuid4().hex
             timings: dict = {}
@@ -493,6 +508,8 @@ class AsyncCheckpointer:
             )
         ):
             return self.save_boundary(state, saveable, step)
+        if self._ps is not None:
+            return self._tiered_delta(state, step, t0)
         import jax.numpy as jnp
 
         bseq = self._bump_seq()
@@ -540,6 +557,134 @@ class AsyncCheckpointer:
             (seq, parent, idx, n, trows, arows, dense, dacc, step_arr, int(step),
              stall_ms, cursor, bseq),
         )
+
+    # -- tiered boundaries (paramstore; single-host, synchronous) ----------
+
+    def _tiered_full(self, state, step, cursor, t0, emit):
+        """Full save spanning both tiers: flush the in-flight writeback,
+        publish ONE npz carrying dense + the whole hot tier + residency +
+        every pending cold row (paramstore.ckpt.write_tiered_full — same
+        atomic chain-reset publish as _save_npz), then apply pending to
+        the cold store.  Publish-before-apply is invariant 7: the store
+        write is redo the chain can replay."""
+        from fast_tffm_tpu.paramstore.ckpt import write_tiered_full
+
+        sid = uuid.uuid4().hex
+        self._ps.flush_writeback(state)
+        pending_rows = self._ps.pending_rows
+        t1 = time.perf_counter()
+        timings: dict = {}
+        try:
+            nbytes = write_tiered_full(
+                self._path, self._ps, state, int(step),
+                save_id=sid, cursor=cursor, chunk_bytes=self._chunk,
+            )
+        except Exception:
+            self.write_failures += 1
+            raise  # tiered saves are sync — a failure must surface
+        self._on_full_published(sid)
+        self._apply_tiered(sid)
+        self.sync_saves += 1
+        stall = (time.perf_counter() - t0) * 1e3
+        if emit:
+            self._emit(
+                "sync", step, timings, nbytes=nbytes or 0,
+                rows=self._ps.hot_rows + pending_rows,
+                snapshot_ms=0.0, convert_ms=(t1 - t0) * 1e3,
+                train_stall_ms=stall,
+            )
+
+    def _tiered_delta(self, state, step, t0):
+        """Delta save spanning both tiers: the window's touched rows as
+        LOGICAL rows through the unchanged save_delta format — touched
+        hot slots gather off the compact device state (and translate to
+        logical ids via the residency map), pending cold rows come off
+        the overlay (flush first, so the LAST dispatch's staging rows are
+        in it).  Hot and pending are disjoint by construction (a
+        resident row never misses)."""
+        import jax
+        import jax.numpy as jnp
+
+        bseq = self._bump_seq()
+        cursor = self._merged_cursor(bseq)
+        self._ps.flush_writeback(state)
+        if self._bitmap is not None:
+            host_bm = np.unpackbits(
+                np.asarray(jnp.packbits(self._bitmap)), count=self._vocab
+            ).astype(bool)
+        else:
+            host_bm = np.zeros((self._vocab,), bool)
+        self._bitmap = self._fresh_bitmap()
+        self._last_boundary_step = int(step)
+        slots = np.flatnonzero(host_bm)
+        hot_slots = slots[slots < self._ps.hot_rows].astype(np.int64)
+        n_hot = int(hot_slots.size)
+        # Pow2-bucketed gather like the resident delta path: one compiled
+        # program per bucket.
+        k = 1 << max(6, (max(n_hot, 1) - 1).bit_length())
+        pad_idx = np.zeros((k,), np.int32)
+        pad_idx[:n_hot] = hot_slots
+        trows, arows = self._gather(state, jnp.asarray(pad_idx))
+        jax.block_until_ready((trows, arows))
+        hot_ids = self._ps.hot_logical_ids(hot_slots)
+        pend_ids, pend_t, pend_a = self._ps.pending_snapshot()
+        idx = np.concatenate([hot_ids, pend_ids])
+        t_all = np.concatenate([np.asarray(trows)[:n_hot], pend_t])
+        a_all = np.concatenate([np.asarray(arows)[:n_hot], pend_a])
+        seq, parent = self._next_seq, self._parent_sig
+        stall_ms = (time.perf_counter() - t0) * 1e3
+        timings: dict = {}
+        try:
+            out_path, sid, nbytes = save_delta(
+                self._path, seq,
+                idx=idx.astype(np.int64), table_rows=t_all, accum_rows=a_all,
+                dense_leaves=[np.asarray(x) for x in _tree_leaves(state.dense)],
+                dense_accum_leaves=[
+                    np.asarray(x) for x in _tree_leaves(state.dense_opt.accum)
+                ],
+                step=np.asarray(state.step), parent_sig=parent,
+                chunk_bytes=self._chunk, timings=timings, cursor=cursor,
+            )
+            from fast_tffm_tpu.resilience import maybe_torn_delta
+
+            maybe_torn_delta(out_path)
+        except Exception as e:
+            # Mirror the async writer's contract: the chain on disk stays
+            # complete; the next boundary promotes itself to a full save.
+            self.write_failures += 1
+            self._on_write_failed()
+            try:
+                self._log(f"tiered delta write failed (chain intact): {e!r}")
+            except Exception:
+                pass
+            return
+        with self._lock:
+            self._parent_sig = sid
+            self._next_seq = seq + 1
+            self._chain_len += 1
+            self._chain_bytes += int(nbytes)
+        self.delta_saves += 1
+        self._apply_tiered(sid)
+        self._emit(
+            "delta", step, timings, nbytes=nbytes, rows=int(idx.size),
+            snapshot_ms=stall_ms, convert_ms=0.0, train_stall_ms=stall_ms,
+        )
+
+    def _apply_tiered(self, sid: str) -> None:
+        """Post-publish store apply; a failure here never un-publishes —
+        pending stays intact and simply rides (and re-applies after) the
+        next boundary."""
+        try:
+            self._ps.apply_pending(sid)
+        except Exception as e:
+            self.write_failures += 1
+            try:
+                self._log(
+                    f"paramstore apply failed after publish (pending rows "
+                    f"retained; chain intact): {e!r}"
+                )
+            except Exception:
+                pass
 
     # -- writer thread ----------------------------------------------------
 

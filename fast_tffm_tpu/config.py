@@ -99,6 +99,15 @@ class Config:
     #   host involvement in between, dist_train scans around the SPMD body.
     #   Per-step losses keep full granularity; stop/checkpoint boundaries
     #   become K-step-aligned (DESIGN.md "Step fusion").
+    dedup_gather_rows: int = 0  # device-side dedup-before-gather on the
+    #   streamed path (ROADMAP item 2(a)): >0 caps the per-batch unique-id
+    #   set at N — the forward gather reads at most N table rows (one HBM
+    #   read per unique row; per-slot re-reads hit the compact buffer),
+    #   cashing in the measured 0.291 dedup ratio.  Values are identical
+    #   to the direct gather, so losses stay BIT-IDENTICAL (test-pinned).
+    #   The stream VERIFIES each batch fits N before it ships (loud error,
+    #   never silent truncation).  0 = off; rows layout, streamed local
+    #   train only
     wire_format: str = "packed"  # streamed H2D staging: packed (ONE coalesced
     #   byte buffer per superbatch, with device-side reconstruction of
     #   elidable tensors — all-ones vals, unused fields, uniform weights,
@@ -194,6 +203,30 @@ class Config:
     online_accum_restart_steps: int = 0  # window-restart alternative to
     #   decay: every N steps (K-aligned) reset EVERY accumulator to
     #   init_accumulator_value; 0 = off; exclusive with adagrad_decay < 1
+    # [ParamStore] — tiered host/device parameter store (paramstore/):
+    # beyond-HBM tables — a device-resident hot tier (top-K rows) + the
+    # full logical table in a memmap-backed host cold store; the prefetch
+    # thread resolves each superbatch's ids ahead of dispatch and miss
+    # rows ride the packed wire alongside the batch
+    paramstore: bool = False  # enable the tiered store (local train only;
+    #   table_layout = rows, npz checkpoints)
+    paramstore_hot_rows: int = 4096  # device-resident hot rows (the PR-9
+    #   coverage curve: top-4096 absorb 59% of gathers at the Zipf(1.1)
+    #   scale shape)
+    paramstore_miss_rows: int = 0  # staging capacity for one superbatch's
+    #   unique non-resident rows; 0 = auto (batch_size * max_nnz *
+    #   steps_per_call — the can't-overflow bound); a tighter cap shrinks
+    #   device memory and fails LOUDLY if a batch exceeds it
+    paramstore_dir: str = ""  # cold-store directory; "" = <model_file>.store
+    paramstore_residency: str = "sample"  # hot-set policy: sample (exact
+    #   frequency count over the first sample_batches of the train stream,
+    #   top-K — the heavy-hitter telemetry's exact twin) | first (ids
+    #   [0, K)) | file:PATH (id list exported from telemetry)
+    paramstore_sample_batches: int = 8  # batches the sample policy counts
+    paramstore_materialize: str = "auto"  # cold-store init: auto
+    #   (materialize the exact jax init draw at small vocab — the
+    #   bit-identity-with-resident path — lazy hashed init beyond) |
+    #   always | never
     # [Resilience] — crash recovery + fault handling (resilience.py)
     on_nan: str = "abort"  # non-finite loss policy: abort (raise before the
     #   next save overwrites good state — the historical behavior) |
@@ -441,6 +474,112 @@ class Config:
                     "[Online] follow = true runs ONE endless epoch — set "
                     f"epoch_num = 1 (got {self.epoch_num})"
                 )
+        if self.dedup_gather_rows < 0:
+            raise ValueError(
+                f"dedup_gather_rows must be >= 0 (0 = off), got "
+                f"{self.dedup_gather_rows}"
+            )
+        if self.dedup_gather_rows > 0:
+            if self.table_layout != "rows":
+                # The dedup body gathers/indexes the plain [V, D] table;
+                # the packed layouts have their own compaction story
+                # (packed_update = compact).
+                raise ValueError(
+                    "dedup_gather_rows > 0 requires table_layout = rows"
+                )
+            if self.device_cache:
+                raise ValueError(
+                    "dedup_gather_rows applies to the STREAMED path; "
+                    "device_cache slices resident batches (drop one)"
+                )
+            if self.paramstore:
+                raise ValueError(
+                    "dedup_gather_rows is redundant under [ParamStore] "
+                    "(tiered resolution already dedups before the gather) "
+                    "— drop one"
+                )
+            if self.online_follow:
+                # The follow stream (_follow_stream) does not run the
+                # per-batch cap guard; without it an over-cap appended
+                # batch would truncate silently inside the jitted dedup.
+                raise ValueError(
+                    "dedup_gather_rows with [Online] follow is not "
+                    "supported: the tail-following stream has no "
+                    "per-batch cap verification yet"
+                )
+        if self.paramstore:
+            if self.table_layout != "rows":
+                raise ValueError(
+                    "[ParamStore] requires table_layout = rows (the "
+                    "compact device tier is a plain [C, D] table)"
+                )
+            if self.checkpoint_format != "npz":
+                raise ValueError(
+                    "[ParamStore] requires checkpoint_format = npz (both "
+                    "tiers publish through the npz chain)"
+                )
+            if self.device_cache:
+                raise ValueError(
+                    "[ParamStore] and device_cache are exclusive: the "
+                    "tiered store IS the residency decision"
+                )
+            if self.online_follow:
+                raise ValueError(
+                    "[ParamStore] with [Online] follow is not supported "
+                    "yet (ROADMAP item 4 composes them)"
+                )
+            if self.async_save:
+                raise ValueError(
+                    "[ParamStore] saves are synchronous (the post-publish "
+                    "store apply must order after the npz publish) — drop "
+                    "async_save"
+                )
+            if self.adagrad_accumulator == "fused":
+                raise ValueError(
+                    "[ParamStore] supports adagrad_accumulator = element "
+                    "or row (fused is a packed-layout storage choice)"
+                )
+            if self.on_nan == "rollback":
+                raise ValueError(
+                    "[ParamStore] with on_nan = rollback is not supported "
+                    "yet — use abort (the tiered restore path does not "
+                    "plug into the in-process rollback loop)"
+                )
+            if self.online_accum_restart_steps > 0:
+                raise ValueError(
+                    "[ParamStore] cannot combine with accum_restart_steps: "
+                    "a global accumulator reset cannot reach the cold "
+                    "tier's rows — use adagrad_decay"
+                )
+            if self.paramstore_hot_rows < 1:
+                raise ValueError(
+                    f"[ParamStore] hot_rows must be >= 1, got "
+                    f"{self.paramstore_hot_rows}"
+                )
+            if self.paramstore_miss_rows < 0:
+                raise ValueError(
+                    "[ParamStore] miss_rows must be >= 0 (0 = auto), got "
+                    f"{self.paramstore_miss_rows}"
+                )
+            if self.paramstore_sample_batches < 1:
+                raise ValueError(
+                    "[ParamStore] sample_batches must be >= 1, got "
+                    f"{self.paramstore_sample_batches}"
+                )
+            if self.paramstore_residency not in ("sample", "first") and not (
+                self.paramstore_residency.startswith("file:")
+                and len(self.paramstore_residency) > 5
+            ):
+                raise ValueError(
+                    f"unknown [ParamStore] residency "
+                    f"{self.paramstore_residency!r} (sample | first | "
+                    "file:PATH)"
+                )
+            if self.paramstore_materialize not in ("auto", "always", "never"):
+                raise ValueError(
+                    f"unknown [ParamStore] materialize "
+                    f"{self.paramstore_materialize!r} (auto | always | never)"
+                )
         if self.delta_full_every_s < 0 or self.delta_chain_max_bytes < 0:
             raise ValueError(
                 "[Checkpoint] full_every_s and chain_max_bytes must be >= 0 "
@@ -660,6 +799,9 @@ def load_config(path: str) -> Config:
     cfg.shuffle = get(t, "shuffle", ini._convert_to_boolean, cfg.shuffle)
     cfg.shuffle_seed = get(t, "shuffle_seed", int, cfg.shuffle_seed)
     cfg.device_cache = get(t, "device_cache", ini._convert_to_boolean, cfg.device_cache)
+    cfg.dedup_gather_rows = get(
+        t, "dedup_gather_rows", int, cfg.dedup_gather_rows
+    )
     cfg.steps_per_call = get(t, "steps_per_call", int, cfg.steps_per_call)
     cfg.wire_format = get(t, "wire_format", str, cfg.wire_format).lower()
     cfg.queue_size = get(t, "queue_size", int, cfg.queue_size)
@@ -742,6 +884,19 @@ def load_config(path: str) -> Config:
     cfg.online_accum_restart_steps = get(
         o, "accum_restart_steps", int, cfg.online_accum_restart_steps
     )
+
+    ps = "ParamStore"
+    cfg.paramstore = get(ps, "enabled", ini._convert_to_boolean, cfg.paramstore)
+    cfg.paramstore_hot_rows = get(ps, "hot_rows", int, cfg.paramstore_hot_rows)
+    cfg.paramstore_miss_rows = get(ps, "miss_rows", int, cfg.paramstore_miss_rows)
+    cfg.paramstore_dir = get(ps, "store_dir", str, cfg.paramstore_dir)
+    cfg.paramstore_residency = get(ps, "residency", str, cfg.paramstore_residency)
+    cfg.paramstore_sample_batches = get(
+        ps, "sample_batches", int, cfg.paramstore_sample_batches
+    )
+    cfg.paramstore_materialize = get(
+        ps, "materialize", str, cfg.paramstore_materialize
+    ).lower()
 
     r = "Resilience"
     cfg.on_nan = get(r, "on_nan", str, cfg.on_nan).lower()

@@ -1,0 +1,261 @@
+"""Tiered checkpointing glue: both tiers through ONE atomic-publish chain.
+
+A tiered run's save boundaries go through the same npz chain machinery
+as every other run (checkpoint.py: atomic tmp+``os.replace``, content
+``save_id``/``parent_sig`` links, the input cursor riding inside the
+same publish), but the payload spans both tiers:
+
+  * **full save** — dense leaves + the ENTIRE hot tier (``table`` /
+    ``table_accum`` are the ``[H, D]``/``[H, A]`` device arrays, so
+    ``latest_step``/``checkpoint_save_id``/``read_input_cursor`` and the
+    chain reader all work unchanged) + the residency set
+    (``tier_hot_ids``) + every pending-writeback row
+    (``tier_cold_idx/rows/accum``) + the store identity
+    (``tier_store``: fingerprint, shape).  The cold BULK never re-writes:
+    the store file on disk IS the base for non-resident rows.
+  * **delta save** — the window's touched rows as LOGICAL rows through
+    the existing ``save_delta`` format: touched hot slots gather from
+    the device, pending rows come off the overlay; a delta is
+    layout-agnostic, so the chain reader needs nothing new.
+
+Crash-consistency invariant 7 (DESIGN "Tiered parameter store"): store
+writes happen ONLY after the boundary npz carrying the same rows is
+durable, so a row's latest value is always recoverable from exactly one
+tier plus the chain — restore replays base + chain and re-scatters every
+chain row into the store (idempotent redo), which also repairs a kill
+mid-apply.  The one undecidable window — a full save killed between
+unlinking the old chain and renaming the new base, with store applies
+from the vanished chain — is DETECTED (``applied_sig`` names a save the
+chain no longer contains) and refused loudly, never silently mixed."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+from fast_tffm_tpu.paramstore.store import ColdStore
+
+__all__ = ["write_tiered_full", "restore_tiered", "is_tiered_checkpoint"]
+
+_TIER_MARKER = "tier_hot_ids"
+
+# Superseded-sig lineage cap: prev-sig lists carry forward across full
+# saves so a crash between a full publish and its store apply (the store
+# still stamped with a sig from the just-unlinked chain) stays
+# distinguishable from a genuinely replaced store.  Applied sigs advance
+# monotonically, so only the recent tail can ever reappear.
+_PREV_SIGS_MAX = 256
+
+
+def _superseded_sigs(path: str) -> list[str]:
+    """Every save_id the on-disk base+deltas (and their own recorded
+    lineage) carry RIGHT NOW — the set the store's ``applied_sig`` could
+    legitimately name after this publish unlinks them.  Tolerant reads:
+    a torn file contributes nothing (its sig could never have been
+    applied)."""
+    from fast_tffm_tpu.checkpoint import _npz_string, _open_npz, delta_paths
+
+    sigs: list[str] = []
+
+    def add(s):
+        if s and s not in sigs:
+            sigs.append(s)
+
+    if os.path.isfile(path):
+        try:
+            with _open_npz(path) as z:
+                if "tier_prev_sigs" in getattr(z, "files", ()):
+                    for s in json.loads(
+                        bytes(np.asarray(z["tier_prev_sigs"]).tobytes()).decode()
+                    ):
+                        add(s)
+                add(_npz_string(z, "save_id"))
+        except (ValueError, OSError):
+            pass
+        for dp in delta_paths(path):
+            try:
+                with _open_npz(dp) as z:
+                    add(_npz_string(z, "save_id"))
+            except (ValueError, OSError):
+                pass
+    return sigs[-_PREV_SIGS_MAX:]
+
+
+def is_tiered_checkpoint(z) -> bool:
+    """True when an open npz holds a tiered (paramstore) checkpoint."""
+    return _TIER_MARKER in getattr(z, "files", ())
+
+
+def write_tiered_full(
+    path: str,
+    server,
+    state,
+    step: int,
+    *,
+    save_id: str,
+    cursor: dict | None = None,
+    chunk_bytes: int | None = None,
+) -> int:
+    """Atomic tiered full save (see module docstring).  The caller must
+    have flushed the writeback first (``server.flush_writeback``) so the
+    pending overlay names the latest value of every non-resident touched
+    row.  Mirrors checkpoint._save_npz's publish ordering exactly:
+    tmp write → unlink old deltas → chaos hook → ``os.replace``."""
+    from fast_tffm_tpu.checkpoint import (
+        DEFAULT_CHUNK_BYTES,
+        _cursor_entry,
+        _maybe_publish_fault,
+        _write_npz_streaming,
+        delta_paths,
+    )
+
+    hot_t, hot_a = server.hot_rows_host(state)
+    cold_idx, cold_t, cold_a = server.pending_snapshot()
+    store_meta = {
+        "fingerprint": server.store.fingerprint,
+        "vocab": server.store.vocab,
+        "row_dim": server.row_dim,
+        "accum_width": server.accum_width,
+        "hot_rows": server.hot_rows,
+    }
+    entries = {
+        "table": hot_t,
+        "table_accum": hot_a,
+        "step": np.asarray(state.step),
+        "save_id": np.frombuffer(save_id.encode(), np.uint8),
+        "published_at": np.float64(time.time()),
+        _TIER_MARKER: np.asarray(server.residency.hot_ids, np.int64),
+        "tier_cold_idx": cold_idx,
+        "tier_cold_rows": cold_t,
+        "tier_cold_accum": cold_a,
+        "tier_store": np.frombuffer(
+            json.dumps(store_meta, sort_keys=True).encode(), np.uint8
+        ),
+        # The sigs this publish supersedes (crash between the rename and
+        # the store apply leaves applied_sig naming one of these — still
+        # fully recoverable, since THIS base's tier_cold rows are the
+        # redo for everything pending since the last apply).
+        "tier_prev_sigs": np.frombuffer(
+            json.dumps(_superseded_sigs(path)).encode(), np.uint8
+        ),
+    }
+    if cursor is not None:
+        entries["input_cursor"] = _cursor_entry(cursor)
+    dense_leaves = list(_leaves(state.dense))
+    dacc_leaves = list(_leaves(state.dense_opt.accum))
+    for i, (p, a) in enumerate(zip(dense_leaves, dacc_leaves)):
+        entries[f"dense_{i}"] = p
+        entries[f"dense_accum_{i}"] = a
+    tmp = path + ".tmp"
+    dirpart = os.path.dirname(path)
+    if dirpart:
+        os.makedirs(dirpart, exist_ok=True)
+    with open(tmp, "wb") as f:
+        nbytes = _write_npz_streaming(
+            f, entries, chunk_bytes or DEFAULT_CHUNK_BYTES
+        )
+    for dp in delta_paths(path):
+        try:
+            os.remove(dp)
+        except OSError:
+            pass
+    _maybe_publish_fault(path)
+    os.replace(tmp, path)
+    return nbytes
+
+
+def _leaves(tree):
+    import jax
+
+    return jax.tree.leaves(tree)
+
+
+def restore_tiered(path: str, store: ColdStore, n_dense: int) -> dict:
+    """Replay base + chain into (hot tier arrays, dense leaves, step),
+    re-scattering every chain row's cold half into the store (idempotent
+    redo — also the repair for a kill mid-apply).  Returns a dict with
+    hot_ids / hot_t / hot_a / dense / dense_accum / step."""
+    from fast_tffm_tpu.checkpoint import (
+        _open_npz,
+        load_delta,
+        read_delta_chain,
+    )
+
+    with _open_npz(path) as z:
+        if not is_tiered_checkpoint(z):
+            raise ValueError(
+                f"{path!r} is not a tiered (paramstore) checkpoint — it has "
+                "no residency members.  Resume it without [ParamStore] "
+                "enabled, or start the tiered run fresh."
+            )
+        meta = json.loads(bytes(np.asarray(z["tier_store"]).tobytes()).decode())
+        if meta.get("fingerprint") != store.fingerprint:
+            raise ValueError(
+                f"tiered checkpoint {path!r} was saved against parameter "
+                f"store {meta.get('fingerprint')!r}, but {store.path!r} is "
+                f"{store.fingerprint!r} — the store was replaced or "
+                "recreated since this checkpoint; restore the original "
+                "store directory or start fresh"
+            )
+        hot_ids = np.asarray(z[_TIER_MARKER], np.int64)
+        hot_t = np.array(z["table"], np.float32)
+        hot_a = np.array(z["table_accum"], np.float32)
+        step = np.asarray(z["step"])
+        dense = [np.asarray(z[f"dense_{i}"]) for i in range(n_dense)]
+        dacc = [np.asarray(z[f"dense_accum_{i}"]) for i in range(n_dense)]
+        cold_idx = np.asarray(z["tier_cold_idx"], np.int64)
+        cold_t = np.asarray(z["tier_cold_rows"], np.float32)
+        cold_a = np.asarray(z["tier_cold_accum"], np.float32)
+        prev_sigs: list = []
+        if "tier_prev_sigs" in z.files:
+            prev_sigs = json.loads(
+                bytes(np.asarray(z["tier_prev_sigs"]).tobytes()).decode()
+            )
+    base_sig, chain = read_delta_chain(path)
+    sigs = {m["save_id"] for m in chain}
+    sigs.update(prev_sigs)
+    if base_sig:
+        sigs.add(base_sig)
+    applied = store.applied_sig
+    if applied is not None and applied not in sigs:
+        raise ValueError(
+            f"parameter store {store.path!r} has boundary {applied!r} "
+            "applied, but the checkpoint chain at "
+            f"{path!r} no longer contains that save — the store is AHEAD "
+            "of the chain (crash inside a full-save publish window?).  "
+            "The tiers cannot be mixed consistently; start the run fresh "
+            "(or restore a matching store backup)."
+        )
+    if cold_idx.size:
+        store.write_rows(cold_idx, cold_t, cold_a)
+    h = hot_ids.size
+    for m in chain:
+        d = load_delta(m["path"], n_dense)
+        idx = np.asarray(d["idx"], np.int64)
+        pos = np.searchsorted(hot_ids, idx)
+        pos_c = np.minimum(pos, max(0, h - 1))
+        is_hot = (pos < h) & (hot_ids[pos_c] == idx) if h else np.zeros(idx.shape, bool)
+        if is_hot.any():
+            hot_t[pos_c[is_hot]] = d["table_rows"][is_hot]
+            hot_a[pos_c[is_hot]] = d["accum_rows"][is_hot]
+        if (~is_hot).any():
+            store.write_rows(
+                idx[~is_hot], d["table_rows"][~is_hot], d["accum_rows"][~is_hot]
+            )
+        dense = d["dense"]
+        dacc = d["dense_accum"]
+        step = d["step"]
+    head = chain[-1]["save_id"] if chain else base_sig
+    store.flush()
+    store.set_applied(head)
+    return {
+        "hot_ids": hot_ids,
+        "hot_t": hot_t,
+        "hot_a": hot_a,
+        "dense": dense,
+        "dense_accum": dacc,
+        "step": step,
+    }

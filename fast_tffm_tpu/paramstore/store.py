@@ -1,0 +1,260 @@
+"""Host-side cold tier: the full logical table as memmap-backed files.
+
+The beyond-HBM half of the tiered parameter store (DESIGN "Tiered
+parameter store").  One ``ColdStore`` owns a directory holding the full
+``[V, D]`` table and ``[V, A]`` accumulator as row-addressable memmaps
+plus a one-bit-per-row "written" bitmap.  Two properties make vocabs far
+past device HBM (and even past host RAM) workable:
+
+  * **sparse files** — the data files are created by ``truncate`` alone,
+    so a 2^30-row store costs disk only for the rows actually written
+    (the OS hands out zero pages for the rest); host RAM is only the
+    page cache's working set, not the table;
+  * **lazy row init** — rows never written read as their deterministic
+    init value, computed on demand: a counter-based hash expands
+    ``(seed, id, col)`` to the same uniform ``[-r, r)`` factor draw every
+    time (bias column 0 stays 0.0, matching every model's
+    ``init_table``), so the init never has to materialize.  Small vocabs
+    can instead ``materialize=True`` the exact ``model.init_table`` draw
+    into the store — that is what makes a tiered run bit-identical to
+    the resident path at overlapping vocab (jax's bulk RNG draw is not
+    reproducible per-row, so exact parity requires materializing it).
+
+Durability contract (crash-consistency invariant 7, DESIGN): rows reach
+the store ONLY through the post-publish apply of a checkpoint boundary
+whose npz already carries the same rows — every store write is a redo
+the chain can replay, so a crash at ANY point leaves a row's latest
+value recoverable from exactly one tier plus the chain.  ``meta.json``
+records the last applied boundary's save_id (atomic tmp+replace); a
+store whose ``applied_sig`` names a save the on-disk chain no longer
+contains (the narrow unlink-to-rename crash window of a full save) is
+detected at restore and refused loudly — never silently mixed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import uuid
+
+import numpy as np
+
+__all__ = ["ColdStore", "hashed_uniform_rows"]
+
+_META = "meta.json"
+_TABLE = "table.dat"
+_ACCUM = "accum.dat"
+_WRITTEN = "written.dat"
+
+STORE_VERSION = 1
+
+
+def hashed_uniform_rows(
+    ids: np.ndarray, row_dim: int, seed: int, init_range: float
+) -> np.ndarray:
+    """Deterministic per-row init: uniform [-r, r) factors from a
+    counter-based integer hash of (seed, id, col); column 0 (the bias
+    slot every model's init_table zeroes) stays 0.0.  Vectorized — a
+    2^30-row store never materializes anything; rows are conjured as
+    they are first touched."""
+    ids = np.asarray(ids, np.uint64).reshape(-1, 1)
+    cols = np.arange(row_dim, dtype=np.uint64).reshape(1, -1)
+    # splitmix64 over a (seed, id, col) counter — full-width avalanche,
+    # so adjacent ids/cols decorrelate.
+    seed_mix = np.uint64((int(seed) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF)
+    x = (
+        ids * np.uint64(0x9E3779B97F4A7C15)
+        + cols * np.uint64(0xBF58476D1CE4E5B9)
+        + seed_mix
+    )
+    x ^= x >> np.uint64(30)
+    x *= np.uint64(0xBF58476D1CE4E5B9)
+    x ^= x >> np.uint64(27)
+    x *= np.uint64(0x94D049BB133111EB)
+    x ^= x >> np.uint64(31)
+    u = (x >> np.uint64(40)).astype(np.float32) / np.float32(1 << 24)  # [0, 1)
+    rows = ((u * 2.0 - 1.0) * np.float32(init_range)).astype(np.float32)
+    rows[:, 0] = 0.0  # bias column
+    return rows
+
+
+class ColdStore:
+    """Row-addressable host store for one logical table (+ accumulator).
+
+    All reads/writes take LOGICAL row ids.  Reads overlay nothing — the
+    caller (paramstore.tiered) owns the pending-writeback overlay; this
+    class is purely the durable bottom tier."""
+
+    def __init__(self, path: str, meta: dict):
+        self.path = path
+        self.meta = meta
+        self.vocab = int(meta["vocab"])
+        self.row_dim = int(meta["row_dim"])
+        self.accum_width = int(meta["accum_width"])
+        self._table = np.memmap(
+            os.path.join(path, _TABLE), np.float32, mode="r+",
+            shape=(self.vocab, self.row_dim),
+        )
+        self._accum = np.memmap(
+            os.path.join(path, _ACCUM), np.float32, mode="r+",
+            shape=(self.vocab, self.accum_width),
+        )
+        self._written = np.memmap(
+            os.path.join(path, _WRITTEN), np.uint8, mode="r+",
+            shape=((self.vocab + 7) // 8,),
+        )
+
+    # -- creation / opening ----------------------------------------------
+
+    @classmethod
+    def create(
+        cls,
+        path: str,
+        *,
+        vocab: int,
+        row_dim: int,
+        accum_width: int,
+        seed: int,
+        init_range: float,
+        init_accum: float,
+        init_table=None,
+        init_accum_arr=None,
+    ) -> "ColdStore":
+        """Fresh store.  ``init_table``/``init_accum_arr`` (host arrays)
+        materialize the exact init into the files (small vocabs — the
+        bit-identity path); without them, rows stay lazy (sparse files,
+        hashed_uniform on first read)."""
+        os.makedirs(path, exist_ok=True)
+        for name, width in ((_TABLE, row_dim), (_ACCUM, accum_width)):
+            with open(os.path.join(path, name), "wb") as f:
+                f.truncate(vocab * width * 4)
+        with open(os.path.join(path, _WRITTEN), "wb") as f:
+            f.truncate((vocab + 7) // 8)
+        meta = {
+            "version": STORE_VERSION,
+            "vocab": int(vocab),
+            "row_dim": int(row_dim),
+            "accum_width": int(accum_width),
+            "seed": int(seed),
+            "init_range": float(init_range),
+            "init_accum": float(init_accum),
+            "materialized": init_table is not None,
+            "fingerprint": uuid.uuid4().hex,
+            "applied_sig": None,
+        }
+        cls._write_meta(path, meta)
+        store = cls(path, meta)
+        if init_table is not None:
+            t = np.asarray(init_table, np.float32)
+            a = np.asarray(init_accum_arr, np.float32)
+            if t.shape != (vocab, row_dim) or a.shape != (vocab, accum_width):
+                raise ValueError(
+                    f"materialized init shapes {t.shape}/{a.shape} do not "
+                    f"match store [{vocab}, {row_dim}]/[{vocab}, {accum_width}]"
+                )
+            # Chunked copy: bounded dirty pages, no 2x table on heap.
+            chunk = max(1, (64 << 20) // max(1, row_dim * 4))
+            for lo in range(0, vocab, chunk):
+                hi = min(vocab, lo + chunk)
+                store._table[lo:hi] = t[lo:hi]
+                store._accum[lo:hi] = a[lo:hi]
+            store._written[:] = 0xFF
+            store.flush()
+        return store
+
+    @classmethod
+    def open(cls, path: str) -> "ColdStore":
+        meta_path = os.path.join(path, _META)
+        try:
+            with open(meta_path) as f:
+                meta = json.load(f)
+        except (OSError, ValueError) as e:
+            raise ValueError(
+                f"parameter store at {path!r} is missing or corrupt "
+                f"({e}) — delete the directory to start fresh"
+            ) from e
+        if int(meta.get("version", 0)) != STORE_VERSION:
+            raise ValueError(
+                f"parameter store {path!r} has version "
+                f"{meta.get('version')}, this build writes {STORE_VERSION}"
+            )
+        return cls(path, meta)
+
+    @staticmethod
+    def _write_meta(path: str, meta: dict) -> None:
+        tmp = os.path.join(path, _META + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(meta, f, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(path, _META))
+
+    @property
+    def fingerprint(self) -> str:
+        return self.meta["fingerprint"]
+
+    @property
+    def applied_sig(self) -> str | None:
+        return self.meta.get("applied_sig")
+
+    def set_applied(self, sig: str | None) -> None:
+        """Record the last checkpoint boundary whose rows were applied
+        (atomic publish — the restore-time orphan check reads this)."""
+        self.meta["applied_sig"] = sig
+        self._write_meta(self.path, self.meta)
+
+    # -- row IO ------------------------------------------------------------
+
+    def _written_mask(self, ids: np.ndarray) -> np.ndarray:
+        b = self._written[ids >> 3]
+        return (b >> (ids & 7).astype(np.uint8)) & 1 > 0
+
+    def read_rows(self, ids: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """(table_rows [n, D], accum_rows [n, A]) for logical ``ids`` —
+        written rows from the memmaps, unwritten rows from the lazy init."""
+        ids = np.asarray(ids, np.int64)
+        if ids.size and (ids.min() < 0 or ids.max() >= self.vocab):
+            raise ValueError(
+                f"store read out of range: ids span "
+                f"[{ids.min()}, {ids.max()}] for vocab {self.vocab}"
+            )
+        written = self._written_mask(ids)
+        t = np.empty((ids.size, self.row_dim), np.float32)
+        a = np.empty((ids.size, self.accum_width), np.float32)
+        if written.any():
+            w_ids = ids[written]
+            t[written] = self._table[w_ids]
+            a[written] = self._accum[w_ids]
+        if not written.all():
+            cold = ids[~written]
+            t[~written] = hashed_uniform_rows(
+                cold, self.row_dim, self.meta["seed"], self.meta["init_range"]
+            )
+            a[~written] = np.float32(self.meta["init_accum"])
+        return t, a
+
+    def write_rows(self, ids: np.ndarray, table_rows, accum_rows) -> None:
+        ids = np.asarray(ids, np.int64)
+        if ids.size and (ids.min() < 0 or ids.max() >= self.vocab):
+            raise ValueError(
+                f"store write out of range: ids span "
+                f"[{ids.min()}, {ids.max()}] for vocab {self.vocab}"
+            )
+        self._table[ids] = np.asarray(table_rows, np.float32)
+        self._accum[ids] = np.asarray(accum_rows, np.float32)
+        # OR the written bits in (np fancy-index |= would lose duplicate
+        # byte updates; ids within one write are unique by contract).
+        np.bitwise_or.at(
+            self._written, ids >> 3, (1 << (ids & 7)).astype(np.uint8)
+        )
+
+    def flush(self) -> None:
+        self._table.flush()
+        self._accum.flush()
+        self._written.flush()
+
+    def close(self) -> None:
+        self.flush()
+        # memmaps release with the object; explicit del keeps Windows-ish
+        # semantics obvious and makes close() idempotent-safe.
+        del self._table, self._accum, self._written

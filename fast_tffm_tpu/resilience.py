@@ -145,13 +145,18 @@ FAULT_KINDS = (
     "reload_corrupt",
     "stream_stall",
     "append_torn",
+    "kill_writeback",
 )
 
 # Which ordinal each kind's ``@N`` counts (documented here, enforced by
 # the injection points): kill/nan = absolute training step; io_error =
 # Nth FMB read operation; torn_delta = Kth delta-file write; kill_publish
 # = Kth npz publish (full or delta, in publish order) — SIGKILL between
-# the finished tmp write and the atomic rename, the torn-publish window.
+# the finished tmp write and the atomic rename, the torn-publish window;
+# kill_writeback (ISSUE 12, appended LAST) = Kth paramstore
+# eviction-writeback apply — SIGKILL MID-apply (some cold-store pages
+# dirty, the boundary not yet stamped), the exact window the tiered
+# chain's redo invariant must survive.
 #
 # SERVING kinds (ISSUE 8; executed by tools/chaos.py --serve against a
 # live front end, not by the in-process FaultInjector): ``@N`` is the
@@ -249,7 +254,9 @@ class FaultPlan:
                     # step ordinals span the horizon.
                     hi = (
                         max(2, horizon // 50)
-                        if kind in ("torn_delta", "kill_publish", "append_torn")
+                        if kind
+                        in ("torn_delta", "kill_publish", "append_torn",
+                            "kill_writeback")
                         else max(2, horizon)
                     )
                     events.append({"kind": kind, "at": rng.randrange(1, hi)})
@@ -342,6 +349,9 @@ class FaultInjector:
         self._kill_publish = {
             e["at"] for e in plan.events if e["kind"] == "kill_publish"
         }
+        self._kill_writeback = {
+            e["at"] for e in plan.events if e["kind"] == "kill_writeback"
+        }
         self._io_ops = 0
         self._delta_writes = 0
         self._publishes = 0
@@ -410,6 +420,20 @@ class FaultInjector:
             _record({"event": "injected_kill_publish", "publish": n, "path": path})
             os.kill(os.getpid(), signal.SIGKILL)
 
+    def on_writeback_apply(self, ordinal: int) -> None:
+        """Called by the paramstore's post-publish store apply, AFTER the
+        first chunk of cold-store row writes lands (dirty pages on disk,
+        ``applied_sig`` not yet stamped); SIGKILLs on the Kth apply.  The
+        chain must replay those rows idempotently on restore
+        (test-pinned)."""
+        with self._lock:
+            due = ordinal in self._kill_writeback
+            if due:
+                self._kill_writeback.discard(ordinal)
+        if due:
+            _record({"event": "injected_kill_writeback", "apply": ordinal})
+            os.kill(os.getpid(), signal.SIGKILL)
+
     def on_delta_write(self, path: str) -> None:
         """Called after each delta-file publish; truncates the Kth one to
         simulate a torn write (what a crash mid-copy on a non-atomic
@@ -475,6 +499,14 @@ def maybe_publish_fault(path: str) -> None:
     inj = _ACTIVE
     if inj is not None:
         inj.on_publish(path)
+
+
+def maybe_writeback_fault(ordinal: int) -> None:
+    """Paramstore writeback-apply injection point (no-op unless a plan is
+    armed) — fires mid-apply on the Kth boundary apply."""
+    inj = _ACTIVE
+    if inj is not None:
+        inj.on_writeback_apply(ordinal)
 
 
 # ---------------------------------------------------------------------------

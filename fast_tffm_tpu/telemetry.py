@@ -162,6 +162,21 @@ SCHEMAS: dict[str, tuple[str, ...]] = {
     # is the conjunction of that tick's sentinels (tools/soak.py).
     "quality": ("hour", "auc_online", "auc_batch"),
     "soak": ("phase", "elapsed_s", "ok"),
+    # Tiered parameter store (ISSUE 12; paramstore/): one record per log
+    # window — hot-tier hit rate over gather slots, staged miss rows and
+    # their wire bytes, writeback (staging D2H -> pending overlay) and
+    # resolve costs, coherency restages, and the pending-overlay depth
+    # (rows awaiting their post-publish store apply).
+    "tiering": (
+        "hit_rate",
+        "miss_rows",
+        "miss_bytes_per_step",
+        "writeback_rows",
+        "writeback_ms",
+        "resolve_ms",
+        "restages",
+        "pending_rows",
+    ),
     "summary": ("total_compiles", "steady_compiles", "stalls", "anomalies"),
 }
 

@@ -141,6 +141,7 @@ def _stream(
     shuffle_epoch=None,
     steps_per_call=1,
     skip_batches=0,
+    dedup_guard=False,
     **shard_kw,
 ):
     """Prefetched input stream yielding ``(batch_or_None, parsed, w)``.
@@ -251,6 +252,13 @@ def _stream(
         io_retry_backoff_s=cfg.io_retry_backoff_s,
         **shard_kw,
     )
+    if dedup_guard and cfg.dedup_gather_rows > 0:
+        # Verified-never-trusted (the wire packer's stance): the jitted
+        # dedup gather (trainer.make_dedup_body) silently TRUNCATES a
+        # unique set past its static cap, so every batch is checked on
+        # the host before it ships — a too-small cap is a loud error
+        # naming the knob, never corrupted training.
+        raw = _dedup_cap_guard(raw, cfg.dedup_gather_rows)
     if steps_per_call > 1:
         from fast_tffm_tpu.utils.prefetch import grouped_pairs
 
@@ -296,6 +304,20 @@ def _stream(
     # superbatches in flight already keep the consumer overlapped.
     depth = max(1, cfg.queue_size // max(1, steps_per_call))
     return InputStream(prefetch(gen, depth=depth, stats=stats), stats)
+
+
+def _dedup_cap_guard(raw, cap: int):
+    """Per-batch unique-id bound check for ``dedup_gather_rows`` (runs in
+    the prefetch thread — overlapped like the parse it rides)."""
+    for p, w in raw:
+        u = int(np.unique(p.ids).size)
+        if u > cap:
+            raise ValueError(
+                f"dedup_gather_rows = {cap} but a batch carries {u} unique "
+                "ids — the jitted dedup gather would silently drop rows.  "
+                "Raise dedup_gather_rows (or set 0 to disable)."
+            )
+        yield p, w
 
 
 def _evaluate(
@@ -527,6 +549,7 @@ def _run_training(
     datastats_ids=None,
     accum_restart=None,
     stream_stop=None,
+    paramstore=None,
 ):
     """Shared step loop.  ``train_stream(epoch)`` overrides the per-epoch
     input stream, ``to_batch(parsed, w)`` the host→device batch assembly,
@@ -580,7 +603,7 @@ def _run_training(
         train_stream = lambda epoch, skip_batches=0: _stream(
             cfg, cfg.train_files, max_nnz, epochs=1, to_batch=to_batch,
             shuffle_epoch=epoch, steps_per_call=cfg.steps_per_call,
-            skip_batches=skip_batches,
+            skip_batches=skip_batches, dedup_guard=True,
         )
     if to_batch is None:
         to_batch = Batch.from_parsed
@@ -826,7 +849,11 @@ def _run_training(
         delta_chain_max=cfg.delta_chain_max,
         full_every_s=cfg.delta_full_every_s,
         chain_max_bytes=cfg.delta_chain_max_bytes,
-        vocab=cfg.vocabulary_size,
+        # Tiered runs size the touched-row bitmap at the COMPACT device
+        # capacity (slots), not the logical vocab — a 2^30-row bitmap
+        # would itself be a gigabyte.
+        vocab=(paramstore.capacity if paramstore is not None else cfg.vocabulary_size),
+        paramstore=paramstore,
         table_layout=cfg.table_layout,
         row_dim=row_dim,
         mark_fn=mark_touched,
@@ -996,6 +1023,15 @@ def _run_training(
                             monitor.emit(
                                 "input", step=int(state.step), epoch=epoch, **rec
                             )
+                    if paramstore is not None:
+                        trec = paramstore.stats.drain(
+                            paramstore.pending_rows, paramstore.hot_rows
+                        )
+                        if trec:
+                            monitor.emit(
+                                "tiering", step=int(state.step), epoch=epoch,
+                                **trec,
+                            )
                     losses.clear()
                     meter.reset()
             if stop_requested.is_set():
@@ -1016,6 +1052,15 @@ def _run_training(
                 rec = input_stats.drain()
                 if rec:
                     monitor.emit("input", step=int(state.step), epoch=epoch, **rec)
+            if paramstore is not None:
+                # Same epoch-tail rule for the tiering record.
+                trec = paramstore.stats.drain(
+                    paramstore.pending_rows, paramstore.hot_rows
+                )
+                if trec:
+                    monitor.emit(
+                        "tiering", step=int(state.step), epoch=epoch, **trec
+                    )
             if losses:
                 # Epoch boundary syncs anyway (validation / checkpoint); a
                 # poisoned state must abort BEFORE the save below replaces
@@ -1097,6 +1142,8 @@ def _run_training(
             summary_extra.update(ledger.summary())
         if datastats is not None:
             summary_extra.update(datastats.summary())
+        if paramstore is not None:
+            summary_extra.update(paramstore.summary())
         profiler.close(step_num)
         tracer.close()
         if host_monitor is not None:
@@ -1132,6 +1179,12 @@ def train(cfg: Config, *, resume: bool = False, log=print, step_hook=None):
             f"weight_files has {len(cfg.weight_files)} entries for "
             f"{len(cfg.train_files)} train_files (they align per-file)"
         )
+    if cfg.paramstore:
+        # Beyond-HBM tables: the tiered host/device parameter store
+        # (paramstore/) — its own driver branch because the input path,
+        # the step, validation scoring, and every checkpoint boundary
+        # are residency-aware.
+        return _tiered_train(cfg, resume=resume, log=log, step_hook=step_hook)
     model = build_model(cfg)
     max_nnz = scan_max_nnz(cfg)
     packed = cfg.table_layout == "packed"
@@ -1231,10 +1284,21 @@ def train(cfg: Config, *, resume: bool = False, log=print, step_hook=None):
         # bit-identity the online tests pin).  Packed layouts reject
         # γ < 1 at config.validate, so the packed bodies stay untouched.
         decay = float(cfg.online_adagrad_decay)
-        from fast_tffm_tpu.trainer import make_decayed_body
+        from fast_tffm_tpu.trainer import make_decayed_body, make_dedup_body
 
-        step_body = make_decayed_body(decay) if decay != 1.0 else None
-        step_fn = make_train_step(model, cfg.learning_rate, decay=decay)
+        if cfg.dedup_gather_rows > 0:
+            # Device-side dedup-before-gather (ROADMAP item 2(a)): the
+            # forward gather touches each unique row once; the stream's
+            # host-side guard (_dedup_cap_guard) pins the cap.  Values —
+            # and therefore losses — are bit-identical (test-pinned).
+            step_body = make_dedup_body(cfg.dedup_gather_rows, decay)
+        elif decay != 1.0:
+            step_body = make_decayed_body(decay)
+        else:
+            step_body = None
+        step_fn = make_train_step(
+            model, cfg.learning_rate, decay=decay, body=step_body
+        )
     if cfg.steps_per_call > 1 and not cfg.device_cache:
         # Streamed step fusion: ONE dispatch (and one H2D superbatch
         # transfer) per K steps.  The scan body is the same step body the
@@ -1336,6 +1400,81 @@ def train(cfg: Config, *, resume: bool = False, log=print, step_hook=None):
                 f"batch {rollback_note['skip_to_batch']} "
                 f"(rollback {rollbacks}/{cfg.max_rollbacks})"
             )
+
+
+def _tiered_train(cfg: Config, *, resume: bool, log=print, step_hook=None):
+    """[ParamStore] driver: local training over the two-tier parameter
+    store (paramstore/) — a device-resident hot tier + the full logical
+    table in a memmap-backed host cold store.  The jitted step is the
+    UNCHANGED trainer step over the compact [C, D] table; everything
+    tiered happens around it: the prefetch thread resolves each
+    superbatch (dedup → hit/miss split → remap; paramstore.residency),
+    miss rows ride the packed wire alongside the batch
+    (paramstore.TieredConverter), updated staging rows write back through
+    the pending overlay, and every checkpoint boundary spans both tiers
+    (checkpoint_async + paramstore.ckpt).  Converts the scale ladder from
+    "what fits in HBM" to "what fits on the host": 2^30+ rows on one
+    chip, bit-identical to the resident path at overlapping vocab."""
+    from fast_tffm_tpu.data.wire import make_spec
+    from fast_tffm_tpu.paramstore import TieredConverter, open_tiered_run
+    from fast_tffm_tpu.trainer import (
+        make_decayed_body,
+        make_scanned_train_step,
+        make_train_step,
+    )
+
+    model = build_model(cfg)
+    max_nnz = scan_max_nnz(cfg)
+    server, state, start_cursor = open_tiered_run(
+        cfg, model, max_nnz, resume=resume, log=log
+    )
+    decay = float(cfg.online_adagrad_decay)
+    body = make_decayed_body(decay) if decay != 1.0 else None
+    if cfg.steps_per_call > 1:
+        inner = make_scanned_train_step(model, cfg.learning_rate, body=body)
+    else:
+        inner = make_train_step(model, cfg.learning_rate, decay=decay)
+    step_fn = server.wrap_step(inner)
+    # The wire spec lives at the COMPACT capacity: ids narrow to the
+    # local slot range (e.g. 3 bytes for a 2^30 logical vocab whose
+    # compact tier holds < 2^24 slots).
+    spec = make_spec(
+        server.capacity, max_nnz,
+        with_vals=True, with_fields=model.uses_fields, with_weights=True,
+    )
+    to_batch = TieredConverter(server, spec)
+
+    def train_stream(epoch, skip_batches=0):
+        return _stream(
+            cfg, cfg.train_files, max_nnz, epochs=1, to_batch=to_batch,
+            shuffle_epoch=epoch, steps_per_call=cfg.steps_per_call,
+            skip_batches=skip_batches,
+        )
+
+    def evaluate(cfg_, _predict_step, st, files, max_nnz_):
+        # Residency-aware scoring: hot rows off the live compact state,
+        # miss rows staged read-only through the pending overlay — no
+        # state mutation, so the train state threads through untouched.
+        server.flush_writeback(st)
+        stream = _stream(cfg_, files, max_nnz_, epochs=1, weights=None)
+        meter = StreamingAUC()
+        for _b, parsed, w in stream:
+            scores = np.asarray(server.predict(st, parsed, w))
+            ww = np.ones_like(parsed.labels) if w is None else np.asarray(w)
+            meter.add(parsed.labels, scores, ww)
+        return meter.value()
+
+    def predict_step(_state, _batch):  # pragma: no cover - guard only
+        raise RuntimeError(
+            "tiered runs score through the residency-aware evaluate path"
+        )
+
+    return _run_training(
+        cfg, state, step_fn, predict_step, max_nnz, log,
+        train_stream=train_stream, to_batch=to_batch, evaluate=evaluate,
+        step_hook=step_hook, row_dim=model.row_dim,
+        start_cursor=start_cursor, paramstore=server,
+    )
 
 
 def _device_cached_input(cfg: Config, model, max_nnz: int, log, body=None):
@@ -1517,6 +1656,22 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None, step_
     # pod supervisor — the generation watcher that re-execs this host into
     # the next pod incarnation when a peer is replaced.
     runtime = initialize_runtime(cfg, log=log)
+    if cfg.paramstore:
+        # The tiered store's residency/writeback protocol is single-host
+        # (the pending overlay and the cold store live on ONE host);
+        # sharding the hot tier over a mesh is ROADMAP follow-up work.
+        raise ValueError(
+            "[ParamStore] is local-train only; dist_train shards the "
+            "table over the mesh instead (drop [ParamStore] enabled, or "
+            "run `train`)"
+        )
+    if cfg.dedup_gather_rows > 0:
+        # The sharded step's gather happens inside the lookup collectives
+        # (allgather/alltoall) — the local dedup body does not apply.
+        raise ValueError(
+            "dedup_gather_rows is local-train only (the sharded lookup "
+            "collectives have their own dedup story)"
+        )
     if cfg.online_follow:
         # The follow reader is single-process by construction: an
         # append-only stream has no stable row count to shard, and the

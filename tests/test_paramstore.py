@@ -1,0 +1,517 @@
+"""Tiered host/device parameter store (ISSUE 12 tentpole).
+
+Pins, per the acceptance criteria:
+  * tiered-vs-resident BIT-IDENTITY at overlapping vocab — logged loss
+    sequences, validation AUC, and the full reconstructed logical state
+    (store + hot tier) against the resident checkpoint, on the streamed
+    (K=1 and fused K>1) path and against the device-cached path;
+  * exact-position resume mid-run (prefix/suffix of the uninterrupted
+    run's loss sequence) with residency restored from the checkpoint;
+  * kill-during-eviction-writeback leaves the chain loadable with no
+    lost or stale rows (the new FaultPlan kind, appended LAST so seeded
+    schedules stay byte-identical);
+  * a vocab past the 2^28 device wall (2^30) trains on one chip
+    (sparse-file lazy store);
+  * device-side dedup-before-gather (dedup_gather_rows) losses
+    bit-identical, with a LOUD error when a batch exceeds the cap;
+  * kind=tiering telemetry + report section + --compare --strict gates.
+"""
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from fast_tffm_tpu.checkpoint import restore_checkpoint
+from fast_tffm_tpu.config import Config, build_model
+from fast_tffm_tpu.paramstore import ColdStore, hashed_uniform_rows
+from fast_tffm_tpu.paramstore.residency import ResidencyMap, choose_hot_ids
+from fast_tffm_tpu.resilience import FAULT_KINDS, FaultPlan
+from fast_tffm_tpu.trainer import init_state
+from fast_tffm_tpu.training import train
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+VOCAB = 300
+
+
+def _write_dataset(path, n=300, vocab=VOCAB, nnz=6, seed=0, hot_bias=True):
+    """Synthetic libsvm rows with a skewed id head (so a small hot tier
+    actually absorbs traffic) and reproducible labels."""
+    rng = np.random.default_rng(seed)
+    with open(path, "w") as f:
+        for _ in range(n):
+            if hot_bias:
+                head = rng.integers(0, 20, size=nnz // 2)
+                tail = rng.integers(0, vocab, size=nnz - nnz // 2)
+                ids = np.unique(np.concatenate([head, tail]))[:nnz]
+                while ids.size < nnz:
+                    ids = np.unique(
+                        np.concatenate([ids, rng.integers(0, vocab, size=nnz)])
+                    )[:nnz]
+            else:
+                ids = rng.choice(vocab, size=nnz, replace=False)
+            vals = np.round(np.abs(rng.normal(size=nnz)) + 0.1, 4)
+            y = int(rng.random() < 0.5)
+            f.write(f"{y} " + " ".join(f"{i}:{v}" for i, v in zip(ids, vals)) + "\n")
+
+
+@pytest.fixture
+def ds(tmp_path):
+    p = tmp_path / "train.libsvm"
+    _write_dataset(str(p))
+    v = tmp_path / "valid.libsvm"
+    _write_dataset(str(v), n=100, seed=9)
+    return tmp_path
+
+
+def _cfg(tmp_path, name, **kw):
+    c = Config()
+    c.model = "fm"
+    c.factor_num = 4
+    c.vocabulary_size = VOCAB
+    c.train_files = (str(tmp_path / "train.libsvm"),)
+    c.epoch_num = 2
+    c.batch_size = 32
+    c.learning_rate = 0.1
+    c.log_every = 1
+    c.save_every_epochs = 1
+    c.model_file = str(tmp_path / f"{name}.ckpt")
+    for k, v in kw.items():
+        setattr(c, k, v)
+    return c.validate()
+
+
+def _losses(logs):
+    return [float(l.split("loss ")[1].split()[0]) for l in logs if "loss " in l]
+
+
+def _aucs(logs):
+    return [l for l in logs if "validation auc" in l]
+
+
+def _run(cfg, **kw):
+    logs = []
+    state = train(cfg, log=lambda *a: logs.append(" ".join(map(str, a))), **kw)
+    return state, logs
+
+
+def _tiered_logical(cfg):
+    """Reconstruct the FULL logical (table, accum) of a finished tiered
+    run: cold store (final sync save applied pending) + the npz's hot
+    tier + its pending members (idempotent overlay)."""
+    z = np.load(cfg.model_file)
+    store = ColdStore.open(cfg.paramstore_dir or cfg.model_file + ".store")
+    t, a = store.read_rows(np.arange(cfg.vocabulary_size))
+    ci = np.asarray(z["tier_cold_idx"], np.int64)
+    if ci.size:
+        t[ci] = z["tier_cold_rows"]
+        a[ci] = z["tier_cold_accum"]
+    hi = np.asarray(z["tier_hot_ids"], np.int64)
+    t[hi] = z["table"]
+    a[hi] = z["table_accum"]
+    return t, a
+
+
+# -- cold store -----------------------------------------------------------
+
+
+def test_store_lazy_init_deterministic_and_persistent(tmp_path):
+    p = str(tmp_path / "store")
+    s = ColdStore.create(
+        p, vocab=1000, row_dim=5, accum_width=5, seed=3, init_range=0.02,
+        init_accum=0.1,
+    )
+    ids = np.array([0, 7, 999])
+    t1, a1 = s.read_rows(ids)
+    assert np.all(t1[:, 0] == 0.0)  # bias column
+    assert np.all(np.abs(t1[:, 1:]) < 0.02) and np.any(t1[:, 1:] != 0.0)
+    assert np.all(a1 == np.float32(0.1))
+    # Lazy reads are pure: same rows again, and across a reopen.
+    t2, _ = s.read_rows(ids)
+    assert np.array_equal(t1, t2)
+    s.write_rows(np.array([7]), np.full((1, 5), 2.0), np.full((1, 5), 3.0))
+    s.flush()
+    s2 = ColdStore.open(p)
+    assert s2.fingerprint == s.fingerprint
+    t3, a3 = s2.read_rows(ids)
+    assert np.all(t3[1] == 2.0) and np.all(a3[1] == 3.0)
+    assert np.array_equal(t3[0], t1[0])  # unwritten rows still lazy-init
+    with pytest.raises(ValueError, match="out of range"):
+        s2.read_rows(np.array([1000]))
+
+
+def test_hashed_uniform_rows_shape_and_determinism():
+    a = hashed_uniform_rows(np.array([5, 6]), 4, seed=1, init_range=0.5)
+    b = hashed_uniform_rows(np.array([5, 6]), 4, seed=1, init_range=0.5)
+    c = hashed_uniform_rows(np.array([5, 6]), 4, seed=2, init_range=0.5)
+    assert np.array_equal(a, b)
+    assert not np.array_equal(a, c)
+    assert np.all(a[:, 0] == 0.0) and np.all(np.abs(a) < 0.5)
+
+
+# -- residency ------------------------------------------------------------
+
+
+def test_residency_resolve_remaps_and_dedups():
+    m = ResidencyMap(np.array([10, 3, 50]))  # slots by SORTED rank: 3,10,50
+    ids = [np.array([[3, 99, 10], [99, 7, 3]])]
+    res = m.resolve(ids, miss_capacity=8)
+    assert list(res.miss_ids) == [7, 99]
+    h = m.hot_rows
+    expect = np.array([[0, h + 1, 1], [h + 1, h + 0, 0]])
+    assert np.array_equal(res.remapped[0], expect)
+    assert res.hit_slots == 3 and res.total_slots == 6 and res.unique_ids == 4
+    with pytest.raises(ValueError, match="miss_rows"):
+        m.resolve(ids, miss_capacity=1)
+
+
+def test_choose_hot_ids_policies(tmp_path):
+    assert list(choose_hot_ids("first", 3, 100)) == [0, 1, 2]
+    # sample: exact top-K by (count desc, id asc) — deterministic ties.
+    batches = [np.array([5, 5, 9, 9, 2, 7])]
+    top = choose_hot_ids("sample", 2, 100, sample_batches=iter(batches))
+    assert sorted(top) == [5, 9]
+    f = tmp_path / "hot.txt"
+    f.write_text("42\n42\n17\n3\n")
+    assert list(choose_hot_ids(f"file:{f}", 2, 100)) == [42, 17]
+    with pytest.raises(ValueError, match="residency"):
+        choose_hot_ids("nope", 2, 100)
+
+
+# -- bit-identity ---------------------------------------------------------
+
+
+def test_tiered_bit_identical_to_resident_streamed(ds):
+    res_cfg = _cfg(ds, "resident", validation_files=(str(ds / "valid.libsvm"),))
+    res_state, res_logs = _run(res_cfg)
+    tier_cfg = _cfg(
+        ds, "tiered", validation_files=(str(ds / "valid.libsvm"),),
+        paramstore=True, paramstore_hot_rows=48, delta_every_steps=3,
+    )
+    _state, tier_logs = _run(tier_cfg)
+    assert _losses(res_logs) == _losses(tier_logs)
+    assert _aucs(res_logs) == _aucs(tier_logs)
+    # The reconstructed logical state matches the resident checkpoint
+    # BIT FOR BIT — every row's latest value is in exactly one tier.
+    ref = restore_checkpoint(
+        res_cfg.model_file,
+        init_state(build_model(res_cfg), __import__("jax").random.key(4)),
+    )
+    t, a = _tiered_logical(tier_cfg)
+    assert np.array_equal(t, np.asarray(ref.table))
+    assert np.array_equal(a, np.asarray(ref.table_opt.accum))
+
+
+def test_tiered_bit_identical_fused_and_device_cache(ds):
+    # steps_per_call=2 exercises the superbatch wire + scan; the
+    # device-cache run pins the third driver path to the same sequence.
+    kw = dict(steps_per_call=2, binary_cache=True)
+    _s, res_logs = _run(_cfg(ds, "res_k2", **kw))
+    _s, cache_logs = _run(_cfg(ds, "cache_k2", device_cache=True, **kw))
+    _s, tier_logs = _run(
+        _cfg(ds, "tier_k2", paramstore=True, paramstore_hot_rows=48,
+             delta_every_steps=4, **kw)
+    )
+    assert _losses(res_logs) == _losses(tier_logs)
+    assert _losses(cache_logs) == _losses(tier_logs)
+
+
+def test_tiered_row_accumulator(ds):
+    kw = dict(adagrad_accumulator="row")
+    _s, res_logs = _run(_cfg(ds, "res_row", **kw))
+    _s, tier_logs = _run(
+        _cfg(ds, "tier_row", paramstore=True, paramstore_hot_rows=32, **kw)
+    )
+    assert _losses(res_logs) == _losses(tier_logs)
+
+
+def test_tiered_coherency_restage_stays_exact(ds, tmp_path):
+    # A hot set that misses EVERYTHING (file policy naming never-seen
+    # ids) forces every repeated id through the staging path — with the
+    # prefetch queue running ahead, consecutive-batch repeats go stale
+    # and must restage.  Losses must still match the resident run.
+    hot = tmp_path / "hot_ids.txt"
+    hot.write_text("\n".join(str(i) for i in range(290, 299)))
+    tier_cfg = _cfg(
+        ds, "tier_cold", paramstore=True, paramstore_hot_rows=8,
+        paramstore_residency=f"file:{hot}", metrics_path=str(ds / "m.jsonl"),
+    )
+    _s, tier_logs = _run(tier_cfg)
+    _s, res_logs = _run(_cfg(ds, "res_cold"))
+    assert _losses(res_logs) == _losses(tier_logs)
+    recs = [json.loads(l) for l in open(ds / "m.jsonl") if l.strip()]
+    tier = [r for r in recs if r["kind"] == "tiering"]
+    assert tier, "no kind=tiering records"
+    assert sum(r["restages"] for r in tier) > 0, (
+        "cold residency + queue-ahead resolution should have forced "
+        "coherency restages"
+    )
+    for r in tier:
+        assert r["hit_rate"] <= 0.05  # the hot set really is cold
+
+
+# -- resume / crash-consistency -------------------------------------------
+
+
+def test_tiered_resume_exact(ds):
+    cfg = _cfg(
+        ds, "t_resume", paramstore=True, paramstore_hot_rows=48,
+        delta_every_steps=3,
+    )
+    def hook(step):
+        if step >= 10:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    _s, part1 = _run(cfg, step_hook=hook)
+    _s, part2 = _run(cfg, resume=True)
+    _s, ref = _run(
+        _cfg(ds, "t_ref", paramstore=True, paramstore_hot_rows=48,
+             delta_every_steps=3)
+    )
+    l1, l2, lr = _losses(part1), _losses(part2), _losses(ref)
+    # The SIGTERM step's own window is saved but never logged; everything
+    # around it must match the uninterrupted run exactly.
+    assert l1 == lr[: len(l1)]
+    assert l2 == lr[len(l1) + 1 :]
+    assert any("resumed tiered run" in l for l in part2)
+
+
+def test_tiered_store_replaced_refused(ds):
+    cfg = _cfg(ds, "t_swap", paramstore=True, paramstore_hot_rows=32)
+    _run(cfg)
+    shutil.rmtree(cfg.model_file + ".store")
+    ColdStore.create(
+        cfg.model_file + ".store", vocab=VOCAB, row_dim=5, accum_width=5,
+        seed=0, init_range=0.01, init_accum=0.1,
+    )
+    with pytest.raises(ValueError, match="store was replaced"):
+        train(cfg, resume=True, log=lambda *a: None)
+
+
+def test_resident_restore_refuses_tiered_checkpoint(ds):
+    cfg = _cfg(ds, "t_guard", paramstore=True, paramstore_hot_rows=32)
+    _run(cfg)
+    import jax
+
+    with pytest.raises(ValueError, match="TIERED"):
+        restore_checkpoint(
+            cfg.model_file, init_state(build_model(cfg), jax.random.key(0))
+        )
+
+
+_KILL_CHILD = r"""
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+from fast_tffm_tpu.config import Config
+from fast_tffm_tpu.resilience import FaultPlan, install_faults
+from fast_tffm_tpu.training import train
+import json
+cfg = Config(**json.loads({cfg_json!r}))
+cfg.train_files = tuple(cfg.train_files)
+cfg.validate()
+install_faults(FaultPlan.parse({plan!r}))
+train(cfg, log=print)
+"""
+
+
+# Apply ordinals under this test config (9 batches/epoch, delta_every=3,
+# save_every_epochs=1): #2 = a mid-epoch DELTA boundary's apply; #4 = the
+# apply right after the first epoch-end FULL publish — the window where
+# the store's applied_sig names a link of the chain that publish just
+# unlinked (recoverable via the base's tier_prev_sigs lineage).
+@pytest.mark.parametrize("plan", ["kill_writeback@2", "kill_writeback@4"])
+def test_kill_during_writeback_apply_chain_loadable(ds, plan):
+    """The satellite pin: SIGKILL mid-apply (cold-store pages dirty, the
+    boundary unstamped) must leave base+chain loadable; the resumed run
+    finishes with the exact state of an uninterrupted one — no lost, no
+    stale rows."""
+    cfg = _cfg(
+        ds, "t_kill", paramstore=True, paramstore_hot_rows=48,
+        delta_every_steps=3,
+    )
+    cfg_json = json.dumps(
+        {
+            k: (list(v) if isinstance(v, tuple) else v)
+            for k, v in cfg.__dict__.items()
+        }
+    )
+    r = subprocess.run(
+        [
+            sys.executable, "-c",
+            _KILL_CHILD.format(repo=REPO, cfg_json=cfg_json, plan=plan),
+        ],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == -signal.SIGKILL, (r.returncode, r.stdout, r.stderr)
+    # Chain loadable + resume-to-completion exact vs uninterrupted.
+    _s, part2 = _run(cfg, resume=True)
+    _s, ref = _run(
+        _cfg(ds, "t_kill_ref", paramstore=True, paramstore_hot_rows=48,
+             delta_every_steps=3)
+    )
+    lr = _losses(ref)
+    l2 = _losses(part2)
+    assert l2 == lr[len(lr) - len(l2):]
+    t, a = _tiered_logical(cfg)
+    t_ref, a_ref = _tiered_logical(
+        _cfg(ds, "t_kill_ref", paramstore=True, paramstore_hot_rows=48,
+             delta_every_steps=3)
+    )
+    assert np.array_equal(t, t_ref)
+    assert np.array_equal(a, a_ref)
+
+
+def test_faultplan_kill_writeback_appended_last():
+    assert FAULT_KINDS[-1] == "kill_writeback"
+    plan = FaultPlan.parse("kill_writeback@2,kill@5")
+    assert {e["kind"] for e in plan.events} == {"kill", "kill_writeback"}
+    # Seeded schedules that never name the new kind are byte-identical
+    # to what the pre-ISSUE-12 grammar drew (appended LAST).
+    old = FaultPlan.parse("random:kill=2,io_error=1,torn_delta=1", seed=5)
+    assert "kill_writeback" not in old.to_json()
+    again = FaultPlan.parse("random:kill=2,io_error=1,torn_delta=1", seed=5)
+    assert old.to_json() == again.to_json()
+
+
+# -- beyond-HBM -----------------------------------------------------------
+
+
+def test_beyond_hbm_vocab_trains(tmp_path):
+    """2^30 logical rows — 4x past the measured 2^28 single-chip wall —
+    trains on one chip: the cold store is a sparse lazy file, the device
+    holds only hot + staging rows."""
+    big = tmp_path / "big.libsvm"
+    rng = np.random.default_rng(1)
+    with open(big, "w") as f:
+        for _ in range(64):
+            ids = rng.integers(0, 1 << 30, size=4)
+            f.write("1 " + " ".join(f"{i}:1.0" for i in ids) + "\n")
+    c = Config()
+    c.model = "fm"
+    c.factor_num = 4
+    c.vocabulary_size = 1 << 30
+    c.train_files = (str(big),)
+    c.epoch_num = 1
+    c.batch_size = 16
+    c.log_every = 1
+    c.learning_rate = 0.1
+    c.model_file = str(tmp_path / "big.ckpt")
+    c.paramstore = True
+    c.paramstore_hot_rows = 32
+    c.paramstore_materialize = "auto"  # 2^30 >> bound -> lazy
+    c.delta_every_steps = 2
+    c.adagrad_accumulator = "row"
+    c.validate()
+    _s, logs = _run(c)
+    losses = _losses(logs)
+    assert len(losses) == 4 and all(np.isfinite(losses))
+    # The store files are SPARSE: apparent size is the full table, disk
+    # blocks are only the touched pages.
+    table = os.path.join(c.model_file + ".store", "table.dat")
+    st = os.stat(table)
+    assert st.st_size == (1 << 30) * 5 * 4
+    assert st.st_blocks * 512 < 64 << 20, "store file is not sparse"
+
+
+# -- dedup-before-gather ---------------------------------------------------
+
+
+def test_dedup_gather_bit_identical(ds):
+    _s, ref = _run(_cfg(ds, "dd_ref"))
+    _s, ded = _run(_cfg(ds, "dd_on", dedup_gather_rows=256))
+    assert _losses(ref) == _losses(ded)
+    _s, ded2 = _run(
+        _cfg(ds, "dd_k2", dedup_gather_rows=256, steps_per_call=2,
+             binary_cache=True)
+    )
+    _s, ref2 = _run(_cfg(ds, "dd_ref2", steps_per_call=2, binary_cache=True))
+    assert _losses(ref2) == _losses(ded2)
+
+
+def test_dedup_gather_overflow_is_loud(ds):
+    from fast_tffm_tpu.utils.prefetch import PrefetchError
+
+    with pytest.raises((ValueError, PrefetchError), match="dedup_gather_rows"):
+        train(_cfg(ds, "dd_tiny", dedup_gather_rows=3), log=lambda *a: None)
+
+
+# -- telemetry / report / config ------------------------------------------
+
+
+def test_tiering_telemetry_and_report_section(ds):
+    import importlib.util
+
+    cfg = _cfg(
+        ds, "t_tel", paramstore=True, paramstore_hot_rows=48,
+        delta_every_steps=3, metrics_path=str(ds / "tel.jsonl"),
+    )
+    _run(cfg)
+    recs = [json.loads(l) for l in open(ds / "tel.jsonl") if l.strip()]
+    tier = [r for r in recs if r["kind"] == "tiering"]
+    assert tier
+    from fast_tffm_tpu.telemetry import SCHEMAS
+
+    for r in tier:
+        missing = [k for k in SCHEMAS["tiering"] if k not in r]
+        assert not missing, missing
+        assert 0.0 <= r["hit_rate"] <= 1.0
+    # Steady-state recompiles stay pinned at zero on the tiered path.
+    steady = [
+        r for r in recs if r["kind"] == "compile" and not r.get("warmup")
+    ]
+    assert not steady, steady
+    spec = importlib.util.spec_from_file_location(
+        "report_tool", os.path.join(REPO, "tools", "report.py")
+    )
+    rep = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(rep)
+    s = rep.summarize(recs)
+    assert s["tiering_windows"] == len(tier)
+    assert 0.0 < s["tier_hit_rate_mean"] <= 1.0
+    text = rep.render(s)
+    assert "Parameter store (tiered)" in text
+    # --compare --strict gates: a degraded hit rate (and fatter miss
+    # bytes) past the threshold regress.
+    worse = dict(s, tier_hit_rate_mean=s["tier_hit_rate_mean"] * 0.5,
+                 tier_miss_bytes_per_step=(s["tier_miss_bytes_per_step"] or 1) * 3)
+    _md, regressions = rep.compare(worse, s, threshold=0.15, strict=True)
+    joined = "\n".join(regressions)
+    assert "hit rate regressed" in joined
+    assert "miss bytes/step regressed" in joined
+    _md, ok = rep.compare(s, s, threshold=0.15, strict=True)
+    assert not [r for r in ok if "paramstore" in r]
+
+
+def test_paramstore_config_rejections():
+    def mk(**kw):
+        c = Config()
+        c.train_files = ("x.libsvm",)
+        for k, v in kw.items():
+            setattr(c, k, v)
+        return c
+
+    with pytest.raises(ValueError, match="table_layout = rows"):
+        mk(paramstore=True, table_layout="packed").validate()
+    with pytest.raises(ValueError, match="device_cache"):
+        mk(paramstore=True, device_cache=True).validate()
+    with pytest.raises(ValueError, match="async_save"):
+        mk(paramstore=True, async_save=True).validate()
+    with pytest.raises(ValueError, match="npz"):
+        mk(paramstore=True, checkpoint_format="orbax").validate()
+    with pytest.raises(ValueError, match="rollback"):
+        mk(paramstore=True, on_nan="rollback").validate()
+    with pytest.raises(ValueError, match="redundant"):
+        mk(paramstore=True, dedup_gather_rows=8).validate()
+    with pytest.raises(ValueError, match="local-train only"):
+        from fast_tffm_tpu.training import dist_train
+
+        dist_train(mk(paramstore=True).validate(), log=lambda *a: None)
+    with pytest.raises(ValueError, match="rows"):
+        mk(dedup_gather_rows=8, table_layout="packed").validate()
+    mk(paramstore=True).validate()  # the plain enablement is legal

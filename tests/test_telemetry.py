@@ -96,6 +96,15 @@ _DRIVER_PAYLOADS = {
         phase="steady", elapsed_s=61.2, ok=True, unanswered=0,
         freshness_scored_p99_ms=212.4, chain_len=5, disk_bytes=1048576,
     ),
+    # Tiered parameter store (ISSUE 12): the per-log-window residency
+    # record the training loop drains from paramstore stats.
+    "tiering": dict(
+        hit_rate=0.6103, miss_rows=812, miss_rows_per_step=203.0,
+        miss_bytes_per_step=58464, wire_bytes_per_step=23040,
+        dedup_ratio=0.2954, writeback_rows=812, writeback_ms=1.9,
+        resolve_ms=3.2, restages=0, pending_rows=812, hot_rows=4096,
+        apply_rows=0, apply_ms=0.0,
+    ),
 }
 
 

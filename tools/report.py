@@ -504,6 +504,43 @@ def summarize(records: list[dict]) -> dict:
         round(statistics.median(rep_mttrs), 3) if rep_mttrs else None
     )
     s["replica_mttr_s_max"] = round(max(rep_mttrs), 3) if rep_mttrs else None
+    # Tiered parameter store (ISSUE 12; paramstore/): per-log-window
+    # residency records.  The hit rate and miss bytes are the two numbers
+    # --compare --strict gates on: a hot set gone stale (hit rate down)
+    # or a staging path gone fat (miss bytes up) are regressions even
+    # when raw throughput holds.
+    tier = kinds.get("tiering", [])
+    s["tiering_windows"] = len(tier)
+    hits = [r["hit_rate"] for r in tier if isinstance(r.get("hit_rate"), (int, float))]
+    s["tier_hit_rate_mean"] = round(sum(hits) / len(hits), 4) if hits else None
+    mbytes = [
+        r["miss_bytes_per_step"]
+        for r in tier
+        if isinstance(r.get("miss_bytes_per_step"), (int, float))
+    ]
+    s["tier_miss_bytes_per_step"] = (
+        int(statistics.median(mbytes)) if mbytes else None
+    )
+    s["tier_miss_rows"] = sum(r.get("miss_rows") or 0 for r in tier) if tier else None
+    s["tier_writeback_rows"] = (
+        sum(r.get("writeback_rows") or 0 for r in tier) if tier else None
+    )
+    wb_ms = sum(
+        (r.get("writeback_ms") or 0) + (r.get("apply_ms") or 0) for r in tier
+    )
+    s["tier_writeback_ms_total"] = round(wb_ms, 1) if tier else None
+    # Writeback stall share: staging D2H + store applies as a fraction of
+    # wall clock — the tiered sibling of ckpt_stall_share.
+    s["tier_writeback_share"] = (
+        round(wb_ms / 1e3 / s["duration_s"], 4)
+        if tier and s["duration_s"]
+        else None
+    )
+    s["tier_restages"] = sum(r.get("restages") or 0 for r in tier) if tier else None
+    s["tier_pending_rows_max"] = (
+        max((r.get("pending_rows") or 0) for r in tier) if tier else None
+    )
+    s["tier_hot_rows"] = tier[-1].get("hot_rows") if tier else None
     predict = kinds.get("predict", [])
     s["predict_last"] = predict[-1] if predict else None
     summary = kinds.get("summary", [])
@@ -581,6 +618,31 @@ def render(s: dict, title: str = "run") -> str:
                 if s.get("ckpt_stall_share") is not None
                 else ""
             )
+        )
+        L.append("")
+    if s.get("tiering_windows"):
+        L += ["## Parameter store (tiered)", ""]
+        L.append(
+            f"- hot tier {_fmt(s['tier_hot_rows'], 0)} rows, "
+            f"hit rate {_fmt(100 * (s['tier_hit_rate_mean'] or 0), 2)}% of "
+            "gather slots"
+        )
+        L.append(
+            f"- miss bytes/step {_fmt_bytes(s['tier_miss_bytes_per_step'])} "
+            f"({_fmt(s['tier_miss_rows'], 0)} staged rows total)"
+        )
+        L.append(
+            f"- writeback: {_fmt(s['tier_writeback_rows'], 0)} rows, "
+            f"{_fmt(s['tier_writeback_ms_total'])} ms stall"
+            + (
+                f" ({100 * s['tier_writeback_share']:.1f}% of wall clock)"
+                if s.get("tier_writeback_share") is not None
+                else ""
+            )
+        )
+        L.append(
+            f"- coherency restages: {s['tier_restages']}, pending peak "
+            f"{_fmt(s['tier_pending_rows_max'], 0)} rows"
         )
         L.append("")
     L += ["## Events", ""]
@@ -866,6 +928,9 @@ _GATE_METRICS = [
     ("quality_auc_online_mean", "backtest online AUC (mean)", True),
     ("quality_auc_gap_max", "backtest worst-hour AUC gap", False),
     ("soak_failures", "failed soak sentinel ticks", False),
+    ("tier_hit_rate_mean", "paramstore hot-tier hit rate", True),
+    ("tier_miss_bytes_per_step", "paramstore miss bytes/step", False),
+    ("tier_restages", "paramstore coherency restages", False),
 ]
 
 
@@ -1003,6 +1068,38 @@ def compare(run: dict, base: dict, threshold: float, strict: bool = False):
             regressions.append(
                 f"{run['soak_failures']} soak sentinel tick(s) failed "
                 f"(phases: {', '.join(run.get('soak_failed_phases') or [])})"
+            )
+        # Tiered-parameter-store gates (ISSUE 12): a hot-tier HIT-RATE
+        # drop past the threshold (the residency decision got worse — the
+        # staging path absorbs gathers the hot tier should) and a
+        # MISS-BYTES-per-step increase past it (the wire/staging traffic
+        # the hit rate is supposed to bound).  Both only when both runs
+        # are tiered.
+        rh, bh = run.get("tier_hit_rate_mean"), base.get("tier_hit_rate_mean")
+        if (
+            isinstance(rh, (int, float))
+            and isinstance(bh, (int, float))
+            and bh > 0
+            and rh < bh * (1 - threshold)
+        ):
+            regressions.append(
+                f"paramstore hit rate regressed {(bh - rh) / bh * 100:.1f}% "
+                f"(> {threshold * 100:.0f}%): {bh} -> {rh}"
+            )
+        rm, bm = (
+            run.get("tier_miss_bytes_per_step"),
+            base.get("tier_miss_bytes_per_step"),
+        )
+        if (
+            isinstance(rm, (int, float))
+            and isinstance(bm, (int, float))
+            and bm > 0
+            and rm > bm * (1 + threshold)
+        ):
+            regressions.append(
+                f"paramstore miss bytes/step regressed "
+                f"{(rm - bm) / bm * 100:.1f}% (> {threshold * 100:.0f}%): "
+                f"{bm} -> {rm}"
             )
         # Checkpoint stall share regression: the run spends a meaningfully
         # larger fraction of wall clock blocked on saves than the base did.
