@@ -449,7 +449,8 @@ class AsyncCheckpointer:
                     cursor=cursor,
                 )
             except Exception:
-                self.write_failures += 1
+                with self._lock:
+                    self.write_failures += 1
                 self._publish_outcome(bseq, None, "failed")
                 raise  # a SYNC save failing must surface — it is the last line
             self._on_full_published(sid)
@@ -495,16 +496,24 @@ class AsyncCheckpointer:
         t0 = time.perf_counter()
         self._drain(count=True)
         self._await_pending(count=True)
+        # Snapshot the chain state under the lock: the (drained) writer
+        # thread updates it there, and the promote decision must not read
+        # a torn parent/len/bytes triple.
+        with self._lock:
+            parent_sig = self._parent_sig
+            chain_len = self._chain_len
+            chain_bytes = self._chain_bytes
+            last_full_t = self._last_full_t
         if (
-            self._parent_sig is None
-            or self._chain_len >= self._chain_max
+            parent_sig is None
+            or chain_len >= self._chain_max
             or (
                 self._full_every_s > 0
-                and time.monotonic() - self._last_full_t >= self._full_every_s
+                and time.monotonic() - last_full_t >= self._full_every_s
             )
             or (
                 self._chain_bytes_max > 0
-                and self._chain_bytes >= self._chain_bytes_max
+                and chain_bytes >= self._chain_bytes_max
             )
         ):
             return self.save_boundary(state, saveable, step)
@@ -544,7 +553,8 @@ class AsyncCheckpointer:
         dense = [_device_copy(x) for x in jax.tree.leaves(state.dense)]
         dacc = [_device_copy(x) for x in jax.tree.leaves(state.dense_opt.accum)]
         step_arr = _device_copy(state.step)
-        seq, parent = self._next_seq, self._parent_sig
+        with self._lock:
+            seq, parent = self._next_seq, self._parent_sig
         cursor = self._merged_cursor(bseq)
         if self._lead_writer and not self._is_writer:
             # The gather/copies above were this host's share of the
@@ -580,7 +590,8 @@ class AsyncCheckpointer:
                 save_id=sid, cursor=cursor, chunk_bytes=self._chunk,
             )
         except Exception:
-            self.write_failures += 1
+            with self._lock:
+                self.write_failures += 1
             raise  # tiered saves are sync — a failure must surface
         self._on_full_published(sid)
         self._apply_tiered(sid)
@@ -631,7 +642,8 @@ class AsyncCheckpointer:
         idx = np.concatenate([hot_ids, pend_ids])
         t_all = np.concatenate([np.asarray(trows)[:n_hot], pend_t])
         a_all = np.concatenate([np.asarray(arows)[:n_hot], pend_a])
-        seq, parent = self._next_seq, self._parent_sig
+        with self._lock:
+            seq, parent = self._next_seq, self._parent_sig
         stall_ms = (time.perf_counter() - t0) * 1e3
         timings: dict = {}
         try:
@@ -651,7 +663,8 @@ class AsyncCheckpointer:
         except Exception as e:
             # Mirror the async writer's contract: the chain on disk stays
             # complete; the next boundary promotes itself to a full save.
-            self.write_failures += 1
+            with self._lock:
+                self.write_failures += 1
             self._on_write_failed()
             try:
                 self._log(f"tiered delta write failed (chain intact): {e!r}")
@@ -663,7 +676,7 @@ class AsyncCheckpointer:
             self._next_seq = seq + 1
             self._chain_len += 1
             self._chain_bytes += int(nbytes)
-        self.delta_saves += 1
+            self.delta_saves += 1
         self._apply_tiered(sid)
         self._emit(
             "delta", step, timings, nbytes=nbytes, rows=int(idx.size),
@@ -677,7 +690,8 @@ class AsyncCheckpointer:
         try:
             self._ps.apply_pending(sid)
         except Exception as e:
-            self.write_failures += 1
+            with self._lock:
+                self.write_failures += 1
             try:
                 self._log(
                     f"paramstore apply failed after publish (pending rows "
@@ -741,7 +755,8 @@ class AsyncCheckpointer:
             )
             self._on_full_published(sid)
             self._publish_outcome(bseq, sid, "full")
-            self.full_saves += 1
+            with self._lock:
+                self.full_saves += 1
             if emit:
                 self._emit(
                     "full", step, timings, nbytes=nbytes or 0,
@@ -750,7 +765,8 @@ class AsyncCheckpointer:
                     train_stall_ms=stall_ms,
                 )
         except Exception as e:
-            self.write_failures += 1
+            with self._lock:
+                self.write_failures += 1
             self._on_write_failed()
             self._publish_outcome(bseq, None, "failed")
             try:
@@ -795,8 +811,8 @@ class AsyncCheckpointer:
                 self._next_seq = seq + 1
                 self._chain_len += 1
                 self._chain_bytes += int(nbytes)
+                self.delta_saves += 1
             self._publish_outcome(bseq, sid, "delta")
-            self.delta_saves += 1
             timings["d2h_ms"] = timings.get("d2h_ms", 0.0) + d2h_ms
             self._emit(
                 "delta", step, timings, nbytes=nbytes, rows=n,
@@ -804,7 +820,8 @@ class AsyncCheckpointer:
                 train_stall_ms=stall_ms,
             )
         except Exception as e:
-            self.write_failures += 1
+            with self._lock:
+                self.write_failures += 1
             self._on_write_failed()
             self._publish_outcome(bseq, None, "failed")
             try:
@@ -856,13 +873,14 @@ class AsyncCheckpointer:
 
     def summary(self) -> dict:
         """End-of-run counters, merged into the kind=summary record."""
-        out = {
-            "ckpt_full_saves": self.full_saves,
-            "ckpt_delta_saves": self.delta_saves,
-            "ckpt_sync_saves": self.sync_saves,
-            "ckpt_write_failures": self.write_failures,
-            "ckpt_blocked_boundaries": self.blocked_boundaries,
-        }
+        with self._lock:
+            out = {
+                "ckpt_full_saves": self.full_saves,
+                "ckpt_delta_saves": self.delta_saves,
+                "ckpt_sync_saves": self.sync_saves,
+                "ckpt_write_failures": self.write_failures,
+                "ckpt_blocked_boundaries": self.blocked_boundaries,
+            }
         if self.blocked_ms:
             out["ckpt_blocked_ms"] = round(self.blocked_ms, 3)
         return {k: v for k, v in out.items() if v}

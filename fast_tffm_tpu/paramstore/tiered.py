@@ -404,6 +404,7 @@ class TieredParamServer:
             return state, loss
 
         if hasattr(inner_step, "lower"):
+            # analysis: ok recompile-hazard delegated CostLedger .lower hook, not a second compile
             step.lower = lambda st, tb: inner_step.lower(st, tb.batch)
         return step
 

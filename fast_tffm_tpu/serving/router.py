@@ -256,10 +256,13 @@ class Router:
         )
         self._closed = False
         self._stop = threading.Event()
-        self._id_lock = threading.Lock()
         self._next_id = itertools.count(1)
         self._rr = itertools.count()
         # Cross-replica counters (the router's own story for report.py).
+        # Reader/health/watch threads and callers all bump these; every
+        # += is a read-modify-write, so they share one leaf lock (never
+        # held across a call — no ordering edges).
+        self._stats_lock = threading.Lock()
         self.failovers = 0  # requests re-sent to a peer after a death
         self.failed_unanswerable = 0  # typed `unavailable` failures
         self.reload_fanouts = 0  # signature changes fanned out
@@ -388,8 +391,10 @@ class Router:
         req_id = next(self._next_id)
         msg = dict(pending.msg)
         msg["id"] = req_id
-        pending.msg = msg
         with slot.lock:
+            # The msg swap rides the slot lock too: a failover retry
+            # re-registers a pending another thread may still observe.
+            pending.msg = msg
             slot.pending[req_id] = pending
             slot.requests += 1
         return req_id
@@ -420,7 +425,8 @@ class Router:
                     self._fail_unanswerable(stranded)
                 else:
                     stranded.retried = True
-                    self.failovers += 1
+                    with self._stats_lock:
+                        self.failovers += 1
                     if not self._dispatch(stranded):
                         self._fail_unanswerable(stranded)
         return True
@@ -453,7 +459,8 @@ class Router:
         if deadline_at is not None:
             msg["deadline_at"] = deadline_at
         if not self._dispatch(_Pending(msg, fut)):
-            self.failed_unanswerable += 1
+            with self._stats_lock:
+                self.failed_unanswerable += 1
             fut.set_exception(Unavailable("no healthy replica"))
         return fut
 
@@ -552,8 +559,9 @@ class Router:
                     )
             return
         if pending.kind == "reload":
-            slot.reload_acks += 1
-            slot.last_reload = msg
+            with slot.lock:
+                slot.reload_acks += 1
+                slot.last_reload = msg
             pending.future.set_result(msg)
             if msg.get("status") in ("staged", "staged_delta"):
                 self._note_reload_staged(slot, msg, pending.gen)
@@ -682,7 +690,8 @@ class Router:
                 self._fail_unanswerable(pending)
                 continue
             pending.retried = True
-            self.failovers += 1
+            with self._stats_lock:
+                self.failovers += 1
             if not self._dispatch(pending):
                 self._fail_unanswerable(pending)
         if not self._stop.is_set():
@@ -694,21 +703,25 @@ class Router:
             ).start()
 
     def _fail_unanswerable(self, pending: _Pending) -> None:
-        self.failed_unanswerable += 1
+        with self._stats_lock:
+            self.failed_unanswerable += 1
         if not pending.future.done():
             pending.future.set_exception(
                 Unavailable("replica died mid-flight and no healthy peer could retry")
             )
 
     def _restart_loop(self, slot: _Slot) -> None:
-        slot.state = "restarting"
+        with slot.lock:
+            slot.state = "restarting"
         rc = slot.handle.returncode if slot.handle is not None else None
         while not self._stop.is_set():
-            slot.restarts += 1
-            attempt = slot.restarts
+            with slot.lock:
+                slot.restarts += 1
+                attempt = slot.restarts
             backoff = self._policy.backoff(attempt)
             if backoff is None:
-                slot.state = "failed"
+                with slot.lock:
+                    slot.state = "failed"
                 self._log(
                     f"router: giving up on replica {slot.index} after "
                     f"{attempt - 1} restart(s) (restart_max "
@@ -736,7 +749,8 @@ class Router:
             mttr = None
             if slot.death_t is not None:
                 mttr = round(time.monotonic() - slot.death_t, 3)
-                self.mttr_s.append(mttr)
+                with self._stats_lock:
+                    self.mttr_s.append(mttr)
             self._log(
                 f"router: replica {slot.index} back (restart #{attempt}, "
                 f"MTTR {mttr}s)"
@@ -799,10 +813,12 @@ class Router:
                 continue
             if sig != last_sig:
                 last_sig = sig
-                self.reload_fanouts += 1
+                with self._stats_lock:
+                    self.reload_fanouts += 1
                 why = "checkpoint changed"
             else:
-                self.reload_retries += 1
+                with self._stats_lock:
+                    self.reload_retries += 1
                 why = "re-driving a failed/deferred reload"
             targets = self.healthy_replicas()
             self._log(
@@ -842,14 +858,18 @@ class Router:
                     "reload_acks": s.reload_acks,
                 }
             )
+        with self._stats_lock:
+            counters = {
+                "failovers": self.failovers,
+                "failed_unanswerable": self.failed_unanswerable,
+                "reload_fanouts": self.reload_fanouts,
+                "reload_retries": self.reload_retries,
+                "mttr_s": list(self.mttr_s),
+            }
         return {
             "run_id": self.run_id,
             "replicas": reps,
-            "failovers": self.failovers,
-            "failed_unanswerable": self.failed_unanswerable,
-            "reload_fanouts": self.reload_fanouts,
-            "reload_retries": self.reload_retries,
-            "mttr_s": list(self.mttr_s),
+            **counters,
             "freshness_staged_ms": self.freshness_percentiles(),
         }
 
@@ -893,6 +913,7 @@ class Router:
                 slot.pending.clear()
                 sock, slot.sock = slot.sock, None
                 ctrl, slot.ctrl = slot.ctrl, None
+                slot.state = "dead"
             for p in orphans:
                 if not p.future.done():
                     p.future.set_exception(Unavailable("router closed"))
@@ -907,7 +928,6 @@ class Router:
                         s.close()
                     except OSError:
                         pass
-            slot.state = "dead"
         deadline = time.monotonic() + timeout
         for slot in self.slots:
             h = slot.handle
@@ -919,9 +939,12 @@ class Router:
                 h.wait(timeout=2.0)
         try:
             fresh = self.freshness_percentiles()
+            with self._stats_lock:
+                failovers = self.failovers
+                unanswerable = self.failed_unanswerable
             self._monitor.close(
-                router_failovers=self.failovers,
-                router_unanswerable=self.failed_unanswerable,
+                router_failovers=failovers,
+                router_unanswerable=unanswerable,
                 router_restarts=sum(s.restarts for s in self.slots),
                 **(
                     {"router_freshness_staged_p99_ms": fresh["p99"]}

@@ -1578,8 +1578,8 @@ def _device_cached_input(cfg: Config, model, max_nnz: int, log, body=None):
             # Measured-cost hook (profiling.CostLedger): expose the inner
             # jit's .lower so the closure stays profileable.
             if perm_ref[0] is not None:
-                return stepk_shuffled.lower(st, perm_ref[0], idxs)
-            return stepk.lower(st, idxs)
+                return stepk_shuffled.lower(st, perm_ref[0], idxs)  # analysis: ok recompile-hazard this IS the ledger's delegated .lower hook
+            return stepk.lower(st, idxs)  # analysis: ok recompile-hazard this IS the ledger's delegated .lower hook
 
         step_fn.lower = _lower_k
         return (
@@ -1605,8 +1605,8 @@ def _device_cached_input(cfg: Config, model, max_nnz: int, log, body=None):
 
     def _lower(st, i):
         if perm_ref[0] is not None:
-            return cached_step_shuffled.lower(st, perm_ref[0], i)
-        return cached_step.lower(st, i)
+            return cached_step_shuffled.lower(st, perm_ref[0], i)  # analysis: ok recompile-hazard this IS the ledger's delegated .lower hook
+        return cached_step.lower(st, i)  # analysis: ok recompile-hazard this IS the ledger's delegated .lower hook
 
     step_fn.lower = _lower
     return (

@@ -1,0 +1,857 @@
+"""Invariant checker suite (tools/analysis/, ISSUE 13).
+
+Table-driven positive/negative fixtures per rule — each checker must
+catch a DISTILLED version of the historical bug it targets (the PR-7
+fresh-jit-per-save recompile, the PR-8 unlocked reload-retry flag, a
+donated-then-read array, a dead config key, an unregistered telemetry
+kind) and stay quiet on the idiomatic fix — plus baseline round-trip,
+suppression-comment parsing, the end-to-end exit-code contract on an
+injected mini repo, and the whole-repo --strict smoke run that IS the
+tier-1 gate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from analysis import core  # noqa: E402
+from analysis.check_config import ConfigChecker  # noqa: E402
+from analysis.check_donation import DonationChecker  # noqa: E402
+from analysis.check_locks import LockChecker  # noqa: E402
+from analysis.check_recompile import RecompileChecker  # noqa: E402
+from analysis.check_telemetry import TelemetryChecker  # noqa: E402
+
+RUN_PY = os.path.join(REPO, "tools", "analysis", "run.py")
+
+
+def ctx_of(tmp_path, files: dict[str, str]) -> core.RepoContext:
+    rels = []
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+        rels.append(rel)
+    return core.RepoContext(str(tmp_path), rels)
+
+
+def rules_hit(findings):
+    return {(f.rule, f.context) for f in findings}
+
+
+# -- donation-after-use ----------------------------------------------------
+
+DONATION_BUG = '''
+import jax
+from functools import partial
+
+@partial(jax.jit, donate_argnums=(0,))
+def step(state, batch):
+    return state + batch
+
+def train(state, batches):
+    for b in batches:
+        out = step(state, b)
+        total = state.sum()      # read-after-donate (the distilled bug)
+        state = out
+    return state
+'''
+
+DONATION_OK = '''
+import jax
+from functools import partial
+
+@partial(jax.jit, donate_argnums=(0,))
+def step(state, batch):
+    return state + batch
+
+def train(state, batches):
+    for b in batches:
+        state = step(state, b)   # the rebinding idiom
+    return state
+
+def snapshot_first(state, b):
+    snap = jax.tree.map(lambda x: x, state)
+    state = step(state, b)
+    return state, snap
+'''
+
+DONATION_ATTR_BUG = '''
+import jax
+
+mark = jax.jit(lambda bm, ids: bm, donate_argnums=(0,))
+
+class C:
+    def note(self, ids):
+        mark(self._bitmap, ids)
+        return self._bitmap.sum()   # donated self-attr read back
+'''
+
+DONATION_ARGNAMES_BUG = '''
+import jax
+
+def _mark(bitmap, ids):
+    return bitmap
+
+mark = jax.jit(_mark, donate_argnames=("bitmap",))
+
+def go(bm, ids):
+    mark(bm, ids)
+    return bm + 1                   # donate_argnames resolve to positions
+'''
+
+
+@pytest.mark.parametrize(
+    "src,expect",
+    [
+        (DONATION_BUG, True),
+        (DONATION_OK, False),
+        (DONATION_ATTR_BUG, True),
+        (DONATION_ARGNAMES_BUG, True),
+    ],
+    ids=["loop-read-after-donate", "rebind-idiom-ok", "self-attr", "argnames"],
+)
+def test_donation_fixtures(tmp_path, src, expect):
+    ctx = ctx_of(tmp_path, {"mod.py": src})
+    findings = DonationChecker().run(ctx)
+    assert bool(findings) == expect, [f.render() for f in findings]
+    if expect:
+        assert all(f.rule == "donation-after-use" for f in findings)
+
+
+# -- recompile-hazard ------------------------------------------------------
+
+# The PR-7 bug, distilled: a fresh jit per save call (built in a method,
+# used once, never cached).
+RECOMPILE_PR7 = '''
+import jax
+
+class Saver:
+    def save(self, state, sharding):
+        replicate = jax.jit(lambda x: x, out_shardings=sharding)
+        return replicate(state)
+'''
+
+RECOMPILE_PR7_FIXED = '''
+import jax
+
+class Saver:
+    def __init__(self, sharding):
+        self._replicate = jax.jit(lambda x: x, out_shardings=sharding)
+
+    def save(self, state):
+        return self._replicate(state)
+'''
+
+RECOMPILE_IN_LOOP = '''
+import jax
+
+def sweep(xs):
+    out = []
+    for x in xs:
+        f = jax.jit(lambda a: a * 2)   # fresh trace+compile per iteration
+        out.append(f(x))
+    return out
+'''
+
+RECOMPILE_FACTORY_OK = '''
+import jax
+
+def make_step(lr):
+    def body(s, b):
+        return s - lr * b
+    step = jax.jit(body)
+    return step                        # factory: caller caches it
+
+CACHE = {}
+
+def cached(key, fn):
+    CACHE[key] = jax.jit(fn)           # memoized: ok
+    return CACHE[key]
+'''
+
+RECOMPILE_SCALAR = '''
+import jax
+
+step = jax.jit(lambda s, k: s * k)
+
+def run(s):
+    for k in range(10):
+        s = step(s, k)                 # every k retraces
+    return s
+'''
+
+RECOMPILE_LOWER = '''
+def measure(fn, args):
+    low = fn.lower(*args)              # out-of-ledger re-lowering
+    return low.compile().cost_analysis()
+'''
+
+RECOMPILE_STR_LOWER_OK = '''
+def norm(cfg):
+    return cfg.model.lower()           # zero-arg str.lower, not jax
+'''
+
+
+@pytest.mark.parametrize(
+    "src,expect,ctx_kind",
+    [
+        (RECOMPILE_PR7, True, "uncached-jit"),
+        (RECOMPILE_PR7_FIXED, False, None),
+        (RECOMPILE_IN_LOOP, True, "jit-in-loop"),
+        (RECOMPILE_FACTORY_OK, False, None),
+        (RECOMPILE_SCALAR, True, "scalar:k"),
+        (RECOMPILE_LOWER, True, "lower"),
+        (RECOMPILE_STR_LOWER_OK, False, None),
+    ],
+    ids=[
+        "pr7-fresh-jit-per-save", "pr7-fixed", "jit-in-loop", "factory-ok",
+        "loop-scalar", "out-of-ledger-lower", "str-lower-ok",
+    ],
+)
+def test_recompile_fixtures(tmp_path, src, expect, ctx_kind):
+    # under the package prefix so the .lower rule engages
+    ctx = ctx_of(tmp_path, {"fast_tffm_tpu/mod.py": src})
+    findings = RecompileChecker().run(ctx)
+    assert bool(findings) == expect, [f.render() for f in findings]
+    if expect:
+        assert any(ctx_kind in f.context for f in findings), [
+            f.context for f in findings
+        ]
+
+
+# -- lock-discipline / lock-order ------------------------------------------
+
+# The PR-8 bug, distilled: a reader thread sets a retry flag, the watch
+# tick clears it — no lock anywhere.
+LOCKS_PR8 = '''
+import threading
+
+class Watcher:
+    def __init__(self):
+        self._retry = False
+        threading.Thread(target=self._read_loop, daemon=True).start()
+
+    def _read_loop(self):
+        while True:
+            self._retry = True      # reader thread writes, unguarded
+
+    def tick(self):
+        retry = self._retry
+        self._retry = False         # caller clears — the lost-ack race
+        return retry
+'''
+
+LOCKS_PR8_FIXED = '''
+import threading
+
+class Watcher:
+    def __init__(self):
+        self._retry = False
+        self._retry_lock = threading.Lock()
+        threading.Thread(target=self._read_loop, daemon=True).start()
+
+    def _read_loop(self):
+        while True:
+            with self._retry_lock:
+                self._retry = True
+
+    def tick(self):
+        with self._retry_lock:
+            retry, self._retry = self._retry, False
+        return retry
+'''
+
+LOCKS_TRAMPOLINE = '''
+import threading
+
+class Ckpt:
+    def __init__(self):
+        self.saves = 0
+
+    def _spawn(self, fn, args):
+        threading.Thread(target=fn, args=args).start()
+
+    def boundary(self, state):
+        self._spawn(self._write, (state,))
+
+    def _write(self, state):
+        self.saves += 1             # writer thread, unguarded counter
+
+    def summary(self):
+        return {"saves": self.saves}
+'''
+
+LOCKS_GUARANTEED_HELD_OK = '''
+import threading
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sig = None
+        threading.Thread(target=self._watch, daemon=True).start()
+
+    def _watch(self):
+        while True:
+            with self._lock:
+                self._attempt()
+
+    def _attempt(self):
+        self._sig = "new"           # only ever called with _lock held
+
+    def tick(self):
+        with self._lock:
+            self._attempt()
+'''
+
+LOCKS_ORDER_CYCLE = '''
+import threading
+
+class AB:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        threading.Thread(target=self._t, daemon=True).start()
+
+    def _t(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def other(self):
+        with self._b:
+            with self._a:           # opposite order: deadlock
+                pass
+'''
+
+
+@pytest.mark.parametrize(
+    "src,rule,expect",
+    [
+        (LOCKS_PR8, "lock-discipline", True),
+        (LOCKS_PR8_FIXED, "lock-discipline", False),
+        (LOCKS_TRAMPOLINE, "lock-discipline", True),
+        (LOCKS_GUARANTEED_HELD_OK, "lock-discipline", False),
+        (LOCKS_ORDER_CYCLE, "lock-order", True),
+    ],
+    ids=[
+        "pr8-unlocked-flag", "pr8-fixed", "spawn-trampoline",
+        "caller-held-lock-ok", "order-cycle",
+    ],
+)
+def test_lock_fixtures(tmp_path, src, rule, expect):
+    ctx = ctx_of(tmp_path, {"fast_tffm_tpu/mod.py": src})
+    findings = [f for f in LockChecker().run(ctx) if f.rule == rule]
+    assert bool(findings) == expect, [f.render() for f in findings]
+
+
+def test_lock_cross_object_annotation(tmp_path):
+    """Router-style: mutations of another class's fields resolve through
+    the parameter annotation and attribute to that class."""
+    src = '''
+import threading
+
+class _Slot:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.state = "starting"
+
+class Router:
+    def __init__(self):
+        self.slots = [_Slot() for _ in range(2)]
+        threading.Thread(target=self._health, daemon=True).start()
+
+    def _health(self):
+        for slot in self.slots:
+            self._down(slot)
+
+    def _down(self, slot: _Slot):
+        slot.state = "dead"         # unguarded cross-object write
+
+    def snapshot(self):
+        return [s.state for s in self.slots]
+'''
+    ctx = ctx_of(tmp_path, {"fast_tffm_tpu/mod.py": src})
+    findings = LockChecker().run(ctx)
+    assert any(f.context == "_Slot.state" for f in findings), [
+        f.render() for f in findings
+    ]
+
+
+# -- config-key ------------------------------------------------------------
+
+CONFIG_PY = '''
+def load_config(path):
+    ini = object()
+
+    def get(section, key, conv, default):
+        return default
+
+    g = "General"
+    model = get(g, "model", str, "fm")
+    size = get(g, "vocabulary_size", int, 1)
+    t = "Train"
+    bs = get(t, "batch_size", int, 8)
+    return model, size, bs
+'''
+
+SAMPLE_OK = """
+[General]
+model = fm
+; vocabulary_size = 1048576
+[Train]
+batch_size = 8
+"""
+
+DESIGN_OK = """
+The `model` key picks fm/ffm; `vocabulary_size` sizes the table and
+`batch_size` the step.  See `[Train] batch_size` for sizing.
+"""
+
+
+def _config_findings(tmp_path, sample, design, config_py=CONFIG_PY):
+    (tmp_path / "fast_tffm_tpu").mkdir(parents=True, exist_ok=True)
+    (tmp_path / "fast_tffm_tpu" / "config.py").write_text(config_py)
+    (tmp_path / "sample.cfg").write_text(sample)
+    (tmp_path / "DESIGN.md").write_text(design)
+    ctx = core.RepoContext(str(tmp_path), ["fast_tffm_tpu/config.py"])
+    return ConfigChecker().run(ctx)
+
+
+def test_config_conformant_trio_is_green(tmp_path):
+    assert _config_findings(tmp_path, SAMPLE_OK, DESIGN_OK) == []
+
+
+def test_config_dead_key_is_an_error(tmp_path):
+    dead = SAMPLE_OK + "ghost_knob = 3\n"
+    findings = _config_findings(tmp_path, dead, DESIGN_OK)
+    assert rules_hit(findings) == {("config-key", "dead:Train.ghost_knob")}
+
+
+def test_config_undocumented_and_undesigned_key(tmp_path):
+    cfg = CONFIG_PY.replace(
+        'return model, size, bs',
+        'x = get(t, "new_knob", int, 0)\n    return model, size, bs',
+    )
+    findings = _config_findings(tmp_path, SAMPLE_OK, DESIGN_OK, cfg)
+    assert ("config-key", "undocumented:Train.new_knob") in rules_hit(findings)
+    assert ("config-key", "undesigned:Train.new_knob") in rules_hit(findings)
+
+
+def test_config_stale_design_reference(tmp_path):
+    stale = DESIGN_OK + "\nTune `[Train] warp_factor` for extra speed.\n"
+    findings = _config_findings(tmp_path, SAMPLE_OK, stale)
+    assert rules_hit(findings) == {("config-key", "stale-ref:Train.warp_factor")}
+
+
+def test_config_continuation_comment_is_not_a_key(tmp_path):
+    # the '[Train] row' false-positive class: deeper-indented ';  x = y'
+    # lines are prose, not commented defaults
+    sample = SAMPLE_OK + ";                             ;     row = [V, 1] grouped\n"
+    assert _config_findings(tmp_path, sample, DESIGN_OK) == []
+
+
+# -- telemetry -------------------------------------------------------------
+
+SCHEMAS_FIXTURE = {"train": (), "ckpt": ()}
+
+TELEMETRY_BAD_KIND = '''
+class Engine:
+    def tick(self, monitor):
+        monitor.emit("reloads", n=1)    # unregistered kind
+'''
+
+TELEMETRY_OK_KIND = '''
+class Engine:
+    def tick(self, monitor):
+        monitor.emit("ckpt", n=1)
+'''
+
+TELEMETRY_ROGUE_LOGGER = '''
+from fast_tffm_tpu.utils.tracing import MetricsLogger
+
+def start(path):
+    return MetricsLogger(path)          # construction outside the layer
+'''
+
+TELEMETRY_RAW_LOG = '''
+def emit(logger):
+    logger.log(kind="train", loss=0.5)  # bypasses the envelope
+'''
+
+
+@pytest.mark.parametrize(
+    "src,rel,expect",
+    [
+        (TELEMETRY_BAD_KIND, "fast_tffm_tpu/mod.py", True),
+        (TELEMETRY_OK_KIND, "fast_tffm_tpu/mod.py", False),
+        (TELEMETRY_ROGUE_LOGGER, "fast_tffm_tpu/mod.py", True),
+        (TELEMETRY_RAW_LOG, "fast_tffm_tpu/mod.py", True),
+        # the documented duck-type fallback file is allowlisted
+        (TELEMETRY_RAW_LOG, "fast_tffm_tpu/serving/metrics.py", False),
+        # tools/ are outside the envelope contract
+        (TELEMETRY_BAD_KIND, "tools/x.py", False),
+    ],
+    ids=["bad-kind", "ok-kind", "rogue-logger", "raw-log", "ducktype-allow", "tools-exempt"],
+)
+def test_telemetry_fixtures(tmp_path, src, rel, expect):
+    ctx = ctx_of(tmp_path, {rel: src})
+    findings = TelemetryChecker(schemas=SCHEMAS_FIXTURE).run(ctx)
+    assert bool(findings) == expect, [f.render() for f in findings]
+
+
+# -- suppressions ----------------------------------------------------------
+
+def test_reasoned_suppression_silences_finding(tmp_path):
+    src = RECOMPILE_LOWER.replace(
+        "low = fn.lower(*args)              # out-of-ledger re-lowering",
+        "low = fn.lower(*args)  # analysis: ok recompile-hazard ledger hook under test",
+    )
+    ctx = ctx_of(tmp_path, {"fast_tffm_tpu/mod.py": src})
+    findings = core.apply_suppressions(RecompileChecker().run(ctx), ctx)
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_suppression_on_line_above_applies(tmp_path):
+    src = (
+        "def measure(fn, args):\n"
+        "    # analysis: ok recompile-hazard delegated ledger hook\n"
+        "    return fn.lower(*args)\n"
+    )
+    ctx = ctx_of(tmp_path, {"fast_tffm_tpu/mod.py": src})
+    findings = core.apply_suppressions(RecompileChecker().run(ctx), ctx)
+    assert findings == []
+
+
+def test_bare_suppression_is_itself_an_error(tmp_path):
+    src = "def f(fn, a):\n    return fn.lower(a)  # analysis: ok recompile-hazard\n"
+    ctx = ctx_of(tmp_path, {"fast_tffm_tpu/mod.py": src})
+    findings = core.apply_suppressions(RecompileChecker().run(ctx), ctx)
+    rules = {f.rule for f in findings}
+    # the original finding survives AND the bare comment is flagged
+    assert rules == {"recompile-hazard", "suppression"}, [
+        f.render() for f in findings
+    ]
+
+
+def test_unknown_rule_suppression_flagged(tmp_path):
+    src = "x = 1  # analysis: ok no-such-rule because I said so\n"
+    ctx = ctx_of(tmp_path, {"mod.py": src})
+    findings = core.apply_suppressions([], ctx)
+    assert [f.rule for f in findings] == ["suppression"]
+    assert "unknown rule" in findings[0].message
+
+
+# -- baseline round-trip ---------------------------------------------------
+
+def test_baseline_round_trip(tmp_path):
+    f1 = core.Finding(rule="lock-discipline", path="a.py", line=3,
+                      message="m1", context="C.x")
+    f2 = core.Finding(rule="config-key", path="b.cfg", line=9,
+                      message="m2", context="dead:S.k")
+    path = str(tmp_path / "baseline.json")
+    core.write_baseline(path, [f1, f2], {"C.x-key-never-matches": "no"})
+    baseline = core.load_baseline(path)
+    assert set(baseline) == {f1.key, f2.key}
+    # both unjustified as written
+    assert set(core.unjustified(baseline)) == {f1.key, f2.key}
+    # f1 still fires, f2 got fixed, f3 is new
+    f3 = core.Finding(rule="telemetry", path="c.py", line=1,
+                      message="m3", context="k:bad")
+    new, pinned, stale = core.partition([f1, f3], baseline)
+    assert new == [f3] and pinned == [f1] and stale == [f2.key]
+
+
+def test_baseline_keys_survive_line_drift(tmp_path):
+    f = core.Finding(rule="lock-discipline", path="a.py", line=3,
+                     message="m", context="C.x")
+    path = str(tmp_path / "b.json")
+    core.write_baseline(path, [f])
+    moved = core.Finding(rule="lock-discipline", path="a.py", line=300,
+                         message="m", context="C.x")
+    new, pinned, stale = core.partition([moved], core.load_baseline(path))
+    assert new == [] and pinned == [moved] and stale == []
+
+
+def test_disambiguation_blocks_key_piggybacking(tmp_path):
+    """A SECOND finding with the same rule/path/context must not ride
+    the first occurrence's pin through the gate."""
+    one = core.Finding(rule="recompile-hazard", path="a.py", line=10,
+                       message="m", context="f:uncached-jit")
+    core.disambiguate([one])
+    path = str(tmp_path / "b.json")
+    core.write_baseline(path, [one], {one.key: "ok"})
+    two = [
+        core.Finding(rule="recompile-hazard", path="a.py", line=10,
+                     message="m", context="f:uncached-jit"),
+        core.Finding(rule="recompile-hazard", path="a.py", line=20,
+                     message="m", context="f:uncached-jit"),
+    ]
+    core.disambiguate(two)
+    assert two[0].key != two[1].key and two[1].key.endswith("#2")
+    new, pinned, stale = core.partition(two, core.load_baseline(path))
+    assert pinned == [two[0]] and new == [two[1]]
+    # removing the first occurrence shifts the survivor DOWN to #1: it
+    # matches the old pin; the (now unused) pin set stays non-stale
+    survivor = [core.Finding(rule="recompile-hazard", path="a.py", line=20,
+                             message="m", context="f:uncached-jit")]
+    core.disambiguate(survivor)
+    new, pinned, stale = core.partition(survivor, core.load_baseline(path))
+    assert new == [] and pinned == survivor
+
+
+def test_string_literal_suppression_does_not_suppress(tmp_path):
+    src = (
+        'MSG = "# analysis: ok recompile-hazard checked elsewhere"\n'
+        "def measure(fn, args):\n"
+        "    return fn.lower(*args)\n"
+    )
+    ctx = ctx_of(tmp_path, {"fast_tffm_tpu/mod.py": src})
+    sf = ctx.files[0]
+    assert sf.suppressions == {}  # the literal is not a comment
+    findings = core.apply_suppressions(RecompileChecker().run(ctx), ctx)
+    assert [f.rule for f in findings] == ["recompile-hazard"]
+
+
+def test_write_baseline_refuses_corrupt_existing(tmp_path):
+    root = _mini_repo(tmp_path, bad_module=LOCKS_PR8)
+    (root / "baseline.json").write_text("<<<<<<< merge conflict\n")
+    r = _run_cli(root, "--write-baseline")
+    assert r.returncode == 2, r.stdout + r.stderr
+    assert "refusing" in r.stderr
+    # the corrupt file is untouched, not blanked
+    assert (root / "baseline.json").read_text().startswith("<<<<<<<")
+
+
+# -- end-to-end exit codes on an injected mini repo ------------------------
+
+MINI_TELEMETRY = "SCHEMAS = {'train': ('loss',), 'ckpt': ('mode',)}\n"
+MINI_CONFIG = CONFIG_PY
+
+
+def _mini_repo(tmp_path, bad_module: str | None = None, sample=SAMPLE_OK,
+               design=DESIGN_OK):
+    root = tmp_path / "mini"
+    pkg = root / "fast_tffm_tpu"
+    pkg.mkdir(parents=True, exist_ok=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "telemetry.py").write_text(MINI_TELEMETRY)
+    (pkg / "config.py").write_text(MINI_CONFIG)
+    (root / "sample.cfg").write_text(sample)
+    (root / "DESIGN.md").write_text(design)
+    (root / "tools").mkdir(exist_ok=True)
+    if bad_module is not None:
+        (pkg / "injected.py").write_text(bad_module)
+    return root
+
+
+def _run_cli(root, *extra):
+    return subprocess.run(
+        [sys.executable, RUN_PY, "--root", str(root),
+         "--baseline", str(root / "baseline.json"), *extra],
+        capture_output=True, text=True, timeout=120,
+    )
+
+
+def test_cli_green_mini_repo_exits_0(tmp_path):
+    r = _run_cli(_mini_repo(tmp_path), "--strict")
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+@pytest.mark.parametrize(
+    "bad,needle",
+    [
+        (RECOMPILE_PR7, "recompile-hazard"),
+        (LOCKS_PR8, "lock-discipline"),
+        (DONATION_BUG, "donation-after-use"),
+        (TELEMETRY_BAD_KIND, "telemetry"),
+    ],
+    ids=["fresh-jit-per-save", "unlocked-flag", "donated-then-read", "bad-kind"],
+)
+def test_cli_injected_historical_bug_exits_1(tmp_path, bad, needle):
+    """The acceptance contract: --strict demonstrably exits 1 when a
+    historical-bug fixture is injected into the tree."""
+    r = _run_cli(_mini_repo(tmp_path, bad_module=bad), "--strict")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert needle in r.stdout
+
+
+def test_cli_injected_dead_config_key_exits_1(tmp_path):
+    root = _mini_repo(tmp_path, sample=SAMPLE_OK + "ghost_knob = 3\n")
+    r = _run_cli(root, "--strict")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "ghost_knob" in r.stdout
+
+
+def test_cli_write_baseline_then_strict_passes(tmp_path):
+    """Baseline round-trip through the CLI: pin the injected finding
+    with a justification and the gate goes green; the justification is
+    mandatory."""
+    root = _mini_repo(tmp_path, bad_module=LOCKS_PR8)
+    assert _run_cli(root, "--strict").returncode == 1
+    assert _run_cli(root, "--write-baseline").returncode == 0
+    # unjustified pins still fail strict
+    r = _run_cli(root, "--strict")
+    assert r.returncode == 1 and "justification" in r.stdout
+    data = json.loads((root / "baseline.json").read_text())
+    for e in data["pinned"]:
+        e["justification"] = "fixture pinned on purpose"
+    (root / "baseline.json").write_text(json.dumps(data))
+    assert _run_cli(root, "--strict").returncode == 0
+
+
+def test_cli_write_baseline_preserves_justifications_and_foreign_pins(tmp_path):
+    """Regenerating the baseline is non-destructive: justifications of
+    persisting pins carry over, and a --rules subset rewrite keeps the
+    OTHER checkers' pins verbatim."""
+    root = _mini_repo(tmp_path, bad_module=LOCKS_PR8 + TELEMETRY_BAD_KIND)
+    assert _run_cli(root, "--write-baseline").returncode == 0
+    data = json.loads((root / "baseline.json").read_text())
+    rules = {e["rule"] for e in data["pinned"]}
+    assert rules == {"lock-discipline", "telemetry"}
+    for e in data["pinned"]:
+        e["justification"] = f"hand-written for {e['rule']}"
+    (root / "baseline.json").write_text(json.dumps(data))
+    # full regeneration: both justifications survive
+    assert _run_cli(root, "--write-baseline").returncode == 0
+    data2 = json.loads((root / "baseline.json").read_text())
+    assert {e["justification"] for e in data2["pinned"]} == {
+        "hand-written for lock-discipline", "hand-written for telemetry",
+    }
+    # subset regeneration: the lock pin (out of scope) survives verbatim
+    assert _run_cli(root, "--rules", "telemetry", "--write-baseline").returncode == 0
+    data3 = json.loads((root / "baseline.json").read_text())
+    assert {e["rule"] for e in data3["pinned"]} == {"lock-discipline", "telemetry"}
+    assert _run_cli(root, "--strict").returncode == 0
+
+
+def test_cli_rules_subset_filters_other_pins(tmp_path):
+    """--rules telemetry must not read other checkers' baseline pins as
+    stale, and must not report their findings."""
+    root = _mini_repo(tmp_path, bad_module=LOCKS_PR8)
+    r = _run_cli(root, "--rules", "telemetry", "--strict")
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+# -- whole-repo smoke (the tier-1 gate itself) -----------------------------
+
+def test_whole_repo_strict_is_green():
+    """`run.py --strict` over THIS tree with the committed baseline: the
+    suite, the code, and the baseline agree.  This test is the tier-1
+    wiring the ISSUE asks for — any new finding anywhere in the package
+    or tools fails here with the finding's file:line in the output."""
+    r = subprocess.run(
+        [sys.executable, RUN_PY, "--strict"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "analysis: OK" in r.stdout
+
+
+def test_whole_repo_json_payload():
+    """--json emits the machine shape report.py renders."""
+    r = subprocess.run(
+        [sys.executable, RUN_PY, "--json", "-"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    payload, _ = json.JSONDecoder().raw_decode(r.stdout[r.stdout.index("{"):])
+    assert payload["version"] == 1
+    assert set(payload["counts"]) == {"by_rule", "by_severity"}
+    assert payload["baseline"]["pinned"] >= 0
+    assert payload["new"] == []  # committed tree is gate-green
+
+
+# -- report.py Analysis section --------------------------------------------
+
+def _load_report_tool():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "report_tool_analysis", os.path.join(REPO, "tools", "report.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _analysis_payload(debt=2, new=0, stale=0, unjustified=0):
+    return {
+        "version": 1,
+        "root": "/x",
+        "counts": {
+            "by_rule": {"lock-discipline": debt + new},
+            "by_severity": {"warning": debt + new},
+        },
+        "baseline": {
+            "pinned": debt, "stale": stale, "unjustified": unjustified,
+            "debt": debt,
+        },
+        "new": [
+            {"rule": "lock-discipline", "path": "x.py", "line": 1,
+             "message": "m", "severity": "warning", "context": "C.x",
+             "fix_hint": "", "key": f"lock-discipline::x.py::C.{i}"}
+            for i in range(new)
+        ],
+        "findings": [],
+    }
+
+
+def test_report_renders_analysis_section(tmp_path):
+    rpt = _load_report_tool()
+    text = rpt.render_analysis(_analysis_payload(debt=3, new=1))
+    assert "## Analysis" in text
+    assert "lock-discipline" in text
+    assert "Baseline debt: 3" in text
+    assert "1 NEW finding" in text
+
+
+def test_report_gates_on_debt_growth(tmp_path):
+    rpt = _load_report_tool()
+    base = _analysis_payload(debt=2)
+    worse = _analysis_payload(debt=4)
+    assert rpt.compare_analysis(worse, base)
+    assert rpt.compare_analysis(base, base) == []
+    # new findings also regress
+    assert rpt.compare_analysis(_analysis_payload(debt=2, new=2), base)
+
+
+def test_report_cli_analysis_gate(tmp_path):
+    """End-to-end: two telemetry runs + two analysis JSONs; --strict
+    exits 1 purely on the analysis debt growth."""
+    run_jsonl = tmp_path / "run.jsonl"
+    rec = (
+        '{"run_id": "r", "kind": "train", "step": 1, "epoch": 0, '
+        '"loss": 0.5, "examples_per_sec": 10.0, '
+        '"examples_per_sec_per_chip": 10.0}'
+    )
+    run_jsonl.write_text(rec + "\n")
+    a_base = tmp_path / "base.json"
+    a_run = tmp_path / "run.json"
+    a_base.write_text(json.dumps(_analysis_payload(debt=1)))
+    a_run.write_text(json.dumps(_analysis_payload(debt=3)))
+    cmd = [
+        sys.executable, os.path.join(REPO, "tools", "report.py"),
+        str(run_jsonl), "--compare", str(run_jsonl), "--strict",
+        "--analysis", str(a_run), "--analysis-base", str(a_base),
+    ]
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "baseline debt grew" in r.stdout
+    # same debt → clean exit
+    a_run.write_text(json.dumps(_analysis_payload(debt=1)))
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    # --analysis-base WITHOUT --analysis is a usage error (exit 2), not a
+    # silently-skipped gate
+    half = [c for c in cmd if c not in ("--analysis", str(a_run))]
+    r = subprocess.run(half, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 2, r.stdout + r.stderr
+    assert "requires --analysis" in r.stderr

@@ -166,12 +166,19 @@ def test_anomaly_names_first_nonfinite_tensor(tmp_path):
 
 
 def test_check_telemetry_conformance():
-    """The satellite tripwire: the static check (no raw MetricsLogger
-    construction / raw kind= logs / unregistered emit kinds anywhere in
-    the package) must pass on the committed tree — schema drift fails
-    tier-1 loudly instead of silently forking the envelope."""
+    """The conformance tripwire: the telemetry rule of the static
+    analysis suite (tools/analysis/ — absorbed the old standalone
+    check_telemetry.py) must pass on the committed tree — schema drift
+    fails tier-1 loudly instead of silently forking the envelope.
+    (tests/test_analysis.py runs the FULL five-checker suite; this
+    checks the telemetry rule alone stays green even if another rule's
+    baseline churns.)"""
     r = subprocess.run(
-        [sys.executable, os.path.join(REPO, "tools", "check_telemetry.py")],
+        [
+            sys.executable,
+            os.path.join(REPO, "tools", "analysis", "run.py"),
+            "--rules", "telemetry", "--strict",
+        ],
         capture_output=True,
         text=True,
     )

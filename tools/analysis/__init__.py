@@ -1,0 +1,19 @@
+"""AST-based invariant checker suite (ISSUE 13).
+
+The repo's hard invariants — buffer-donation safety, zero steady-state
+recompiles, lock-guarded shared state, config/schema conformance — are
+machine-checked here at commit time instead of rediscovered in review.
+``python tools/analysis/run.py --strict`` runs every checker over the
+tree and is wired into tier-1 (tests/test_analysis.py).
+
+Modules:
+  core.py             shared infra: Finding model, suppressions, baseline,
+                      parsed-file cache, output rendering
+  check_donation.py   donated buffers read after the donating dispatch
+  check_recompile.py  jit-in-loop / uncached jit / traced Python scalars /
+                      out-of-ledger .lower()/cost_analysis()
+  check_locks.py      unguarded shared mutations + lock-order cycles
+  check_config.py     config.py ⇄ sample.cfg ⇄ DESIGN.md key conformance
+  check_telemetry.py  RunMonitor envelope conformance (absorbed from the
+                      old tools/check_telemetry.py regex checker)
+"""

@@ -1,0 +1,227 @@
+"""config-key: config.py ⇄ sample.cfg ⇄ DESIGN.md conformance.
+
+Three artifacts describe the same key vocabulary and they drift
+independently: ``config.load_config`` reads ``[Section] key`` pairs,
+``sample.cfg`` documents them (active or as ``; key = value`` commented
+defaults), and DESIGN.md explains them.  The rules:
+
+  * every key read in config.py must appear in sample.cfg (same
+    section) — an undocumented knob is invisible to operators;
+  * every key in sample.cfg must be read by config.py — a dead key in
+    the sample silently does nothing for whoever sets it (error);
+  * every key read in config.py must be mentioned in DESIGN.md (the
+    bare key token anywhere — DESIGN prose is not section-structured);
+  * every explicit ``[Section] key`` reference in DESIGN.md must name a
+    real section+key (stale design references mislead).
+
+The reader model matches load_config's idiom exactly: section variables
+(``g = "General"``) resolve through module-level assignment, and every
+``get(<section>, "<key>", ...)`` call names one read.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from analysis.core import Finding
+
+RULE = "config-key"
+
+# [Section] key references in DESIGN.md ("`[Online] follow = true`",
+# "`[Checkpoint]\nfull_every_s`").  Only identifier-looking tokens with
+# an underscore are treated as key references — "[General] key
+# vocabulary" prose must not match.
+_DESIGN_REF = re.compile(r"\[([A-Z][A-Za-z]+)\]`?\s+`?([a-z][a-z0-9_]*)")
+# Active keys start the line; commented DEFAULTS are '; key = v' with the
+# ';' in column 0 and one space — deeper-indented ';   word = ...' lines
+# are continuation prose, not keys.
+_SAMPLE_KEY = re.compile(r"^(?:([a-z][a-z0-9_]*)\s*=|; ?([a-z][a-z0-9_]*) ?=)")
+_SAMPLE_SECTION = re.compile(r"^\s*\[([A-Za-z]+)\]")
+
+
+def read_config_reads(config_py: str) -> dict[tuple[str, str], int]:
+    """{(section, key): line} for every get(section, "key", ...) call."""
+    with open(config_py, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=config_py)
+    sections: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Constant):
+            if isinstance(node.value.value, str):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        sections[tgt.id] = node.value.value
+    out: dict[tuple[str, str], int] = {}
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "get"
+            and len(node.args) >= 2
+        ):
+            continue
+        sec_node, key_node = node.args[0], node.args[1]
+        if isinstance(sec_node, ast.Name):
+            section = sections.get(sec_node.id)
+        elif isinstance(sec_node, ast.Constant) and isinstance(sec_node.value, str):
+            section = sec_node.value
+        else:
+            section = None
+        if (
+            section
+            and isinstance(key_node, ast.Constant)
+            and isinstance(key_node.value, str)
+        ):
+            out.setdefault((section, key_node.value), node.lineno)
+    return out
+
+
+def read_sample_keys(sample_cfg: str) -> dict[tuple[str, str], int]:
+    """Documented keys: active entries AND ``; key = value`` commented
+    defaults (the sample's house style annotates optional keys that
+    way).  Continuation comment lines (no '=' after an identifier at
+    line start) don't match."""
+    out: dict[tuple[str, str], int] = {}
+    section = None
+    with open(sample_cfg, encoding="utf-8") as f:
+        for i, line in enumerate(f, 1):
+            m = _SAMPLE_SECTION.match(line)
+            if m:
+                section = m.group(1)
+                continue
+            if section is None:
+                continue
+            m = _SAMPLE_KEY.match(line)
+            if m:
+                out.setdefault((section, m.group(1) or m.group(2)), i)
+    return out
+
+
+def read_design_refs(design_md: str):
+    """(explicit [Section] key refs with lines, full text) — the text
+    backs the bare-mention rule."""
+    with open(design_md, encoding="utf-8") as f:
+        text = f.read()
+    refs: dict[tuple[str, str], int] = {}
+    for m in _DESIGN_REF.finditer(text):
+        key = m.group(2)
+        if "_" in key:  # identifier-shaped, not prose
+            refs.setdefault((m.group(1), key), text.count("\n", 0, m.start()) + 1)
+    return refs, text
+
+
+class ConfigChecker:
+    """Paths are injectable so the fixture tests can run it against a
+    synthetic trio; defaults resolve against ``ctx.root``."""
+
+    name = "config"
+    rules = (RULE,)
+    description = "config.py ⇄ sample.cfg ⇄ DESIGN.md key conformance"
+
+    def __init__(self, config_py=None, sample_cfg=None, design_md=None):
+        self._config_py = config_py
+        self._sample_cfg = sample_cfg
+        self._design_md = design_md
+
+    def run(self, ctx) -> list[Finding]:
+        config_py = self._config_py or os.path.join(
+            ctx.root, "fast_tffm_tpu", "config.py"
+        )
+        sample_cfg = self._sample_cfg or os.path.join(ctx.root, "sample.cfg")
+        design_md = self._design_md or os.path.join(ctx.root, "DESIGN.md")
+        findings: list[Finding] = []
+        for path, what in ((config_py, "config.py"), (sample_cfg, "sample.cfg")):
+            if not os.path.isfile(path):
+                findings.append(
+                    Finding(
+                        rule=RULE, path=what, line=0,
+                        message=f"{what} not found at {path}",
+                        context=f"missing:{what}",
+                    )
+                )
+        if findings:
+            return findings
+        reads = read_config_reads(config_py)
+        sample = read_sample_keys(sample_cfg)
+        have_design = os.path.isfile(design_md)
+        design_refs, design_text = (
+            read_design_refs(design_md) if have_design else ({}, "")
+        )
+        rel_cfg = os.path.relpath(config_py, ctx.root).replace(os.sep, "/")
+        rel_sample = os.path.relpath(sample_cfg, ctx.root).replace(os.sep, "/")
+        rel_design = (
+            os.path.relpath(design_md, ctx.root).replace(os.sep, "/")
+            if have_design
+            else "DESIGN.md"
+        )
+
+        for (section, key), line in sorted(reads.items()):
+            if (section, key) not in sample:
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=rel_cfg,
+                        line=line,
+                        message=(
+                            f"[{section}] {key} is read by load_config but "
+                            f"absent from sample.cfg — operators cannot "
+                            "discover it"
+                        ),
+                        context=f"undocumented:{section}.{key}",
+                        fix_hint=(
+                            f"add '{key} = <default>' (or the commented "
+                            f"'; {key} = ...' form) under [{section}] in "
+                            "sample.cfg"
+                        ),
+                    )
+                )
+            if have_design and not re.search(
+                rf"\b{re.escape(key)}\b", design_text
+            ):
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=rel_cfg,
+                        line=line,
+                        message=(
+                            f"[{section}] {key} is read by load_config but "
+                            "never mentioned in DESIGN.md"
+                        ),
+                        context=f"undesigned:{section}.{key}",
+                        fix_hint=f"document {key} where DESIGN.md covers [{section}]",
+                    )
+                )
+        for (section, key), line in sorted(sample.items()):
+            if (section, key) not in reads:
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=rel_sample,
+                        line=line,
+                        message=(
+                            f"[{section}] {key} appears in sample.cfg but "
+                            "load_config never reads it — a dead key "
+                            "silently does nothing for whoever sets it"
+                        ),
+                        context=f"dead:{section}.{key}",
+                        fix_hint="wire it into load_config or delete the sample entry",
+                    )
+                )
+        for (section, key), line in sorted(design_refs.items()):
+            if (section, key) not in reads:
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=rel_design,
+                        line=line,
+                        message=(
+                            f"DESIGN.md references [{section}] {key} but "
+                            "load_config reads no such key — a stale design "
+                            "reference misleads"
+                        ),
+                        context=f"stale-ref:{section}.{key}",
+                        fix_hint="fix the section/key name or drop the reference",
+                    )
+                )
+        return findings

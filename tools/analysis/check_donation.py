@@ -1,0 +1,307 @@
+"""donation-after-use: a donated buffer read after the donating dispatch.
+
+``jit(..., donate_argnums=...)`` hands the argument's device buffer to
+XLA; the Python binding still points at it, and a later read returns
+garbage (or raises on newer jax) — the exact bug class the async-
+checkpoint snapshot machinery exists to dodge.  The checker resolves,
+per module:
+
+  * jitted-with-donation callables — ``f = jax.jit(g, donate_argnums=
+    (0,))`` / ``self._mark = jax.jit(...)`` assignments, ``@jax.jit``-
+    with-donation and ``@partial(jax.jit, donate_argnums=...)``
+    decorated defs (donate_argnames map to positions via the wrapped
+    def's signature when it is local);
+  * their call sites in the same module: any plain-name or self-attr
+    argument in a donated position becomes CONSUMED after the call
+    statement (unless that same statement rebinds it, the
+    ``x = f(x)`` idiom);
+  * any later Load of a consumed binding in the same scope, before a
+    rebind/del, is a finding.  Loop bodies are walked twice so a
+    loop-carried read-after-donate (consumed at the bottom, read at the
+    top of the next iteration) is caught.
+
+Scope: same-module resolution only.  A factory returning a jitted
+closure that another module calls is invisible here — the runtime
+donation error (and the recompile sentinel's twin) covers that path.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from analysis.core import (
+    Finding,
+    RepoContext,
+    attr_chain,
+    call_name,
+    enclosing_function,
+    jax_aliases,
+    parent_map,
+    resolves_to,
+)
+
+RULE = "donation-after-use"
+
+
+def _donated_positions(call: ast.Call):
+    """(positions, argnames) from a jax.jit Call's keywords, or None when
+    the call donates nothing."""
+    pos: set[int] = set()
+    names: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                pos.add(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                for el in v.elts:
+                    if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                        pos.add(el.value)
+        elif kw.arg == "donate_argnames":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                names.add(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                for el in v.elts:
+                    if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                        names.add(el.value)
+    if not pos and not names:
+        return None
+    return pos, names
+
+
+def _is_jit_call(node: ast.AST, aliases) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = call_name(node)
+    return name is not None and (
+        resolves_to(name, "jax.jit", aliases) or resolves_to(name, "jax.pjit", aliases)
+    )
+
+
+def _local_defs(tree: ast.AST) -> dict[str, ast.FunctionDef]:
+    out = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[node.name] = node
+    return out
+
+
+def _names_to_positions(fn: ast.FunctionDef | None, names: set[str]) -> set[int]:
+    if fn is None or not names:
+        return set()
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    return {params.index(n) for n in names if n in params}
+
+
+def _collect_donated(tree: ast.AST, aliases) -> dict[str, set[int]]:
+    """callable name (as written at call sites: 'f' or 'self._mark')
+    → donated positions."""
+    defs = _local_defs(tree)
+    out: dict[str, set[int]] = {}
+
+    def positions_for(call: ast.Call, wrapped: ast.AST | None) -> set[int] | None:
+        d = _donated_positions(call)
+        if d is None:
+            return None
+        pos, names = d
+        fn = None
+        if isinstance(wrapped, ast.Name):
+            fn = defs.get(wrapped.id)
+        elif isinstance(wrapped, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn = wrapped
+        return pos | _names_to_positions(fn, names)
+
+    for node in ast.walk(tree):
+        # name = jax.jit(g, donate_*) / self._f = jax.jit(...)
+        if isinstance(node, ast.Assign) and _is_jit_call(node.value, aliases):
+            wrapped = node.value.args[0] if node.value.args else None
+            pos = positions_for(node.value, wrapped)
+            if pos:
+                for tgt in node.targets:
+                    name = attr_chain(tgt)
+                    if name:
+                        out[name] = pos
+        # @jax.jit(donate_*) / @partial(jax.jit, donate_*) decorated defs
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if not isinstance(dec, ast.Call):
+                    continue
+                dname = call_name(dec)
+                if dname is None:
+                    continue
+                if resolves_to(dname, "jax.jit", aliases):
+                    pos = positions_for(dec, node)
+                    if pos:
+                        out[node.name] = pos
+                elif resolves_to(dname, "functools.partial", aliases):
+                    inner = dec.args[0] if dec.args else None
+                    iname = attr_chain(inner) if inner is not None else None
+                    if iname and resolves_to(iname, "jax.jit", aliases):
+                        pos = positions_for(dec, node)
+                        if pos:
+                            out[node.name] = pos
+    return out
+
+
+class _ScopeWalker:
+    """Linear statement walk of one function body tracking consumed
+    bindings.  Branch-insensitive on purpose (union semantics): an If arm
+    that donates taints the fall-through — conservative, and the reason
+    findings carry the donating line so a human can adjudicate fast."""
+
+    def __init__(self, checker, donated: dict[str, set[int]], sf, parents):
+        self.checker = checker
+        self.donated = donated
+        self.sf = sf
+        self.parents = parents
+        self.consumed: dict[str, tuple[str, int]] = {}  # name -> (callee, line)
+        self.reported: set[tuple[int, str]] = set()
+
+    def _donation_args(self, call: ast.Call):
+        name = call_name(call)
+        if name is None:
+            return []
+        pos = self.donated.get(name)
+        if not pos:
+            return []
+        out = []
+        for i, arg in enumerate(call.args):
+            if i in pos:
+                aname = attr_chain(arg)
+                if aname:
+                    out.append((aname, name, call.lineno))
+        return out
+
+    # -- statement walk ------------------------------------------------
+
+    def run(self, body: list[ast.stmt]):
+        self._walk_block(body)
+
+    def _walk_block(self, body: list[ast.stmt]):
+        for stmt in body:
+            self._statement(stmt)
+
+    def _statement(self, stmt: ast.stmt):
+        # Nested defs/classes get their own scope (fresh walker via the
+        # checker's per-function driver); don't descend here.
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return
+
+        stores = self._store_targets(stmt)
+
+        # 1. reads of consumed bindings anywhere in this statement
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            if isinstance(node, (ast.Name, ast.Attribute)) and isinstance(
+                getattr(node, "ctx", None), ast.Load
+            ):
+                name = attr_chain(node)
+                if name is None:
+                    continue
+                hit = self._consumed_hit(name)
+                if hit is not None and (node.lineno, hit) not in self.reported:
+                    callee, dline = self.consumed[hit]
+                    self.reported.add((node.lineno, hit))
+                    name = hit
+                    self.checker.findings.append(
+                        Finding(
+                            rule=RULE,
+                            path=self.sf.rel,
+                            line=node.lineno,
+                            message=(
+                                f"{name!r} was donated to {callee!r} at line "
+                                f"{dline} and read again here — the buffer "
+                                "belongs to XLA after the dispatch"
+                            ),
+                            context=(
+                                f"{enclosing_function(node, self.parents)}:{name}"
+                            ),
+                            fix_hint=(
+                                "rebind the result (x = f(x)), device-copy "
+                                "before donating (checkpoint_async."
+                                "device_snapshot), or drop the donation"
+                            ),
+                        )
+                    )
+
+        # 2. donations performed by this statement
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                for aname, callee, line in self._donation_args(node):
+                    if aname not in stores:  # x = f(x) rebinds — safe
+                        self.consumed[aname] = (callee, line)
+
+        # 3. rebinds/dels clear consumption
+        for name in stores:
+            self.consumed.pop(name, None)
+
+        # recurse into compound statements in source order; loop bodies
+        # run twice for the loop-carried case
+        for body in self._sub_blocks(stmt):
+            self._walk_block(body)
+        if isinstance(stmt, (ast.For, ast.While, ast.AsyncFor)):
+            for body in self._sub_blocks(stmt):
+                self._walk_block(body)
+
+    def _consumed_hit(self, name: str) -> str | None:
+        if name in self.consumed:
+            return name
+        # reading THROUGH the consumed binding (x.shape, x[0] via chain)
+        for c in self.consumed:
+            if name.startswith(c + "."):
+                return c
+        return None
+
+    @staticmethod
+    def _store_targets(stmt: ast.stmt) -> set[str]:
+        out: set[str] = set()
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.Name, ast.Attribute)) and isinstance(
+                getattr(node, "ctx", None), (ast.Store, ast.Del)
+            ):
+                name = attr_chain(node)
+                if name:
+                    out.add(name)
+        return out
+
+    @staticmethod
+    def _sub_blocks(stmt: ast.stmt):
+        for field in ("body", "orelse", "finalbody"):
+            blk = getattr(stmt, field, None)
+            if blk:
+                yield blk
+        for h in getattr(stmt, "handlers", ()) or ():
+            yield h.body
+
+
+class DonationChecker:
+    name = "donation"
+    rules = (RULE,)
+    description = "donated buffers read after the donating dispatch"
+
+    def __init__(self):
+        self.findings: list[Finding] = []
+
+    def run(self, ctx: RepoContext) -> list[Finding]:
+        self.findings = []
+        for sf in ctx.files:
+            tree = sf.tree
+            if tree is None:
+                continue
+            aliases = jax_aliases(tree)
+            donated = _collect_donated(tree, aliases)
+            if not donated:
+                continue
+            parents = parent_map(tree)
+            for node in ast.walk(tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    _ScopeWalker(self, donated, sf, parents).run(node.body)
+            # module-level statements form one more scope
+            _ScopeWalker(self, donated, sf, parents).run(
+                [s for s in tree.body if not isinstance(
+                    s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+                )]
+            )
+        return self.findings

@@ -1,0 +1,616 @@
+"""lock-discipline / lock-order: threaded shared state, statically.
+
+The threading surface is now ~60 primitives across 16 modules, and the
+last four PRs each shipped a post-review fix for exactly this bug class
+(the unlocked reload-retry flag, the stranded-pending race).  This
+checker builds, per module:
+
+  * **lock catalog** — ``self.X = threading.Lock()/RLock()/Condition()``
+    assignments name the lock attributes; ``with <expr>.X`` where X is a
+    known lock attribute of the base's (inferred) class counts as
+    holding that lock.
+  * **thread entries** — methods/nested defs handed to
+    ``threading.Thread(target=...)`` / ``Timer`` / ``executor.submit``;
+    when a target is a bare parameter (the ``self._spawn(fn, ...)``
+    trampoline), every ``self.<method>`` passed as a call argument in
+    the class becomes a potential entry.  Entries whose Thread() call
+    sits in a loop/comprehension are multi-instance (N concurrent
+    copies of one body).
+  * **reachability** — closure over ``self.method()`` calls from each
+    entry, and separately from the class's public surface ("caller"
+    context: another thread is on the other end of every public method
+    of these server objects).
+  * **shared-mutation findings** — an attribute written OUTSIDE any
+    with-lock block, reachable from a thread entry, and accessed from a
+    second context (or one multi-instance entry).  ``__init__`` is
+    exempt (runs before the threads exist).  Cross-object accesses
+    resolve through parameter annotations (``slot: _Slot``) and
+    ``self.xs = [Cls(i) ...]`` comprehensions, so Router's mutations of
+    _Slot fields attribute to _Slot.
+  * **lock-order graph** — edge A→B when B is acquired while A is held
+    (lexically nested ``with``, or through the self-call closure);
+    cycles are errors.
+
+Guardedness is "inside ANY with-lock block" on purpose: which lock is
+the *right* one is a design question the finding's fix hint hands to a
+human; the checker's job is flagging mutations with no lock at all —
+the historical bug class.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from analysis.core import (
+    Finding,
+    RepoContext,
+    attr_chain,
+    call_name,
+    jax_aliases,
+    resolves_to,
+)
+
+RULE = "lock-discipline"
+RULE_ORDER = "lock-order"
+
+_LOCK_TYPES = (
+    "threading.Lock",
+    "threading.RLock",
+    "threading.Condition",
+    "threading.Semaphore",
+    "threading.BoundedSemaphore",
+)
+
+
+def _is_lock_ctor(call: ast.Call, aliases) -> bool:
+    name = call_name(call)
+    return name is not None and any(
+        resolves_to(name, t, aliases) for t in _LOCK_TYPES
+    )
+
+
+class _Access:
+    __slots__ = ("attr", "kind", "line", "method", "guarded", "base_cls")
+
+    def __init__(self, attr, kind, line, method, guarded, base_cls):
+        self.attr = attr
+        self.kind = kind  # "read" | "write"
+        self.line = line
+        self.method = method
+        self.guarded = guarded
+        self.base_cls = base_cls
+
+
+class _MethodInfo:
+    def __init__(self, name):
+        self.name = name
+        self.calls: set[str] = set()
+        self.accesses: list[_Access] = []
+        self.acquires: list[tuple[str, int]] = []  # (lock_id, line)
+        self.calls_under: list[tuple[tuple[str, ...], str]] = []
+
+
+class _ClassModel:
+    def __init__(self, name, module):
+        self.name = name
+        self.module = module
+        self.lock_attrs: set[str] = set()
+        self.methods: dict[str, _MethodInfo] = {}
+        self.entries: dict[str, bool] = {}  # entry -> multi-instance
+        self.attr_types: dict[str, str] = {}
+        self.has_dynamic_target = False
+        self.method_args_passed: set[str] = set()
+        self._reach = None
+
+
+def _lock_attrs_of(cls: ast.ClassDef, aliases) -> set[str]:
+    out = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            tgt = node.targets[0] if len(node.targets) == 1 else None
+            name = attr_chain(tgt) if tgt is not None else None
+            if name and name.startswith("self.") and _is_lock_ctor(node.value, aliases):
+                out.add(name.split(".", 1)[1])
+    return out
+
+
+class LockChecker:
+    name = "locks"
+    rules = (RULE, RULE_ORDER)
+    description = "unguarded shared mutations + lock-order cycles"
+
+    def __init__(self):
+        self._edges: dict[tuple[str, str], tuple[str, int]] = {}
+
+    def run(self, ctx: RepoContext) -> list[Finding]:
+        self._edges = {}
+        findings: list[Finding] = []
+        for sf in ctx.files:
+            if not sf.rel.startswith("fast_tffm_tpu/"):
+                continue
+            tree = sf.tree
+            if tree is None:
+                continue
+            aliases = jax_aliases(tree)
+            classes = {
+                n.name: n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)
+            }
+            lock_attrs = {
+                name: _lock_attrs_of(node, aliases)
+                for name, node in classes.items()
+            }
+            models = {
+                name: self._model_class(sf, node, aliases, classes, lock_attrs)
+                for name, node in classes.items()
+            }
+            findings.extend(self._shared_mutations(sf, models))
+            self.finish_module_edges(models)
+        findings.extend(self._cycles(self._edges))
+        return findings
+
+    # -- per-class modelling -------------------------------------------
+
+    def _model_class(self, sf, cls, aliases, classes, lock_attrs) -> _ClassModel:
+        model = _ClassModel(cls.name, sf.rel)
+        model.lock_attrs = lock_attrs[cls.name]
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign):
+                tgt = node.targets[0] if len(node.targets) == 1 else None
+                name = attr_chain(tgt) if tgt is not None else None
+                if not (name and name.startswith("self.") and name.count(".") == 1):
+                    continue
+                attr = name.split(".", 1)[1]
+                if isinstance(node.value, ast.Call):
+                    cname = call_name(node.value)
+                    if cname in classes:
+                        model.attr_types[attr] = cname
+                elif isinstance(node.value, ast.ListComp) and isinstance(
+                    node.value.elt, ast.Call
+                ):
+                    cname = call_name(node.value.elt)
+                    if cname in classes:
+                        model.attr_types[attr] = cname
+        for item in cls.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._model_method(sf, model, item, aliases, classes, lock_attrs)
+        if model.has_dynamic_target:
+            for m in model.method_args_passed:
+                if m in model.methods:
+                    model.entries.setdefault(m, False)
+        return model
+
+    def _model_method(self, sf, model, fn, aliases, classes, lock_attrs):
+        info = _MethodInfo(fn.name)
+        model.methods[fn.name] = info
+        param_types: dict[str, str] = {}
+        for a in fn.args.args + fn.args.posonlyargs + fn.args.kwonlyargs:
+            ann = a.annotation
+            if isinstance(ann, ast.Name) and ann.id in classes:
+                param_types[a.arg] = ann.id
+            elif (
+                isinstance(ann, ast.Constant)
+                and isinstance(ann.value, str)
+                and ann.value in classes
+            ):
+                param_types[a.arg] = ann.value
+        for node in ast.walk(fn):
+            gens = []
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                gens.append((node.target, node.iter))
+            elif isinstance(
+                node, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)
+            ):
+                gens.extend((g.target, g.iter) for g in node.generators)
+            for target, it_node in gens:
+                if not isinstance(target, ast.Name):
+                    continue
+                for it_expr in [it_node] + (
+                    list(it_node.args) if isinstance(it_node, ast.Call) else []
+                ):
+                    it = attr_chain(it_expr)
+                    if it and it.startswith("self."):
+                        t = model.attr_types.get(it.split(".", 1)[1])
+                        if t:
+                            param_types[target.id] = t
+            # local = ClassName(...) direct construction
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                cname = call_name(node.value)
+                tgt = node.targets[0] if len(node.targets) == 1 else None
+                if (
+                    cname in classes
+                    and isinstance(tgt, ast.Name)
+                ):
+                    param_types[tgt.id] = cname
+        nested_defs = {
+            n.name
+            for n in ast.walk(fn)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) and n is not fn
+        }
+        st = _WalkState(
+            self, sf, model, info, param_types, classes, lock_attrs, nested_defs
+        )
+        st.walk(fn.body, held=(), in_loop=False)
+
+    # -- findings -------------------------------------------------------
+
+    def _shared_mutations(self, sf, models) -> list[Finding]:
+        findings = []
+        per_cls: dict[str, list[tuple[_ClassModel, _Access]]] = {}
+        for model in models.values():
+            for info in model.methods.values():
+                for acc in info.accesses:
+                    per_cls.setdefault(acc.base_cls, []).append((model, acc))
+        for cls_name, pairs in sorted(per_cls.items()):
+            owner = models.get(cls_name)
+            lock_attrs = owner.lock_attrs if owner else set()
+            by_attr: dict[str, list[tuple[_ClassModel, _Access]]] = {}
+            for model, acc in pairs:
+                if acc.attr not in lock_attrs:
+                    by_attr.setdefault(acc.attr, []).append((model, acc))
+            for attr, accs in sorted(by_attr.items()):
+                f = self._judge_attr(sf, cls_name, attr, accs)
+                if f is not None:
+                    findings.append(f)
+        return findings
+
+    def _judge_attr(self, sf, cls_name, attr, accs) -> Finding | None:
+        contexts: set[str] = set()
+        unguarded_writes: list[_Access] = []
+        multi = False
+        methods_seen = set()
+        for model, acc in accs:
+            if acc.method == "__init__":
+                continue
+            ctxs = _method_contexts(model, acc.method)
+            contexts |= ctxs
+            multi = multi or any(
+                model.entries.get(c.split(":", 1)[1], False)
+                for c in ctxs
+                if c.startswith("thread:")
+            )
+            methods_seen.add(f"{model.name}.{acc.method}")
+            if (
+                acc.kind == "write"
+                and not acc.guarded
+                and acc.method not in _guaranteed_held(model)
+            ):
+                unguarded_writes.append(acc)
+        if not unguarded_writes:
+            return None
+        thread_ctxs = {c for c in contexts if c.startswith("thread:")}
+        if not thread_ctxs:
+            return None
+        if not (len(contexts) >= 2 or multi):
+            return None
+        w = unguarded_writes[0]
+        return Finding(
+            rule=RULE,
+            path=sf.rel,
+            line=w.line,
+            message=(
+                f"{cls_name}.{attr} is written unguarded in {w.method}() but "
+                f"shared across contexts ({', '.join(sorted(contexts))}; "
+                f"methods: {', '.join(sorted(methods_seen))})"
+            ),
+            context=f"{cls_name}.{attr}",
+            severity="warning",
+            fix_hint=(
+                "guard every write (and compound read-modify-write) with "
+                "the owning lock, or confine the attribute to one thread"
+            ),
+        )
+
+    # -- lock order -----------------------------------------------------
+
+    def add_edge(self, a, b, where):
+        if a != b:
+            self._edges.setdefault((a, b), where)
+
+    def finish_module_edges(self, models):
+        for model in models.values():
+            all_acquires = self._transitive_acquires(model)
+            for info in model.methods.values():
+                for held_ids, callee in info.calls_under:
+                    if not held_ids:
+                        continue
+                    for acq_id, line in all_acquires.get(callee, ()):
+                        for h in held_ids:
+                            self.add_edge(h, acq_id, (model.module, line))
+
+    def _transitive_acquires(self, model) -> dict[str, list[tuple[str, int]]]:
+        out: dict[str, list[tuple[str, int]]] = {}
+
+        def visit(mname, seen):
+            if mname in out:
+                return out[mname]
+            if mname in seen:
+                return []
+            seen.add(mname)
+            info = model.methods.get(mname)
+            if info is None:
+                return []
+            acc = list(info.acquires)
+            for callee in info.calls:
+                acc.extend(visit(callee, seen))
+            out[mname] = acc
+            return acc
+
+        for mname in model.methods:
+            visit(mname, set())
+        return out
+
+    def _cycles(self, edges) -> list[Finding]:
+        graph: dict[str, set[str]] = {}
+        for a, b in edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        findings = []
+        color: dict[str, int] = {}
+
+        def dfs(n, stack):
+            color[n] = 1
+            stack.append(n)
+            cyc = None
+            for m in sorted(graph.get(n, ())):
+                if color.get(m, 0) == 0:
+                    cyc = dfs(m, stack)
+                elif color.get(m) == 1:
+                    cyc = stack[stack.index(m):] + [m]
+                if cyc:
+                    break
+            stack.pop()
+            color[n] = 2
+            return cyc
+
+        for n in sorted(graph):
+            if color.get(n, 0) == 0:
+                cyc = dfs(n, [])
+                if cyc:
+                    where = edges.get((cyc[0], cyc[1])) or ("?", 0)
+                    findings.append(
+                        Finding(
+                            rule=RULE_ORDER,
+                            path=where[0],
+                            line=where[1],
+                            message=(
+                                "lock acquisition cycle: "
+                                + " -> ".join(cyc)
+                                + " — two threads taking the ends in "
+                                "opposite order deadlock"
+                            ),
+                            context="cycle:" + ">".join(sorted(set(cyc))),
+                            fix_hint=(
+                                "impose one global order (document it), or "
+                                "release the outer lock before calling into "
+                                "code that takes the inner one"
+                            ),
+                        )
+                    )
+        return findings
+
+
+class _WalkState:
+    """Statement walk of one method tracking held locks and loop depth.
+    Nested defs are walked with the same _MethodInfo (they run with the
+    method's ``self``) but inherit no held locks (they usually run later,
+    on another thread)."""
+
+    _COMPOUND = (
+        ast.If, ast.For, ast.AsyncFor, ast.While, ast.Try, ast.With,
+        ast.AsyncWith, ast.Match,
+    )
+
+    def __init__(self, checker, sf, model, info, param_types, classes,
+                 lock_attrs, nested_defs):
+        self.checker = checker
+        self.sf = sf
+        self.model = model
+        self.info = info
+        self.param_types = param_types
+        self.classes = classes
+        self.lock_attrs = lock_attrs
+        self.nested_defs = nested_defs
+
+    def walk(self, body, held, in_loop):
+        for stmt in body:
+            self.statement(stmt, held, in_loop)
+
+    def statement(self, stmt, held, in_loop):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.walk(stmt.body, held=(), in_loop=False)
+            return
+        if isinstance(stmt, ast.ClassDef):
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            new_held = list(held)
+            for item in stmt.items:
+                self.scan_expr(item.context_expr, held, in_loop)
+                lock_id = self._lock_id(item.context_expr)
+                if lock_id is not None:
+                    self.info.acquires.append((lock_id, stmt.lineno))
+                    for h in new_held:
+                        self.checker.add_edge(
+                            h, lock_id, (self.sf.rel, stmt.lineno)
+                        )
+                    new_held.append(lock_id)
+            self.walk(stmt.body, tuple(new_held), in_loop)
+            return
+        # header expressions of compound statements; whole simple ones
+        if isinstance(stmt, self._COMPOUND):
+            for header in self._headers(stmt):
+                self.scan_expr(header, held, in_loop)
+            enters_loop = isinstance(stmt, (ast.For, ast.While, ast.AsyncFor))
+            for name in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, name, None)
+                if sub:
+                    self.walk(sub, held, in_loop or enters_loop)
+            for h in getattr(stmt, "handlers", ()) or ():
+                self.walk(h.body, held, in_loop)
+            for case in getattr(stmt, "cases", ()) or ():
+                self.walk(case.body, held, in_loop)
+        else:
+            self.scan_expr(stmt, held, in_loop)
+
+    @staticmethod
+    def _headers(stmt):
+        for field in ("test", "iter", "target", "subject"):
+            v = getattr(stmt, field, None)
+            if v is not None:
+                yield v
+
+    def scan_expr(self, node, held, in_loop):
+        comp_calls = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)):
+                for inner in ast.walk(sub):
+                    if isinstance(inner, ast.Call):
+                        comp_calls.add(id(inner))
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            if isinstance(sub, ast.Call):
+                self._call(sub, held, in_loop or id(sub) in comp_calls)
+            elif isinstance(sub, ast.Attribute):
+                self._access(sub, held)
+
+    def _call(self, call, held, in_loop):
+        cname = call_name(call)
+        if cname is not None and (
+            cname in ("threading.Thread", "threading.Timer", "Thread", "Timer")
+            or cname.endswith(".submit")
+            or cname.endswith("start_new_thread")
+        ):
+            self._entry(call, in_loop)
+        if cname and cname.startswith("self.") and cname.count(".") == 1:
+            m = cname.split(".", 1)[1]
+            self.info.calls.add(m)
+            self.info.calls_under.append((tuple(held), m))
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            a = attr_chain(arg)
+            if a and a.startswith("self.") and a.count(".") == 1:
+                self.model.method_args_passed.add(a.split(".", 1)[1])
+
+    def _entry(self, call, in_loop):
+        target = None
+        for kw in call.keywords:
+            if kw.arg == "target":
+                target = kw.value
+        cname = call_name(call) or ""
+        if target is None and call.args:
+            if cname.endswith(".submit") or cname.endswith("start_new_thread"):
+                target = call.args[0]
+            elif "Timer" in cname and len(call.args) >= 2:
+                target = call.args[1]
+        if target is None:
+            return
+        t = attr_chain(target)
+        if t and t.startswith("self.") and t.count(".") == 1:
+            name = t.split(".", 1)[1]
+            self.model.entries[name] = self.model.entries.get(name, False) or in_loop
+        elif isinstance(target, ast.Name):
+            if target.id in self.nested_defs:
+                self.model.entries[target.id] = (
+                    self.model.entries.get(target.id, False) or in_loop
+                )
+            else:
+                self.model.has_dynamic_target = True
+
+    def _access(self, node: ast.Attribute, held):
+        if not isinstance(node.value, ast.Name):
+            return
+        base = node.value.id
+        if base == "self":
+            cls = self.model.name
+        elif base in self.param_types:
+            cls = self.param_types[base]
+        else:
+            return
+        kind = "write" if isinstance(node.ctx, (ast.Store, ast.Del)) else "read"
+        self.info.accesses.append(
+            _Access(node.attr, kind, node.lineno, self.info.name, bool(held), cls)
+        )
+
+    def _lock_id(self, expr) -> str | None:
+        chain = attr_chain(expr)
+        if chain is None:
+            return None
+        parts = chain.split(".")
+        if len(parts) != 2:
+            return None
+        base, attr = parts
+        if base == "self":
+            if attr in self.model.lock_attrs:
+                return f"{self.model.module}:{self.model.name}.{attr}"
+            return None
+        cls = self.param_types.get(base)
+        if cls is not None and attr in self.lock_attrs.get(cls, ()):
+            return f"{self.model.module}:{cls}.{attr}"
+        return None
+
+
+def _guaranteed_held(model: _ClassModel) -> set[str]:
+    """Methods provably entered ONLY with a lock already held: every
+    in-class call edge to them either carries a lexically-held lock or
+    comes from another guaranteed method (fixed point).  Thread entries
+    and the public surface are never guaranteed — an external caller
+    holds nothing.  This is what lets the engine's _tick_lock-serialized
+    reload tick count its callees' writes as guarded."""
+    cached = getattr(model, "_guaranteed", None)
+    if cached is not None:
+        return cached
+    edges: dict[str, list[tuple[str, bool]]] = {}
+    for info in model.methods.values():
+        for held_ids, callee in info.calls_under:
+            edges.setdefault(callee, []).append((info.name, bool(held_ids)))
+    unguardable = {m for m in model.methods if not m.startswith("_")}
+    unguardable |= set(model.entries) | {"__init__"}
+    guaranteed: set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for m in model.methods:
+            if m in guaranteed or m in unguardable:
+                continue
+            inc = edges.get(m)
+            if not inc:
+                continue
+            if all(held or caller in guaranteed for caller, held in inc):
+                guaranteed.add(m)
+                changed = True
+    model._guaranteed = guaranteed
+    return guaranteed
+
+
+def _method_contexts(model: _ClassModel, method: str) -> set[str]:
+    if model._reach is None:
+        reach = {}
+        for entry in model.entries:
+            reach[entry] = _closure(model, {entry})
+        public = {m for m in model.methods if not m.startswith("_")}
+        public.add("__init__")
+        reach["__caller__"] = _closure(model, public)
+        model._reach = reach
+    out = set()
+    for entry in model.entries:
+        if method in model._reach[entry]:
+            out.add(f"thread:{entry}")
+    if method in model._reach["__caller__"]:
+        out.add("caller")
+    if not out:
+        out.add("caller")
+    return out
+
+
+def _closure(model: _ClassModel, roots: set[str]) -> set[str]:
+    seen = set(roots)
+    frontier = list(roots)
+    while frontier:
+        m = frontier.pop()
+        info = model.methods.get(m)
+        if info is None:
+            continue
+        for callee in info.calls:
+            if callee not in seen:
+                seen.add(callee)
+                frontier.append(callee)
+    return seen

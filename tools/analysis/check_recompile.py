@@ -1,0 +1,321 @@
+"""recompile-hazard: patterns that make steady state XLA-compile.
+
+The zero-steady-recompile invariant is pinned at runtime by the compile
+sentinel (telemetry.CompileSentinel), but only on paths a test drives.
+This checker catches the constructions statically:
+
+  * **jit-in-loop** — ``jax.jit(...)`` called lexically inside a
+    for/while body (or in a def nested inside one): a fresh callable per
+    iteration means a fresh trace+compile per iteration.
+  * **uncached jit** — the PR-7 ``make_replicator`` class: a jit result
+    built inside a function and neither returned, nor stored on
+    self/module/class state, nor immediately stored into a cache
+    container.  Each call of the enclosing function compiles again.
+    (``jax.jit(f)(x)`` — construct-and-invoke — is the degenerate case.)
+  * **traced Python scalar** — a known-jitted callable invoked with a
+    raw loop variable argument: every distinct Python value retraces
+    unless the arg is marked static or wrapped in an array.
+  * **out-of-ledger lowering** — ``.lower(args)`` (with arguments — the
+    zero-arg form is str.lower) or ``.cost_analysis()`` outside
+    profiling.py: re-lowering is how the CostLedger measures cost
+    WITHOUT a second backend compile, and it owns the one sanctioned
+    call site; anywhere else risks paying compile twice.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from analysis.core import (
+    Finding,
+    RepoContext,
+    attr_chain,
+    call_name,
+    enclosing_function,
+    jax_aliases,
+    parent_map,
+    resolves_to,
+)
+
+RULE = "recompile-hazard"
+
+# Files allowed to call .lower()/.cost_analysis(): the cost ledger owns
+# re-lowering (one per program, off the hot path, documented in DESIGN
+# §"Profiling & data statistics").
+LOWER_ALLOWED = {"fast_tffm_tpu/profiling.py"}
+
+
+def _is_jit(call: ast.Call, aliases) -> bool:
+    name = call_name(call)
+    return name is not None and (
+        resolves_to(name, "jax.jit", aliases)
+        or resolves_to(name, "jax.pjit", aliases)
+    )
+
+
+def _jit_callables(tree: ast.AST, aliases) -> set[str]:
+    """Names (as written at call sites) bound to jitted callables in this
+    module — the traced-scalar check's target set."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if _is_jit(node.value, aliases):
+                for tgt in node.targets:
+                    name = attr_chain(tgt)
+                    if name:
+                        out.add(name)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) and _is_jit(dec, aliases):
+                    out.add(node.name)
+                elif not isinstance(dec, ast.Call):
+                    dname = attr_chain(dec)
+                    if dname and resolves_to(dname, "jax.jit", aliases):
+                        out.add(node.name)
+    return out
+
+
+def _loop_ancestors(node, parents):
+    cur = parents.get(node)
+    while cur is not None:
+        yield cur
+        cur = parents.get(cur)
+
+
+class RecompileChecker:
+    name = "recompile"
+    rules = (RULE,)
+    description = "constructions that compile in steady state"
+
+    def run(self, ctx: RepoContext) -> list[Finding]:
+        findings: list[Finding] = []
+        for sf in ctx.files:
+            tree = sf.tree
+            if tree is None:
+                continue
+            aliases = jax_aliases(tree)
+            parents = parent_map(tree)
+            jitted = _jit_callables(tree, aliases)
+            loop_vars = self._loop_vars(tree)
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Call) and _is_jit(node, aliases):
+                    findings.extend(
+                        self._check_jit_site(sf, node, parents)
+                    )
+                if isinstance(node, ast.Call):
+                    findings.extend(
+                        self._check_traced_scalar(
+                            sf, node, parents, jitted, loop_vars
+                        )
+                    )
+                    findings.extend(self._check_lower(sf, node, parents))
+        return findings
+
+    # -- jit construction sites ----------------------------------------
+
+    def _check_jit_site(self, sf, call: ast.Call, parents):
+        func_anchor = enclosing_function(call, parents)
+        # (a) lexically inside a loop (crossing no function boundary —
+        # a def inside the loop resets the judgment to the def's own
+        # sinks, but the def CALL per iteration is the factory pattern
+        # and factories are fine)
+        for anc in _loop_ancestors(call, parents):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                break
+            if isinstance(anc, (ast.For, ast.While, ast.AsyncFor)):
+                return [
+                    Finding(
+                        rule=RULE,
+                        path=sf.rel,
+                        line=call.lineno,
+                        message=(
+                            "jax.jit called inside a loop — a fresh callable "
+                            "(and a fresh trace+compile) per iteration"
+                        ),
+                        context=f"{func_anchor}:jit-in-loop",
+                        fix_hint=(
+                            "hoist the jit out of the loop, or cache the "
+                            "callable keyed by what actually varies "
+                            "(treedef/shape), as dist_train's replicator does"
+                        ),
+                    )
+                ]
+        # (b) uncached per-call construction
+        sink = self._jit_sink(call, parents)
+        if sink == "uncached":
+            return [
+                Finding(
+                    rule=RULE,
+                    path=sf.rel,
+                    line=call.lineno,
+                    message=(
+                        "jitted callable constructed per call and never "
+                        "cached — each invocation of "
+                        f"{func_anchor.split('.')[-1]}() traces and "
+                        "compiles again (the PR-7 fresh-jit-per-save class)"
+                    ),
+                    context=f"{func_anchor}:uncached-jit",
+                    severity="warning",
+                    fix_hint=(
+                        "store the jitted fn on self/module at init, return "
+                        "it from a factory, or memoize it in a dict keyed "
+                        "by the varying part"
+                    ),
+                )
+            ]
+        return []
+
+    def _jit_sink(self, call: ast.Call, parents) -> str:
+        """'ok' when the jit result is cached/returned; 'uncached' when it
+        is provably call-local (assigned to a local never returned, or
+        invoked and discarded) inside a function."""
+        parent = parents.get(call)
+        fn = None
+        for anc in _loop_ancestors(call, parents):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn = anc
+                break
+        if fn is None:
+            return "ok"  # module/class level: compiled once per import
+        # construct-and-invoke: jax.jit(f)(x)
+        if isinstance(parent, ast.Call) and parent.func is call:
+            return "uncached"
+        if isinstance(parent, ast.Return):
+            return "ok"  # factory
+        if isinstance(parent, ast.Assign):
+            local_names = []
+            for tgt in parent.targets:
+                name = attr_chain(tgt)
+                if name is None:
+                    return "ok"  # starred/subscript target: assume cached
+                if "." in name or isinstance(tgt, ast.Subscript):
+                    return "ok"  # self._f = jit(...) / cache[k] = jit(...)
+                local_names.append(name)
+            # a local: cached only if it escapes — returned, yielded,
+            # stored onto an attribute/subscript, or closed over by a
+            # returned def
+            for name in local_names:
+                if self._escapes(fn, name):
+                    return "ok"
+            return "uncached"
+        # any other context (argument to a call, tuple element, with
+        # item...): assume it escapes
+        return "ok"
+
+    @staticmethod
+    def _value_reads(expr: ast.AST, name: str) -> bool:
+        """Does ``name`` appear in ``expr`` as a VALUE (escaping), not
+        merely as the func of a call?  ``return f`` escapes; ``return
+        f(x)`` just uses the throwaway callable one time."""
+        skip_funcs = {
+            id(node.func)
+            for node in ast.walk(expr)
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+        }
+        return any(
+            isinstance(sub, ast.Name)
+            and sub.id == name
+            and id(sub) not in skip_funcs
+            for sub in ast.walk(expr)
+        )
+
+    @classmethod
+    def _escapes(cls, fn: ast.AST, name: str) -> bool:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Return) and node.value is not None:
+                if cls._value_reads(node.value, name):
+                    return True
+            # stored beyond the frame: self.x = f / cache[k] = f
+            if isinstance(node, ast.Assign):
+                if cls._value_reads(node.value, name):
+                    for tgt in node.targets:
+                        if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                            return True
+            # nested defs (closures) reading the name count as escapes —
+            # the closure may be returned or stored
+            if node is not fn and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                body = node.body if isinstance(node.body, list) else [node.body]
+                for stmt in body:
+                    for sub in ast.walk(stmt):
+                        if isinstance(sub, ast.Name) and sub.id == name:
+                            return True
+        return False
+
+    # -- traced Python scalars -----------------------------------------
+
+    @staticmethod
+    def _loop_vars(tree: ast.AST) -> set[str]:
+        out: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                for sub in ast.walk(node.target):
+                    if isinstance(sub, ast.Name):
+                        out.add(sub.id)
+        return out
+
+    def _check_traced_scalar(self, sf, call: ast.Call, parents, jitted, loop_vars):
+        name = call_name(call)
+        if name is None or name not in jitted:
+            return []
+        # only flag when the call site is itself inside a loop — a
+        # loop var used once after the loop is a fixed value
+        in_loop = any(
+            isinstance(a, (ast.For, ast.While, ast.AsyncFor))
+            for a in _loop_ancestors(call, parents)
+        )
+        if not in_loop:
+            return []
+        out = []
+        for arg in call.args:
+            if isinstance(arg, ast.Name) and arg.id in loop_vars:
+                out.append(
+                    Finding(
+                        rule=RULE,
+                        path=sf.rel,
+                        line=call.lineno,
+                        message=(
+                            f"loop variable {arg.id!r} passed raw into jitted "
+                            f"{name!r} — each distinct Python value retraces "
+                            "and recompiles"
+                        ),
+                        context=(
+                            f"{enclosing_function(call, parents)}:"
+                            f"scalar:{arg.id}"
+                        ),
+                        severity="warning",
+                        fix_hint=(
+                            "wrap it (jnp.asarray / device_put) so the shape"
+                            "/dtype is what's traced, or mark it static if "
+                            "it really selects a program"
+                        ),
+                    )
+                )
+        return out
+
+    # -- out-of-ledger lowering ----------------------------------------
+
+    def _check_lower(self, sf, call: ast.Call, parents):
+        if sf.rel in LOWER_ALLOWED or not sf.rel.startswith("fast_tffm_tpu/"):
+            return []
+        if not isinstance(call.func, ast.Attribute):
+            return []
+        attr = call.func.attr
+        if attr == "cost_analysis" or (attr == "lower" and call.args):
+            return [
+                Finding(
+                    rule=RULE,
+                    path=sf.rel,
+                    line=call.lineno,
+                    message=(
+                        f".{attr}() outside the cost ledger — re-lowering "
+                        "belongs to profiling.py (one per program, no second "
+                        "backend compile); anywhere else risks compiling twice"
+                    ),
+                    context=f"{enclosing_function(call, parents)}:{attr}",
+                    severity="warning",
+                    fix_hint="route through profiling.CostLedger's delegated .lower",
+                )
+            ]
+        return []

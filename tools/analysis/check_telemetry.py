@@ -1,0 +1,147 @@
+"""telemetry: RunMonitor envelope conformance (the absorbed fifth checker).
+
+The envelope only means something if EVERY record flows through
+``telemetry.RunMonitor`` and a kind registered in ``telemetry.SCHEMAS``.
+RunMonitor.emit raises on unknown kinds at runtime — but only on code
+paths a test actually drives; a new module quietly constructing its own
+``MetricsLogger`` (or calling ``.log(kind=...)`` raw) forks the schema
+without tripping anything.  The rules (unchanged from the old
+tools/check_telemetry.py, now AST-resolved on the shared parse instead
+of regexes, so a prose mention of ``MetricsLogger(`` in a docstring no
+longer needs special-casing):
+
+  1. ``MetricsLogger(...)`` may only be CONSTRUCTED inside the telemetry
+     layer (telemetry.py owns it; utils/tracing.py defines it).
+  2. Raw ``.log(kind=...)`` may only appear in the documented duck-type
+     fallback (serving/metrics.py log_to) and tracing.py itself.
+  3. Every string-literal kind passed to ``.emit("<kind>", ...)`` in the
+     package must be registered in SCHEMAS.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+
+from analysis.core import Finding, RepoContext, call_name, enclosing_function, parent_map
+
+RULE = "telemetry"
+
+ALLOW_LOGGER_CONSTRUCTION = {
+    "fast_tffm_tpu/telemetry.py",  # RunMonitor owns the logger
+    "fast_tffm_tpu/utils/tracing.py",  # defines MetricsLogger
+}
+
+ALLOW_RAW_KIND_LOG = {
+    "fast_tffm_tpu/utils/tracing.py",  # the logger's own implementation
+    "fast_tffm_tpu/serving/metrics.py",  # documented duck-type fallback:
+    #   log_to() accepts a bare MetricsLogger for envelope-less callers;
+    #   every in-tree engine passes a RunMonitor (the emit() path)
+}
+
+
+def _default_schemas(root: str):
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    from fast_tffm_tpu.telemetry import SCHEMAS  # jax-free import
+
+    return SCHEMAS
+
+
+class TelemetryChecker:
+    """``schemas`` is injectable for fixture tests; by default the real
+    telemetry.SCHEMAS imports off ``ctx.root`` (telemetry.py is jax-free
+    by design — PEP 562 lazy package imports, PR 4)."""
+
+    name = "telemetry"
+    rules = (RULE,)
+    description = "every telemetry record rides the RunMonitor envelope"
+
+    def __init__(self, schemas=None, package_prefix: str = "fast_tffm_tpu/"):
+        self._schemas = schemas
+        self._prefix = package_prefix
+
+    def run(self, ctx: RepoContext) -> list[Finding]:
+        schemas = self._schemas
+        if schemas is None:
+            schemas = _default_schemas(ctx.root)
+        findings: list[Finding] = []
+        for sf in ctx.files:
+            if not sf.rel.startswith(self._prefix):
+                continue
+            tree = sf.tree
+            if tree is None:
+                continue
+            parents = parent_map(tree)
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                anchor = enclosing_function(node, parents)
+                if (
+                    name is not None
+                    and name.split(".")[-1] == "MetricsLogger"
+                    and sf.rel not in ALLOW_LOGGER_CONSTRUCTION
+                ):
+                    findings.append(
+                        Finding(
+                            rule=RULE,
+                            path=sf.rel,
+                            line=node.lineno,
+                            message=(
+                                "MetricsLogger constructed outside the "
+                                "telemetry layer — emit through a RunMonitor "
+                                "(telemetry.py) so the record carries the "
+                                "envelope"
+                            ),
+                            context=f"{anchor}:logger-construction",
+                            fix_hint="build a RunMonitor (or accept one) instead",
+                        )
+                    )
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "log"
+                    and any(kw.arg == "kind" for kw in node.keywords)
+                    and sf.rel not in ALLOW_RAW_KIND_LOG
+                ):
+                    findings.append(
+                        Finding(
+                            rule=RULE,
+                            path=sf.rel,
+                            line=node.lineno,
+                            message=(
+                                "raw .log(kind=...) bypasses RunMonitor.emit "
+                                "— the record gets no envelope and no schema "
+                                "check"
+                            ),
+                            context=f"{anchor}:raw-log",
+                            fix_hint="call monitor.emit(<kind>, ...) instead",
+                        )
+                    )
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "emit"
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                ):
+                    kind = node.args[0].value
+                    if kind not in schemas:
+                        findings.append(
+                            Finding(
+                                rule=RULE,
+                                path=sf.rel,
+                                line=node.lineno,
+                                message=(
+                                    f"emit of unregistered kind {kind!r} — "
+                                    "register it (and its required keys) in "
+                                    "telemetry.SCHEMAS"
+                                ),
+                                context=f"{anchor}:kind:{kind}",
+                                fix_hint=(
+                                    "add the kind to SCHEMAS and cover it in "
+                                    "the table-driven test_telemetry suite"
+                                ),
+                            )
+                        )
+        return findings

@@ -1,0 +1,461 @@
+"""Shared infrastructure for the invariant checker suite.
+
+Everything a checker needs that is not rule logic lives here: the
+``Finding`` model (rule, severity, stable baseline key, fix hint), the
+parsed-source cache (``RepoContext`` parses each file once; all five
+checkers share the ASTs), per-line suppression comments, the committed
+baseline (pre-existing findings are pinned with a written justification;
+any NEW finding fails ``--strict``), and the human/JSON renderers.
+
+Stdlib-only on purpose: the suite must run on a machine that cannot
+import jax (CI collectors, a laptop triaging a diff).
+
+Suppression syntax, on the flagged line or the line directly above::
+
+    # analysis: ok <rule> <reason>
+
+The reason is REQUIRED — a bare suppression is itself an error finding
+(rule ``suppression``), so silencing a rule always leaves a written
+trace next to the code it excuses.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+import sys
+
+SEVERITIES = ("error", "warning")
+
+# One place for every rule name so run.py, report.py and the tests agree.
+RULES = (
+    "donation-after-use",
+    "recompile-hazard",
+    "lock-discipline",
+    "lock-order",
+    "config-key",
+    "telemetry",
+    "suppression",
+    "parse",
+)
+
+
+@dataclasses.dataclass
+class Finding:
+    """One violation.  ``context`` is the stable anchor the baseline keys
+    on (function/attr/key names — survives line-number drift, unlike
+    ``line``, which is for humans and clickable editors).  ``ordinal``
+    disambiguates same-context repeats (a SECOND uncached jit in the
+    same function must read as NEW, not ride the first one's pin) —
+    assigned by :func:`disambiguate` after a run."""
+
+    rule: str
+    path: str  # repo-relative, '/'-separated
+    line: int
+    message: str
+    severity: str = "error"
+    context: str = ""
+    fix_hint: str = ""
+    ordinal: int = 1
+
+    @property
+    def key(self) -> str:
+        base = f"{self.rule}::{self.path}::{self.context or self.message}"
+        return base if self.ordinal <= 1 else f"{base}#{self.ordinal}"
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["key"] = self.key
+        return d
+
+    def render(self) -> str:
+        sev = "" if self.severity == "error" else f" [{self.severity}]"
+        hint = f"\n      fix: {self.fix_hint}" if self.fix_hint else ""
+        return f"{self.path}:{self.line}:{sev} {self.message}{hint}"
+
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*analysis:\s*ok\s+([a-z][a-z0-9-]*)\b[ \t]*(.*)$"
+)
+
+
+class SourceFile:
+    """One parsed file: text, lines, AST (lazy), suppression map."""
+
+    def __init__(self, abspath: str, rel: str):
+        self.abspath = abspath
+        self.rel = rel
+        with open(abspath, encoding="utf-8") as f:
+            self.text = f.read()
+        self.lines = self.text.splitlines()
+        self._tree: ast.AST | None = None
+        self._parse_error: SyntaxError | None = None
+        # line -> list[(rule, reason)]; reason may be "" (an error).
+        # Tokenized, not line-regexed: the marker inside a STRING literal
+        # ("# analysis: ok recompile-hazard ...") must not mute anything.
+        self.suppressions: dict[int, list[tuple[str, str]]] = {}
+        import io
+        import tokenize
+
+        try:
+            tokens = list(tokenize.generate_tokens(io.StringIO(self.text).readline))
+        except (tokenize.TokenError, SyntaxError, IndentationError):
+            tokens = []  # unparseable file: rule=parse reports it anyway
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if m:
+                self.suppressions.setdefault(tok.start[0], []).append(
+                    (m.group(1), m.group(2).strip())
+                )
+
+    @property
+    def tree(self) -> ast.AST | None:
+        if self._tree is None and self._parse_error is None:
+            try:
+                self._tree = ast.parse(self.text, filename=self.rel)
+            except SyntaxError as e:
+                self._parse_error = e
+        return self._tree
+
+    @property
+    def parse_error(self) -> SyntaxError | None:
+        self.tree  # trigger the lazy parse
+        return self._parse_error
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        """A finding at ``line`` is suppressed by a REASONED ok-comment
+        for its rule on the same line or the line directly above."""
+        for ln in (line, line - 1):
+            for r, reason in self.suppressions.get(ln, ()):
+                if r == rule and reason:
+                    return True
+        return False
+
+
+class RepoContext:
+    """The shared input every checker runs against: the repo root and the
+    parsed files.  Construction never raises on bad source — syntax
+    errors surface as rule=``parse`` findings so one broken file cannot
+    hide the rest of the report."""
+
+    def __init__(self, root: str, rels: list[str]):
+        self.root = os.path.abspath(root)
+        self.files: list[SourceFile] = []
+        self.parse_findings: list[Finding] = []
+        for rel in sorted(rels):
+            sf = SourceFile(os.path.join(self.root, rel), rel.replace(os.sep, "/"))
+            self.files.append(sf)
+            if sf.parse_error is not None:
+                e = sf.parse_error
+                self.parse_findings.append(
+                    Finding(
+                        rule="parse",
+                        path=sf.rel,
+                        line=e.lineno or 0,
+                        message=f"syntax error: {e.msg}",
+                        context=f"syntax:{e.lineno}",
+                    )
+                )
+
+    def file(self, rel: str) -> SourceFile | None:
+        rel = rel.replace(os.sep, "/")
+        for sf in self.files:
+            if sf.rel == rel:
+                return sf
+        return None
+
+    def package_files(self, prefix: str = "fast_tffm_tpu/") -> list[SourceFile]:
+        return [f for f in self.files if f.rel.startswith(prefix)]
+
+
+DEFAULT_EXCLUDE_DIRS = {
+    "__pycache__", ".git", "csrc", "docs", "data", "configs", "tests"
+}
+
+
+def discover(root: str) -> list[str]:
+    """Default target set: the package, tools (including this suite),
+    and the top-level drivers.  tests/ is excluded on purpose — its
+    fixtures (including test_analysis's own) violate rules by design."""
+    rels: list[str] = []
+    for base in ("fast_tffm_tpu", "tools"):
+        for dirpath, dirnames, filenames in os.walk(os.path.join(root, base)):
+            dirnames[:] = [d for d in dirnames if d not in DEFAULT_EXCLUDE_DIRS]
+            for fn in filenames:
+                if fn.endswith(".py"):
+                    rels.append(
+                        os.path.relpath(os.path.join(dirpath, fn), root)
+                    )
+    for fn in ("bench.py", "bench_all.py", "fast_tffm.py"):
+        if os.path.isfile(os.path.join(root, fn)):
+            rels.append(fn)
+    return rels
+
+
+def disambiguate(findings: list[Finding]) -> list[Finding]:
+    """Assign ordinals so same-base-key findings get distinct keys in
+    source order ('...#2', '...#3').  Removing an occurrence shifts the
+    survivors DOWN (never up), so a stale pin goes stale — it can never
+    absorb a genuinely new occurrence."""
+    counts: dict[str, int] = {}
+    for f in sorted(findings, key=lambda f: (f.rule, f.path, f.line)):
+        f.ordinal = 1  # key reads the base form during the count
+        n = counts.get(f.key, 0) + 1
+        counts[f.key] = n
+        f.ordinal = n
+    return findings
+
+
+# -- suppression application ----------------------------------------------
+
+
+def apply_suppressions(
+    findings: list[Finding], ctx: RepoContext
+) -> list[Finding]:
+    """Drop findings covered by a reasoned ok-comment; add one
+    rule=``suppression`` error per REASON-LESS ok-comment anywhere in the
+    tree (a silent mute is worse than the finding it hides)."""
+    out = []
+    for f in findings:
+        sf = ctx.file(f.path)
+        if sf is not None and sf.suppressed(f.rule, f.line):
+            continue
+        out.append(f)
+    for sf in ctx.files:
+        for ln, entries in sorted(sf.suppressions.items()):
+            for rule, reason in entries:
+                if not reason:
+                    out.append(
+                        Finding(
+                            rule="suppression",
+                            path=sf.rel,
+                            line=ln,
+                            message=(
+                                f"suppression for {rule!r} has no reason — "
+                                "'# analysis: ok <rule> <reason>' requires one"
+                            ),
+                            context=f"bare:{rule}:{ln}",
+                            fix_hint="append the reason the rule is okay to break here",
+                        )
+                    )
+                elif rule not in RULES:
+                    out.append(
+                        Finding(
+                            rule="suppression",
+                            path=sf.rel,
+                            line=ln,
+                            message=f"suppression names unknown rule {rule!r}",
+                            context=f"unknown:{rule}:{ln}",
+                            fix_hint="rules: " + ", ".join(r for r in RULES),
+                        )
+                    )
+    return out
+
+
+# -- baseline --------------------------------------------------------------
+
+
+def load_baseline(path: str) -> dict:
+    """{"version": 1, "pinned": [{key, justification, ...}]} → key map."""
+    if not os.path.isfile(path):
+        return {}
+    with open(path) as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or "pinned" not in data:
+        raise ValueError(f"{path}: not a baseline file (no 'pinned' list)")
+    out = {}
+    for entry in data["pinned"]:
+        out[entry["key"]] = entry
+    return out
+
+
+def write_baseline(
+    path: str, findings: list[Finding], justifications=None, keep_entries=()
+) -> None:
+    """Pin the given findings.  ``justifications`` maps key (or rule, as
+    a fallback) → text; unpinned-without-text entries get an empty
+    justification, which --strict then refuses — writing a baseline is
+    not the same as justifying it.  ``keep_entries`` carries existing
+    pins to preserve verbatim (a partial --rules regeneration must not
+    erase other checkers' debt)."""
+    justifications = justifications or {}
+    seen = set()
+    pinned = []
+    for entry in keep_entries:
+        if entry["key"] not in seen:
+            seen.add(entry["key"])
+            pinned.append(entry)
+    for f in sorted(findings, key=lambda f: (f.rule, f.path, f.line)):
+        if f.key in seen:
+            continue
+        seen.add(f.key)
+        pinned.append(
+            {
+                "key": f.key,
+                "rule": f.rule,
+                "path": f.path,
+                "severity": f.severity,
+                "message": f.message,
+                "justification": justifications.get(
+                    f.key, justifications.get(f.rule, "")
+                ),
+            }
+        )
+    pinned.sort(key=lambda e: e["key"])
+    with open(path, "w") as f:
+        json.dump({"version": 1, "pinned": pinned}, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def partition(findings: list[Finding], baseline: dict):
+    """(new, pinned, stale_keys): findings not in the baseline, findings
+    the baseline covers, and baseline keys with no live finding (paid-off
+    debt — prune them)."""
+    new, pinned = [], []
+    live_keys = set()
+    for f in findings:
+        live_keys.add(f.key)
+        (pinned if f.key in baseline else new).append(f)
+    stale = sorted(set(baseline) - live_keys)
+    return new, pinned, stale
+
+
+def unjustified(baseline: dict) -> list[str]:
+    return sorted(
+        k for k, e in baseline.items() if not (e.get("justification") or "").strip()
+    )
+
+
+# -- AST helpers shared by the checkers ------------------------------------
+
+
+def attr_chain(node: ast.AST) -> str | None:
+    """'self._mark', 'jax.jit', 'slot.lock' — or None when the expression
+    is not a plain dotted name."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> str | None:
+    return attr_chain(call.func)
+
+
+def jax_aliases(tree: ast.AST) -> dict[str, str]:
+    """Import-aware names: {'jit': 'jax.jit', 'partial':
+    'functools.partial', ...} for this module."""
+    out: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+def resolves_to(name: str, target: str, aliases: dict[str, str]) -> bool:
+    """Does dotted ``name`` (as written) denote ``target`` (canonical,
+    e.g. 'jax.jit') under this module's imports?"""
+    if name == target:
+        return True
+    head, _, rest = name.partition(".")
+    full = aliases.get(head)
+    if full is None:
+        return False
+    return (full + ("." + rest if rest else "")) == target
+
+
+def parent_map(tree: ast.AST) -> dict[ast.AST, ast.AST]:
+    out = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            out[child] = node
+    return out
+
+
+def enclosing_function(node: ast.AST, parents: dict) -> str:
+    """Dotted qualname-ish anchor: 'Router._on_down' / '<module>'."""
+    names = []
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            names.append(cur.name)
+        cur = parents.get(cur)
+    return ".".join(reversed(names)) or "<module>"
+
+
+# -- output ----------------------------------------------------------------
+
+
+def render_text(
+    findings: list[Finding], new: list[Finding], stale: list[str],
+    baseline: dict, strict: bool,
+) -> str:
+    L = []
+    by_rule: dict[str, list[Finding]] = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, []).append(f)
+    for rule in sorted(by_rule):
+        fs = by_rule[rule]
+        L.append(f"[{rule}] {len(fs)} finding(s):")
+        for f in sorted(fs, key=lambda f: (f.path, f.line)):
+            mark = "NEW " if f in new else ""
+            L.append(f"  {mark}{f.render()}")
+    errs = sum(1 for f in findings if f.severity == "error")
+    L.append(
+        f"analysis: {len(findings)} finding(s) ({errs} error(s)), "
+        f"{len(new)} new vs baseline, {len(baseline)} pinned, {len(stale)} stale"
+    )
+    if stale:
+        L.append(
+            "stale baseline entries (debt paid off — prune them from the "
+            "baseline file):"
+        )
+        L += [f"  {k}" for k in stale]
+    bad = unjustified(baseline)
+    if bad and strict:
+        L.append("baseline entries missing a justification:")
+        L += [f"  {k}" for k in bad]
+    return "\n".join(L)
+
+
+def to_json(findings, new, stale, baseline, root) -> dict:
+    by_rule: dict[str, int] = {}
+    by_sev: dict[str, int] = {}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+        by_sev[f.severity] = by_sev.get(f.severity, 0) + 1
+    return {
+        "version": 1,
+        "root": root,
+        "counts": {"by_rule": by_rule, "by_severity": by_sev},
+        "baseline": {
+            "pinned": len(baseline),
+            "stale": len(stale),
+            "unjustified": len(unjustified(baseline)),
+            "debt": len(findings) - len(new),
+        },
+        "new": [f.to_dict() for f in new],
+        "findings": [f.to_dict() for f in findings],
+    }
+
+
+def _tools_on_path() -> None:
+    tools = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if tools not in sys.path:
+        sys.path.insert(0, tools)
+
+
+_tools_on_path()

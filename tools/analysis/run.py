@@ -1,0 +1,205 @@
+#!/usr/bin/env python
+"""Run the invariant checker suite; gate on NEW findings.
+
+    python tools/analysis/run.py                    # report everything
+    python tools/analysis/run.py --strict           # tier-1 gate: exit 1 on
+                                                    #   any finding not pinned
+                                                    #   in the baseline (or a
+                                                    #   baseline entry with no
+                                                    #   justification)
+    python tools/analysis/run.py --json out.json    # machine output (the
+                                                    #   report.py Analysis
+                                                    #   section's input)
+    python tools/analysis/run.py --rules locks,config
+    python tools/analysis/run.py --write-baseline   # pin the current findings
+                                                    #   (justifications still
+                                                    #   owed: --strict refuses
+                                                    #   empty ones)
+
+Exit codes: 0 = conformant; 1 = gate failed (--strict only); 2 = usage.
+Stdlib-only — the suite runs where jax can't import.
+
+Baseline policy: ``baseline.json`` (committed next to this file) pins
+pre-existing findings by stable key with a WRITTEN justification each.
+New findings fail --strict; paying off debt leaves stale entries the
+report tells you to prune.  Per-line escapes use the suppression
+comment (``# analysis: ok <rule> <reason>``) — reasons required there
+too.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_TOOLS = os.path.dirname(_HERE)
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+from analysis import core  # noqa: E402
+from analysis.check_config import ConfigChecker  # noqa: E402
+from analysis.check_donation import DonationChecker  # noqa: E402
+from analysis.check_locks import LockChecker  # noqa: E402
+from analysis.check_recompile import RecompileChecker  # noqa: E402
+from analysis.check_telemetry import TelemetryChecker  # noqa: E402
+
+DEFAULT_BASELINE = os.path.join(_HERE, "baseline.json")
+
+CHECKERS = {
+    "donation": DonationChecker,
+    "recompile": RecompileChecker,
+    "locks": LockChecker,
+    "config": ConfigChecker,
+    "telemetry": TelemetryChecker,
+}
+
+
+def _rule_prefixes(rules) -> tuple[str, ...]:
+    """Baseline-key prefixes owned by the selected checkers (plus the
+    framework's own suppression/parse rules, which every run produces)."""
+    return tuple(
+        r + "::" for name in rules for r in CHECKERS[name]().rules
+    ) + ("suppression::", "parse::")
+
+
+def run_suite(root: str, rules=None, ctx: core.RepoContext | None = None):
+    """(findings, ctx) over ``root`` for the named checkers (all by
+    default).  Suppressions are already applied; baseline is not."""
+    if ctx is None:
+        ctx = core.RepoContext(root, core.discover(root))
+    findings = list(ctx.parse_findings)
+    for name, cls in CHECKERS.items():
+        if rules and name not in rules:
+            continue
+        findings.extend(cls().run(ctx))
+    findings = core.apply_suppressions(findings, ctx)
+    core.disambiguate(findings)
+    findings.sort(key=lambda f: (f.rule, f.path, f.line))
+    return findings, ctx
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="analysis",
+        description="AST invariant checkers: donation, recompile, locks, "
+        "config, telemetry.",
+    )
+    ap.add_argument(
+        "--root",
+        default=os.path.dirname(_TOOLS),
+        help="repo root to analyze (default: this checkout)",
+    )
+    ap.add_argument(
+        "--rules",
+        help="comma-separated checker subset: " + ",".join(CHECKERS),
+    )
+    ap.add_argument(
+        "--baseline",
+        default=DEFAULT_BASELINE,
+        help="baseline file pinning pre-existing findings",
+    )
+    ap.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="ignore the baseline (every finding reads as new)",
+    )
+    ap.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="pin the current findings into --baseline and exit",
+    )
+    ap.add_argument(
+        "--strict",
+        action="store_true",
+        help="exit 1 on new findings, unjustified baseline entries, or "
+        "reason-less suppressions",
+    )
+    ap.add_argument("--json", metavar="PATH", help="also write machine output here ('-' = stdout)")
+    args = ap.parse_args(argv)
+
+    rules = None
+    if args.rules:
+        rules = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = rules - set(CHECKERS)
+        if unknown:
+            print(f"analysis: unknown rule(s) {sorted(unknown)}", file=sys.stderr)
+            return 2
+
+    root = os.path.abspath(args.root)
+    findings, _ctx = run_suite(root, rules)
+
+    if args.write_baseline:
+        # Regeneration is non-destructive: justifications of persisting
+        # pins carry over, and a --rules subset run must not erase the
+        # OTHER checkers' debt — only the selected rules' pins rebuild.
+        # A CORRUPT existing baseline refuses loudly: rewriting over it
+        # would blank every hand-written justification with a success
+        # message.
+        try:
+            existing = core.load_baseline(args.baseline)
+        except (ValueError, json.JSONDecodeError) as e:
+            print(
+                f"analysis: refusing --write-baseline: existing "
+                f"{args.baseline} is unreadable ({e}) — fix or delete it "
+                "first (rewriting would discard every justification)",
+                file=sys.stderr,
+            )
+            return 2
+        keep = []
+        if rules is not None:
+            prefixes = _rule_prefixes(rules)
+            keep = [e for k, e in existing.items() if not k.startswith(prefixes)]
+        just = {
+            k: e.get("justification", "")
+            for k, e in existing.items()
+            if (e.get("justification") or "").strip()
+        }
+        core.write_baseline(args.baseline, findings, justifications=just,
+                            keep_entries=keep)
+        carried = sum(1 for f in findings if f.key in just)
+        print(
+            f"analysis: pinned {len(findings)} finding(s) into "
+            f"{args.baseline} ({carried} justification(s) carried over, "
+            f"{len(keep)} out-of-scope pin(s) preserved) — write the "
+            "missing justifications (--strict refuses empty ones)"
+        )
+        return 0
+
+    baseline = {} if args.no_baseline else core.load_baseline(args.baseline)
+    if rules is not None:
+        # a partial run must not read other checkers' pins as stale
+        baseline = {
+            k: v
+            for k, v in baseline.items()
+            if k.startswith(_rule_prefixes(rules))
+        }
+    new, _pinned, stale = core.partition(findings, baseline)
+    print(core.render_text(findings, new, stale, baseline, args.strict))
+
+    payload = core.to_json(findings, new, stale, baseline, root)
+    if args.json == "-":
+        print(json.dumps(payload, indent=1, sort_keys=True))
+    elif args.json:
+        with open(args.json, "w") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+            f.write("\n")
+
+    if args.strict:
+        problems = []
+        if new:
+            problems.append(f"{len(new)} new finding(s)")
+        bad = core.unjustified(baseline)
+        if bad:
+            problems.append(f"{len(bad)} baseline entr(y/ies) without justification")
+        if problems:
+            print("analysis: GATE FAILED — " + "; ".join(problems))
+            return 1
+    print("analysis: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

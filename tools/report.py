@@ -1120,6 +1120,80 @@ def compare(run: dict, base: dict, threshold: float, strict: bool = False):
     return "\n".join(L), regressions
 
 
+# -- static analysis ------------------------------------------------------
+
+
+def load_analysis(path: str) -> dict:
+    """Output of ``tools/analysis/run.py --json``; raises ValueError on
+    anything else."""
+    with open(path) as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or "counts" not in data or "baseline" not in data:
+        raise ValueError(f"{path}: not an analysis JSON (run.py --json output)")
+    return data
+
+
+def render_analysis(a: dict) -> str:
+    """The "Analysis" section: findings by rule/severity + baseline debt.
+    Debt = findings the committed baseline excuses; the compare gate
+    fails --strict when it grows."""
+    counts = a.get("counts", {})
+    base = a.get("baseline", {})
+    new = a.get("new", [])
+    L = ["## Analysis (static invariant checkers)", ""]
+    L.append("| rule | findings |")
+    L.append("|---|---:|")
+    for rule, n in sorted((counts.get("by_rule") or {}).items()):
+        L.append(f"| {rule} | {n} |")
+    if not (counts.get("by_rule") or {}):
+        L.append("| – | 0 |")
+    sev = counts.get("by_severity") or {}
+    L.append("")
+    L.append(
+        f"Severity: {sev.get('error', 0)} error(s), "
+        f"{sev.get('warning', 0)} warning(s).  Baseline debt: "
+        f"{base.get('debt', 0)} pinned finding(s)"
+        + (f", {base.get('stale', 0)} stale pin(s) to prune" if base.get("stale") else "")
+        + (
+            f", {base.get('unjustified', 0)} pin(s) MISSING a justification"
+            if base.get("unjustified")
+            else ""
+        )
+        + "."
+    )
+    if new:
+        L.append("")
+        L.append(f"**{len(new)} NEW finding(s) (not in the baseline):**")
+        for f in new[:20]:
+            L.append(
+                f"- `{f.get('rule')}` {f.get('path')}:{f.get('line')} — "
+                f"{f.get('message')}"
+            )
+        if len(new) > 20:
+            L.append(f"- … and {len(new) - 20} more")
+    L.append("")
+    return "\n".join(L)
+
+
+def compare_analysis(run_a: dict, base_a: dict) -> list[str]:
+    """Strict-gate regressions: baseline-debt growth and new findings.
+    (run.py --strict already fails on new findings in CI; this gate
+    catches the debt creeping up between two otherwise-green runs —
+    i.e. someone re-baselining instead of fixing.)"""
+    regressions = []
+    rd = (run_a.get("baseline") or {}).get("debt", 0) or 0
+    bd = (base_a.get("baseline") or {}).get("debt", 0) or 0
+    if rd > bd:
+        regressions.append(
+            f"analysis baseline debt grew: {bd} -> {rd} pinned finding(s) "
+            "(fix findings instead of re-pinning them)"
+        )
+    rn, bn = len(run_a.get("new") or ()), len(base_a.get("new") or ())
+    if rn > bn:
+        regressions.append(f"new analysis findings: {bn} -> {rn}")
+    return regressions
+
+
 # -- bench wiring ---------------------------------------------------------
 
 
@@ -1209,6 +1283,18 @@ def main(argv=None) -> int:
         "measured-bytes-per-example regressions past --threshold",
     )
     ap.add_argument("--out", metavar="PATH", help="write the report here instead of stdout")
+    ap.add_argument(
+        "--analysis",
+        metavar="JSON",
+        help="static-analysis results (tools/analysis/run.py --json): "
+        "render an Analysis section; with --compare --strict, gate on "
+        "baseline-debt growth vs --analysis-base",
+    )
+    ap.add_argument(
+        "--analysis-base",
+        metavar="JSON",
+        help="baseline run's analysis JSON for the debt-growth gate",
+    )
     args = ap.parse_args(argv)
 
     def _load_many(paths):
@@ -1225,6 +1311,23 @@ def main(argv=None) -> int:
     title = ", ".join(os.path.basename(p) for p in args.run)
     text = render(run, title=title)
     rc = 0
+    if args.analysis_base and not args.analysis:
+        # A dropped --analysis must not silently skip the debt gate and
+        # exit 0 — half a flag pair is a usage error, not a pass.
+        print(
+            "report: --analysis-base requires --analysis (the run's own "
+            "analysis JSON) — debt gate would be silently skipped",
+            file=sys.stderr,
+        )
+        return 2
+    run_analysis = None
+    if args.analysis:
+        try:
+            run_analysis = load_analysis(args.analysis)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"report: {e}", file=sys.stderr)
+            return 2
+        text = text + "\n" + render_analysis(run_analysis)
     if args.compare:
         try:
             base = summarize(_load_many(args.compare))
@@ -1234,6 +1337,25 @@ def main(argv=None) -> int:
         cmp_text, regressions = compare(
             run, base, threshold=args.threshold, strict=args.strict
         )
+        if args.strict and run_analysis is not None:
+            if not args.analysis_base:
+                print(
+                    "report: note: --analysis given without "
+                    "--analysis-base — debt-growth gate skipped",
+                    file=sys.stderr,
+                )
+            else:
+                try:
+                    base_analysis = load_analysis(args.analysis_base)
+                except (OSError, ValueError, json.JSONDecodeError) as e:
+                    print(f"report: {e}", file=sys.stderr)
+                    return 2
+                extra = compare_analysis(run_analysis, base_analysis)
+                if extra:
+                    cmp_text += "**ANALYSIS REGRESSED:**\n" + "\n".join(
+                        f"- {r}" for r in extra
+                    ) + "\n"
+                    regressions.extend(extra)
         text = text + "\n" + cmp_text
         if regressions:
             rc = 1
