@@ -40,7 +40,7 @@ import time
 
 # telemetry's hang-exit watchdog is importable WITHOUT jax (the package
 # __init__ is lazy for exactly this): armed before the jax import below.
-from fast_tffm_tpu.telemetry import arm_hang_exit
+from fast_tffm_tpu.telemetry import arm_hang_exit, write_json_artifact
 
 # Armed before jax/backend init: backend init inside `import jax`
 # is itself a known hang point behind a dead tunnel.  Budget covers the
@@ -1264,9 +1264,7 @@ def bench_dist(
             result["dist_error"] = failed[0][1][-800:]
             result["value"] = None
             if out_path:
-                with open(out_path, "w") as f:
-                    json.dump(result, f, indent=1, sort_keys=True)
-                    f.write("\n")
+                write_json_artifact(out_path, result)
             print(json.dumps(result))
             return result
         import json as _json
@@ -1313,9 +1311,7 @@ def bench_dist(
             h["steady_recompiles"] for h in per_host.values()
         )
     if out_path:
-        with open(out_path, "w") as f:
-            json.dump(result, f, indent=1, sort_keys=True)
-            f.write("\n")
+        write_json_artifact(out_path, result)
     print(json.dumps(result))
     return result
 

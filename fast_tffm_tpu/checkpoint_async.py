@@ -53,6 +53,7 @@ from fast_tffm_tpu.checkpoint import (
     save_checkpoint,
     save_delta,
 )
+from fast_tffm_tpu.telemetry import log_quietly
 
 __all__ = ["AsyncCheckpointer", "device_snapshot", "make_row_gather", "make_touched_marker"]
 
@@ -278,6 +279,7 @@ class AsyncCheckpointer:
         self.delta_saves = 0
         self.sync_saves = 0
         self.write_failures = 0
+        self.cursor_failures = 0
         self.blocked_boundaries = 0
         self.blocked_ms = 0.0
 
@@ -327,8 +329,15 @@ class AsyncCheckpointer:
             return None
         try:
             return self._cursor_fn()
-        except Exception:
-            return None  # a cursor bug must never cost the checkpoint
+        except Exception as e:
+            # a cursor bug must never cost the checkpoint — but it must
+            # leave a trace: counted into summary(), logged best-effort
+            # (the checkpoint then saves WITHOUT a cursor, which resume
+            # reports as a legacy start-of-data fallback)
+            with self._lock:
+                self.cursor_failures += 1
+            log_quietly(self._log, f"cursor capture failed (saving without one): {e!r}")
+            return None
 
     # -- multi-host protocol ----------------------------------------------
 
@@ -372,10 +381,7 @@ class AsyncCheckpointer:
         except Exception as e:
             # A dead KV store means the pod is coming apart; peers will
             # surface it as PeerLostError — log, never kill the writer.
-            try:
-                self._log(f"checkpoint signature publish failed: {e!r}")
-            except Exception:
-                pass
+            log_quietly(self._log, f"checkpoint signature publish failed: {e!r}")
 
     def _apply_outcome(self, out: dict | None) -> None:
         """Non-lead chain-state mirror: fold one awaited publish outcome
@@ -666,10 +672,7 @@ class AsyncCheckpointer:
             with self._lock:
                 self.write_failures += 1
             self._on_write_failed()
-            try:
-                self._log(f"tiered delta write failed (chain intact): {e!r}")
-            except Exception:
-                pass
+            log_quietly(self._log, f"tiered delta write failed (chain intact): {e!r}")
             return
         with self._lock:
             self._parent_sig = sid
@@ -692,13 +695,11 @@ class AsyncCheckpointer:
         except Exception as e:
             with self._lock:
                 self.write_failures += 1
-            try:
-                self._log(
-                    f"paramstore apply failed after publish (pending rows "
-                    f"retained; chain intact): {e!r}"
-                )
-            except Exception:
-                pass
+            log_quietly(
+                self._log,
+                f"paramstore apply failed after publish (pending rows "
+                f"retained; chain intact): {e!r}",
+            )
 
     # -- writer thread ----------------------------------------------------
 
@@ -769,10 +770,7 @@ class AsyncCheckpointer:
                 self.write_failures += 1
             self._on_write_failed()
             self._publish_outcome(bseq, None, "failed")
-            try:
-                self._log(f"async checkpoint write failed (previous checkpoint intact): {e!r}")
-            except Exception:
-                pass
+            log_quietly(self._log, f"async checkpoint write failed (previous checkpoint intact): {e!r}")
 
     def _write_delta(
         self, seq, parent, idx, n, trows, arows, dense, dacc, step_arr, step,
@@ -824,10 +822,7 @@ class AsyncCheckpointer:
                 self.write_failures += 1
             self._on_write_failed()
             self._publish_outcome(bseq, None, "failed")
-            try:
-                self._log(f"delta checkpoint write failed (chain intact): {e!r}")
-            except Exception:
-                pass
+            log_quietly(self._log, f"delta checkpoint write failed (chain intact): {e!r}")
 
     def _on_full_published(self, sid: str) -> None:
         with self._lock:
@@ -868,7 +863,7 @@ class AsyncCheckpointer:
                 rows_written=int(rows),
                 train_stall_ms=round(float(train_stall_ms), 3),
             )
-        except Exception:
+        except (OSError, ValueError):
             pass  # a full metrics disk must not cost the checkpoint
 
     def summary(self) -> dict:
@@ -879,6 +874,7 @@ class AsyncCheckpointer:
                 "ckpt_delta_saves": self.delta_saves,
                 "ckpt_sync_saves": self.sync_saves,
                 "ckpt_write_failures": self.write_failures,
+                "ckpt_cursor_failures": self.cursor_failures,
                 "ckpt_blocked_boundaries": self.blocked_boundaries,
             }
         if self.blocked_ms:

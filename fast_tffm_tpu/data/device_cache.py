@@ -252,7 +252,9 @@ def make_cached_train_step(model, learning_rate: float, data: DeviceDataset, bod
     # Measured-cost hooks (profiling.CostLedger): the closures stay
     # profileable by delegating .lower to the inner jit with the resident
     # arrays bound — lowering only, never a second backend compile.
+    # analysis: ok recompile-hazard delegated CostLedger .lower hook, not a second compile
     step.lower = lambda st, i: _step.lower(st, arrays, i)
+    # analysis: ok recompile-hazard delegated CostLedger .lower hook, not a second compile
     step_shuffled.lower = lambda st, perm, i: _step_shuffled.lower(
         st, arrays, perm, i
     )
@@ -383,7 +385,9 @@ def make_cached_scan_train_step(model, learning_rate: float, data: DeviceDataset
         return _scan_step_shuffled(state, arrays, perm, idxs)
 
     # Same measured-cost .lower delegation as make_cached_train_step's.
+    # analysis: ok recompile-hazard delegated CostLedger .lower hook, not a second compile
     step.lower = lambda st, idxs: _scan_step.lower(st, arrays, idxs)
+    # analysis: ok recompile-hazard delegated CostLedger .lower hook, not a second compile
     step_shuffled.lower = lambda st, perm, idxs: _scan_step_shuffled.lower(
         st, arrays, perm, idxs
     )

@@ -64,6 +64,8 @@ import sys
 import threading
 import time
 
+from fast_tffm_tpu.telemetry import log_quietly
+
 __all__ = [
     "PEER_LOST_EXIT",
     "PeerLostError",
@@ -122,6 +124,7 @@ def process_identity() -> tuple[int, int]:
 
             if _jax_dist.global_state.client is not None:
                 return jax.process_index(), jax.process_count()
+        # analysis: ok exception-hygiene jax-internal probe: any failure here means "not in a distributed runtime" and the env-var fallback below answers
         except Exception:
             pass
     try:
@@ -345,6 +348,7 @@ class DistributedRuntime:
             from jax._src import distributed as _jax_dist
 
             client = _jax_dist.global_state.client
+        # analysis: ok exception-hygiene jax-internal probe: no coordination client means the FileKV fallback below takes over
         except Exception:
             client = None
         if client is not None:
@@ -621,8 +625,9 @@ class HostMonitor:
         self._fired[key] = True
         try:
             self._on_event(peer, classification, detail)
+        # analysis: ok exception-hygiene owner-injected event callback; the monitor thread must survive any callback bug (the host-stall classification already fired)
         except Exception:
-            pass  # telemetry must never kill the monitor
+            pass
 
     def _run(self) -> None:
         while not self._stop.wait(self._poll):
@@ -643,8 +648,8 @@ class HostMonitor:
                 if self._straggler_steps > 0 and self._my_step is not None and payload:
                     try:
                         behind = int(self._my_step()) - int(payload.get("step", 0))
-                    except Exception:
-                        continue
+                    except (TypeError, ValueError):
+                        continue  # malformed heartbeat payload: no straggler verdict this poll
                     if behind >= self._straggler_steps:
                         self._emit_once(
                             p,
@@ -730,14 +735,12 @@ class GenerationWatcher:
                 continue
             gen = int(info.get("generation", -1))
             if gen > self._generation:
-                try:
-                    self._log(
-                        f"distributed: generation {self._generation} -> {gen} "
-                        f"(cause: {info.get('cause', '?')}) — re-exec'ing into "
-                        "the new pod generation with --resume"
-                    )
-                except Exception:
-                    pass
+                log_quietly(
+                    self._log,
+                    f"distributed: generation {self._generation} -> {gen} "
+                    f"(cause: {info.get('cause', '?')}) — re-exec'ing into "
+                    "the new pod generation with --resume",
+                )
                 self._exec(gen, reexec_argv(self._argv))
                 return  # only reachable with an injected exec_fn (tests)
 
@@ -762,6 +765,7 @@ def enable_cpu_collectives() -> bool:
 
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
         return True
+    # analysis: ok exception-hygiene capability probe: False means "no gloo on this jax", the caller proceeds single-process
     except Exception:
         return False
 

@@ -558,8 +558,9 @@ def repair_delta_chain(path: str, log=print) -> list[str]:
     try:
         with np.load(path, allow_pickle=False) as z:
             expect = _npz_str(z, "save_id")
+    # analysis: ok exception-hygiene by contract ANY unreadable base means "nothing a tail repair can fix" — the strict restore path reports the corruption loudly
     except Exception:
-        return []  # base unreadable: nothing a tail repair can fix
+        return []
     deltas = _delta_files(path)
     bad_from, reason = None, ""
     for i, dp in enumerate(deltas):
@@ -702,6 +703,8 @@ class Supervisor:
         self.last_rc: int | None = None
 
     def _tail(self, proc, first_progress_t, last_step, on_progress=None) -> None:
+        from fast_tffm_tpu.telemetry import log_quietly
+
         try:
             for line in proc.stdout:
                 line = line.rstrip("\n")
@@ -715,14 +718,11 @@ class Supervisor:
                     if on_progress is not None:
                         try:
                             on_progress()
+                        # analysis: ok exception-hygiene owner-injected progress callback; the tail thread must survive any callback bug (MTTR already stamped)
                         except Exception:
-                            pass  # telemetry must never kill the tail
-                if self._child_log is not None:
-                    try:
-                        self._child_log(line)
-                    except Exception:
-                        pass
-        except Exception:
+                            pass
+                log_quietly(self._child_log, line)
+        except (OSError, ValueError):
             pass  # a closed pipe on kill is expected, not an error
 
     def run(self, resume: bool = False) -> int:

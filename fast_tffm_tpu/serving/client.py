@@ -20,7 +20,13 @@ import sys
 import threading
 import time
 
-from fast_tffm_tpu.serving.protocol import SERVE_READY_PREFIX, decode, encode
+from fast_tffm_tpu.telemetry import log_quietly
+from fast_tffm_tpu.serving.protocol import (
+    SERVE_READY_PREFIX,
+    BadRequest,
+    decode,
+    encode,
+)
 
 __all__ = ["ServeConnection", "spawn_serve"]
 
@@ -84,8 +90,8 @@ class ServeConnection:
                     continue
                 try:
                     msg = decode(raw)
-                except Exception:
-                    continue
+                except BadRequest:
+                    continue  # a garbled line never kills the reader
                 with self.lock:
                     meta = self._pending.pop(msg.get("id"), None)
                 if isinstance(meta, _SyncBox):
@@ -174,8 +180,12 @@ def spawn_serve(
             for line in proc.stdout:
                 if line.strip() and log is not None:
                     log(line.strip())
-        except Exception:
-            pass
+        except Exception as e:
+            # ANY failure (torn SERVE_READY line, raising log callback)
+            # must still reach ready.set() — a dead waiter would turn a
+            # fast loud failure into a full spawn-timeout hang, and a
+            # dead drain would let the child block on a full pipe.
+            log_quietly(log, f"serve ready-waiter error: {e!r}")
         ready.set()
 
     threading.Thread(target=wait_ready, name="serve-ready", daemon=True).start()

@@ -51,6 +51,7 @@ from fast_tffm_tpu.serving.admission import AdmissionQueue
 from fast_tffm_tpu.serving.buckets import BucketLadder
 from fast_tffm_tpu.serving.metrics import ServingMetrics
 from fast_tffm_tpu.serving.protocol import DeadlineExceeded
+from fast_tffm_tpu.telemetry import log_quietly
 from fast_tffm_tpu.telemetry import RunMonitor
 
 __all__ = [
@@ -554,10 +555,7 @@ class ServingEngine:
                 # on_delta_reload — keeping them out of `reloads` keeps
                 # the two counters independent: reloads = full re-reads.
                 self.metrics.on_reload(ok=True)
-            try:
-                self._log(f"serving: swapped in checkpoint step {staged_step}")
-            except Exception:
-                pass  # a raising log callback must not kill the collector
+            log_quietly(self._log, f"serving: swapped in checkpoint step {staged_step}")
         # Claim the futures: a pending Future is always cancellable, and
         # resolving a cancelled one raises InvalidStateError — which,
         # unguarded, would kill the collector over ONE impatient caller.
@@ -603,10 +601,7 @@ class ServingEngine:
             for r in pending:
                 if not r.future.done():
                     r.future.set_exception(e)
-            try:
-                self._log(f"serving: flush failed: {e!r}")
-            except Exception:
-                pass
+            log_quietly(self._log, f"serving: flush failed: {e!r}")
             self._last_flush_t = time.perf_counter()  # answered = progress
             return
         for i, r in enumerate(pending):
@@ -617,7 +612,7 @@ class ServingEngine:
             self._emit_freshness()
         try:
             self._monitor.on_dispatch(self._flush_seq)
-        except Exception:
+        except (OSError, ValueError):
             # Same stance as the metrics writes below: a telemetry I/O
             # failure (ENOSPC mem record) degrades to a lost record —
             # it must NEVER kill the collector.
@@ -639,7 +634,7 @@ class ServingEngine:
             self._last_metrics_log = t_resolved
             try:
                 self.metrics.log_to(self._monitor)
-            except Exception:
+            except (OSError, ValueError):
                 # A full metrics disk (ENOSPC) must degrade to lost
                 # metrics records, never to a dead collector: every
                 # request behind a dead collector hangs or blocks.
@@ -662,7 +657,7 @@ class ServingEngine:
                 publish_to_first_scored_ms=round(scored_ms, 3),
                 mode=f["mode"],
             )
-        except Exception:
+        except (OSError, ValueError):
             pass  # a full metrics disk must not kill the collector
 
     # -- hot reload ------------------------------------------------------
@@ -772,7 +767,7 @@ class ServingEngine:
                     path=self._cfg.model_file, error=repr(exc),
                     attempts=self._fail_count,
                 )
-            except Exception:
+            except (OSError, ValueError):
                 pass  # a full metrics disk must not kill the watcher
             self._log(
                 f"serving: giving up on this checkpoint write after "
@@ -940,12 +935,12 @@ class ServingEngine:
             # (ENOSPC) degrades to a lost record, it must not turn an
             # otherwise-successful serve run into a nonzero exit.
             self.metrics.log_to(self._monitor)
-        except Exception:
+        except (OSError, ValueError):
             pass
         finally:
             try:
                 self._monitor.close()
-            except Exception:
+            except (OSError, ValueError):
                 pass
 
     def __enter__(self):

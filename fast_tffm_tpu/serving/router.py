@@ -49,8 +49,10 @@ import threading
 import time
 
 from fast_tffm_tpu.resilience import RestartPolicy
+from fast_tffm_tpu.telemetry import log_quietly
 from fast_tffm_tpu.serving.protocol import (
     REPLICA_READY_PREFIX as _READY_PREFIX,
+    BadRequest,
     Unavailable,
     WireError,
     decode,
@@ -142,8 +144,11 @@ def spawn_replica(
                     return
                 if line:
                     log(f"replica {index}: {line}")
-        except Exception:
-            pass
+        except Exception as e:
+            # ANY failure (torn READY line, raising log callback) must
+            # still reach ready.set() below — a dead waiter otherwise
+            # turns a fast loud failure into a full ready-timeout hang.
+            log_quietly(log, f"replica {index}: ready-waiter error: {e!r}")
         ready.set()  # EOF / error: unblock the waiter to fail loudly
 
     waiter = threading.Thread(
@@ -165,8 +170,10 @@ def spawn_replica(
                 line = line.rstrip()
                 if line:
                     log(f"replica {index}: {line}")
-        except Exception:
-            pass
+        except Exception as e:
+            # the drain exists so the child's stdout pipe can never fill
+            # and block it — it must survive even a raising log callback
+            log_quietly(log, f"replica {index}: drain error: {e!r}")
 
     threading.Thread(target=drain, name=f"replica-{index}-drain", daemon=True).start()
     return ReplicaProcess(proc, port, proc.pid)
@@ -501,7 +508,7 @@ class Router:
                     continue
                 try:
                     msg = decode(line)
-                except Exception:
+                except BadRequest:
                     continue  # a garbled line never kills the link
                 self._on_response(slot, msg)
         except (OSError, ValueError):
@@ -621,8 +628,8 @@ class Router:
                     replicas=n,
                     scope="fleet_staged",
                 )
-            except Exception:
-                pass
+            except (OSError, ValueError):
+                pass  # lost freshness record, never a dead watcher
 
     def freshness_percentiles(self) -> dict:
         """Running publish→staged percentiles across every ack observed —
@@ -646,8 +653,8 @@ class Router:
                 "fault", event="replica_wedged", replica=slot.index,
                 age_s=round(float(age), 3), wedge_signal=what,
             )
-        except Exception:
-            pass
+        except (OSError, ValueError):
+            pass  # lost fault record, never a skipped kill
         # SIGKILL, then the down path (triggered by the socket dropping
         # or directly here) drains and restarts.
         if slot.handle is not None:
@@ -679,8 +686,8 @@ class Router:
                 "fault", event="replica_crash", replica=slot.index,
                 exit_code=rc, detail=why,
             )
-        except Exception:
-            pass
+        except (OSError, ValueError):
+            pass  # lost fault record, never a skipped drain
         # Drain around the corpse: one retry on a healthy peer, else a
         # typed failure — nothing hangs, nothing silently drops.
         for pending in orphans:
@@ -732,8 +739,8 @@ class Router:
                         "fault", event="replica_giveup", replica=slot.index,
                         attempts=attempt - 1,
                     )
-                except Exception:
-                    pass
+                except (OSError, ValueError):
+                    pass  # lost fault record; the giveup state is already set
                 return
             if backoff > 0:
                 self._log(
@@ -760,8 +767,8 @@ class Router:
                     "restart", attempt=attempt, exit_code=rc,
                     backoff_s=round(backoff, 3), mttr_s=mttr, replica=slot.index,
                 )
-            except Exception:
-                pass
+            except (OSError, ValueError):
+                pass  # lost restart record; the replica is back either way
             return
 
     # -- health ------------------------------------------------------------
@@ -952,5 +959,5 @@ class Router:
                     else {}
                 ),
             )
-        except Exception:
-            pass
+        except (OSError, ValueError):
+            pass  # lost summary record on close

@@ -47,6 +47,7 @@ no-op when jax is absent.
 from __future__ import annotations
 
 import contextlib
+import json
 import os
 import sys
 import threading
@@ -63,6 +64,7 @@ __all__ = [
     "CompileSentinel",
     "new_run_id",
     "artifact_stamp",
+    "write_json_artifact",
     "thread_stacks",
     "classify_stall",
     "first_nonfinite_leaf",
@@ -195,6 +197,37 @@ def artifact_stamp(run_id: str = "") -> dict:
     return {"run_id": run_id or new_run_id(), "schema_version": SCHEMA_VERSION}
 
 
+def write_json_artifact(path, obj, *, indent: int = 1, sort_keys: bool = True) -> None:
+    """Atomically publish a committed BENCH_*/PROBE_*-style JSON artifact:
+    full payload to a sibling tmp, then ``os.replace`` onto ``path`` — the
+    same complete-or-previous contract every checkpoint publish honors
+    (DESIGN crash-consistency invariant 1; gated by the atomic-publish
+    checker).  A reader — a compare gate, a dashboard poller, a human
+    mid-run — never sees a torn verdict."""
+    tmp = f"{path}.{os.getpid():x}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=indent, sort_keys=sort_keys)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def log_quietly(log, msg: str) -> None:
+    """Deliver ``msg`` to a caller-provided log callback, absorbing ANY
+    failure the callback raises.  The one sanctioned sink for the
+    "logging must never kill the worker" contract (collector threads,
+    checkpoint writers, watchdogs): callbacks are injected by drivers and
+    tests, so their failure surface is unknowable — and the message is
+    always best-effort context for a diagnosis already recorded through
+    a typed path (counter, typed error, telemetry record)."""
+    if log is None:
+        return
+    try:
+        log(msg)
+    # analysis: ok exception-hygiene the sanctioned raising-log-callback sink — the diagnosis already traveled a typed path; see docstring
+    except Exception:
+        pass
+
+
 # -- compile sentinel -----------------------------------------------------
 
 # One process-wide counter fed by one jax.monitoring listener: jax has no
@@ -235,14 +268,16 @@ def _ensure_compile_listener() -> bool:
 
             jax.monitoring.register_event_duration_secs_listener(_on_duration_event)
             _listener_state[0] = True
+        # analysis: ok exception-hygiene jax-version probe: no listener API on this jax means the sentinel degrades to disabled (recorded in _listener_state)
         except Exception:
             _listener_state[0] = False
         try:
             import jax.monitoring
 
             jax.monitoring.register_event_listener(_on_event)
+        # analysis: ok exception-hygiene jax-version probe: hit counting is additive — the compile count stands alone without it
         except Exception:
-            pass  # hit counting is additive; the compile count stands alone
+            pass
     return _listener_state[0]
 
 
@@ -268,9 +303,11 @@ def enable_compilation_cache(path: str) -> bool:
         try:
             jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
             jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        # analysis: ok exception-hygiene older-jax compat probe: dir alone still caches the big programs
         except Exception:
-            pass  # older jax: dir alone still caches the big programs
+            pass
         return True
+    # analysis: ok exception-hygiene capability probe: False (no side effects) IS the documented no-cache outcome
     except Exception:
         return False
 
@@ -340,6 +377,7 @@ def _ru_maxrss_bytes() -> int | None:
         v = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
         # Linux reports KiB; macOS reports bytes.
         return int(v) if sys.platform == "darwin" else int(v) * 1024
+    # analysis: ok exception-hygiene resource probe degrades to None by documented contract ("None where unreadable")
     except Exception:
         return None
 
@@ -364,6 +402,7 @@ def device_live_bytes() -> int | None:
         if had_stats:
             return total
         return int(sum(int(x.nbytes) for x in jax.live_arrays()))
+    # analysis: ok exception-hygiene resource probe degrades to None by documented contract ("None where unreadable")
     except Exception:
         return None
 
@@ -476,6 +515,7 @@ def first_nonfinite_leaf(tree) -> str | None:
             arr = np.asarray(leaf)
             if arr.dtype.kind == "f" and arr.size and not np.isfinite(arr).all():
                 return jax.tree_util.keystr(path)
+    # analysis: ok exception-hygiene forensic probe on the way down to an abort — None just means "leaf unnamed", the anomaly record still lands
     except Exception:
         return None
     return None
@@ -652,8 +692,8 @@ class RunMonitor:
         finally:
             try:
                 self.on_dispatch(self._step, warmup=True)
-            except Exception:
-                pass
+            except (OSError, ValueError):
+                pass  # a failed drain is a lost record, not a broken window
             with self._lock:
                 self._warmup_depth -= 1
 
@@ -741,18 +781,21 @@ class RunMonitor:
             if self._queue_depth_fn is not None:
                 try:
                     depth = self._queue_depth_fn()
+                # analysis: ok exception-hygiene driver-injected probe; the watchdog must survive any probe bug — depth=None still classifies
                 except Exception:
                     depth = None
             alive = None
             if self._producer_alive_fn is not None:
                 try:
                     alive = self._producer_alive_fn()
+                # analysis: ok exception-hygiene driver-injected probe; the watchdog must survive any probe bug — alive=None still classifies
                 except Exception:
                     alive = None
             s_idle = None
             if self._stream_idle_fn is not None:
                 try:
                     s_idle = self._stream_idle_fn()
+                # analysis: ok exception-hygiene driver-injected probe; the watchdog must survive any probe bug — s_idle=None still classifies
                 except Exception:
                     s_idle = None
             cls = (
@@ -771,17 +814,14 @@ class RunMonitor:
                     producer_alive=alive,
                     stacks=stacks,
                 )
-            except Exception:
+            except (OSError, ValueError):
                 pass  # a full metrics disk must not kill stall detection
-            if self._log is not None:
-                try:
-                    self._log(
-                        f"telemetry watchdog: no step for {since:.1f}s "
-                        f"(deadline {self._stall_timeout:.1f}s) at step {step} — "
-                        f"{cls}; thread stacks -> kind=stall record"
-                    )
-                except Exception:
-                    pass  # a raising log callback must not kill the watchdog
+            log_quietly(
+                self._log,
+                f"telemetry watchdog: no step for {since:.1f}s "
+                f"(deadline {self._stall_timeout:.1f}s) at step {step} — "
+                f"{cls}; thread stacks -> kind=stall record",
+            )
 
     # -- shutdown ---------------------------------------------------------
 

@@ -1,13 +1,16 @@
-"""Invariant checker suite (tools/analysis/, ISSUE 13).
+"""Invariant checker suite (tools/analysis/, ISSUEs 13 + 14).
 
 Table-driven positive/negative fixtures per rule — each checker must
 catch a DISTILLED version of the historical bug it targets (the PR-7
 fresh-jit-per-save recompile, the PR-8 unlocked reload-retry flag, a
-donated-then-read array, a dead config key, an unregistered telemetry
-kind) and stay quiet on the idiomatic fix — plus baseline round-trip,
-suppression-comment parsing, the end-to-end exit-code contract on an
-injected mini repo, and the whole-repo --strict smoke run that IS the
-tier-1 gate.
+donated-then-read array — now also through a wrapper call, a dead
+config key, an unregistered telemetry kind, a torn publish, a bare
+except, the PR-8 diagnosis-swallowing re-raise) and stay quiet on the
+idiomatic fix — plus baseline round-trip, lockfile round-trip +
+drift-detection pins (delete a registry entry -> exit 1, append ->
+--write-lock flow), suppression-comment parsing, the end-to-end
+exit-code contract on an injected mini repo across all 8 rules, and
+the whole-repo --strict smoke run that IS the tier-1 gate.
 """
 
 from __future__ import annotations
@@ -507,6 +510,417 @@ def test_telemetry_fixtures(tmp_path, src, rel, expect):
     assert bool(findings) == expect, [f.render() for f in findings]
 
 
+# -- atomic-publish --------------------------------------------------------
+
+from analysis.check_exceptions import ExceptionChecker  # noqa: E402
+from analysis.check_publish import PublishChecker  # noqa: E402
+from analysis import check_formats  # noqa: E402
+from analysis.check_formats import FormatsChecker  # noqa: E402
+
+PUBLISH_DIRECT = '''
+import json
+
+def write_verdict(result, out):
+    with open(out + ".json", "w") as f:     # torn-verdict window
+        json.dump(result, f)
+'''
+
+PUBLISH_OK = '''
+import json
+import os
+
+def write_verdict(result, out):
+    tmp = out + ".json.tmp"
+    with open(tmp, "w") as f:
+        json.dump(result, f)
+    os.replace(tmp, out + ".json")
+'''
+
+PUBLISH_NO_TMP_WRITE = '''
+import os
+
+def publish(path):
+    stage = path + ".partial"
+    os.replace(stage, path)                 # nobody wrote stage here
+'''
+
+PUBLISH_WRITE_AFTER_RENAME = '''
+import os
+
+def publish(path, payload, extra):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+    os.replace(tmp, path)
+    with open(path, "wb") as f:             # tears the published file
+        f.write(extra)
+'''
+
+PUBLISH_UNLINK_AFTER = '''
+import os
+
+def full_save(path, payload):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+    os.replace(tmp, path)
+    for dp in delta_paths(path):            # new base + old chain window
+        os.remove(dp)
+'''
+
+PUBLISH_UNLINK_BEFORE_OK = '''
+import os
+
+def full_save(path, payload):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+    for dp in delta_paths(path):
+        os.remove(dp)
+    os.replace(tmp, path)
+'''
+
+PUBLISH_QUARANTINE_OK = '''
+import os
+
+def quarantine(dp):
+    os.replace(dp, dp + ".corrupt")         # move-aside, not a publish
+'''
+
+PUBLISH_APPEND_OK = '''
+def log_line(rec):
+    with open("metrics.json", "a") as f:    # append-only JSONL, not a snapshot
+        f.write(rec)
+'''
+
+PUBLISH_HANDOFF_OK = '''
+import os
+import subprocess
+
+def build(target):
+    tmp = f"{target}.{os.getpid()}.tmp"
+    subprocess.run(["make", f"OUT={tmp}"], check=True)   # producer handed tmp
+    os.replace(tmp, target)
+'''
+
+
+@pytest.mark.parametrize(
+    "src,expect",
+    [
+        (PUBLISH_DIRECT, True),
+        (PUBLISH_OK, False),
+        (PUBLISH_NO_TMP_WRITE, True),
+        (PUBLISH_WRITE_AFTER_RENAME, True),
+        (PUBLISH_UNLINK_AFTER, True),
+        (PUBLISH_UNLINK_BEFORE_OK, False),
+        (PUBLISH_QUARANTINE_OK, False),
+        (PUBLISH_APPEND_OK, False),
+        (PUBLISH_HANDOFF_OK, False),
+    ],
+    ids=[
+        "direct-write", "tmp-rename-ok", "rename-no-tmp",
+        "write-after-rename", "unlink-after-publish", "unlink-before-ok",
+        "quarantine-ok", "append-ok", "subprocess-handoff-ok",
+    ],
+)
+def test_publish_fixtures(tmp_path, src, expect):
+    ctx = ctx_of(tmp_path, {"mod.py": src})
+    findings = PublishChecker().run(ctx)
+    assert bool(findings) == expect, [f.render() for f in findings]
+    if expect:
+        assert all(f.rule == "atomic-publish" for f in findings)
+
+
+# -- exception-hygiene -----------------------------------------------------
+
+EXC_BARE = '''
+def load(path):
+    try:
+        return open(path).read()
+    except:
+        return None
+'''
+
+# The threaded broad-swallow: a module that spawns threads and a handler
+# that eats the failure without raise/log/counter.
+EXC_SWALLOW_THREADED = '''
+import threading
+
+def start(work):
+    threading.Thread(target=work).start()
+
+def work():
+    try:
+        step()
+    except Exception:
+        pass
+'''
+
+EXC_SWALLOW_UNTHREADED = '''
+def work():
+    try:
+        step()
+    except Exception:
+        pass
+'''
+
+EXC_LOGGED_OK = EXC_SWALLOW_THREADED.replace(
+    "    except Exception:\n        pass",
+    "    except Exception as e:\n        log(f'step failed: {e!r}')",
+)
+
+EXC_COUNTED_OK = EXC_SWALLOW_THREADED.replace(
+    "    except Exception:\n        pass",
+    "    except Exception:\n        FAILURES[0] += 1",
+)
+
+# The PR-8 bug, distilled: validate_classes's actionable duplicate-name
+# ValueError swallowed by a generic format message.
+EXC_DROPPED = '''
+def validate(classes):
+    try:
+        return parse(classes)
+    except ValueError as e:
+        raise ValueError("serve_classes must be name:tier pairs")
+'''
+
+EXC_PRESERVED_MSG = '''
+def validate(classes):
+    try:
+        return parse(classes)
+    except ValueError as e:
+        raise ValueError(f"bad serve_classes: {e}") from None
+'''
+
+EXC_PRESERVED_CHAIN = '''
+def validate(classes):
+    try:
+        return parse(classes)
+    except ValueError as e:
+        raise ValueError("bad serve_classes") from e
+'''
+
+# PEP-562 idiom: the handler INSPECTS e.name before converting — the
+# diagnosis was consulted, not dropped.
+EXC_INSPECTED_OK = '''
+def getattr_hook(name):
+    try:
+        return load(name)
+    except ModuleNotFoundError as e:
+        if e.name != name:
+            raise
+        raise AttributeError(f"no attribute {name!r}") from None
+'''
+
+
+@pytest.mark.parametrize(
+    "src,expect,ctx_key",
+    [
+        (EXC_BARE, True, "bare"),
+        (EXC_SWALLOW_THREADED, True, "swallow"),
+        (EXC_SWALLOW_UNTHREADED, False, None),
+        (EXC_LOGGED_OK, False, None),
+        (EXC_COUNTED_OK, False, None),
+        (EXC_DROPPED, True, "dropped"),
+        (EXC_PRESERVED_MSG, False, None),
+        (EXC_PRESERVED_CHAIN, False, None),
+        (EXC_INSPECTED_OK, False, None),
+    ],
+    ids=[
+        "bare", "threaded-swallow", "unthreaded-exempt", "logged-ok",
+        "counted-ok", "pr8-diagnosis-dropped", "embedded-msg-ok",
+        "chained-ok", "pep562-inspected-ok",
+    ],
+)
+def test_exception_fixtures(tmp_path, src, expect, ctx_key):
+    ctx = ctx_of(tmp_path, {"mod.py": src})
+    findings = ExceptionChecker().run(ctx)
+    assert bool(findings) == expect, [f.render() for f in findings]
+    if expect:
+        assert all(f.rule == "exception-hygiene" for f in findings)
+        assert any(ctx_key in f.context for f in findings)
+
+
+def test_exception_bare_is_error_severity(tmp_path):
+    ctx = ctx_of(tmp_path, {"mod.py": EXC_BARE})
+    (f,) = ExceptionChecker().run(ctx)
+    assert f.severity == "error"
+
+
+# -- interprocedural core (PR 14) ------------------------------------------
+
+DONATION_WRAPPER_BUG = '''
+import jax
+
+_step = jax.jit(lambda s, b: s + b, donate_argnums=(0,))
+
+def save(state, batch):
+    return _step(state, batch)
+
+def train(state, batches):
+    for b in batches:
+        save(state, b)
+        total = state.sum()      # read after the WRAPPED donation
+    return total
+'''
+
+DONATION_WRAPPER_REBIND_OK = '''
+import jax
+
+_step = jax.jit(lambda s, b: s + b, donate_argnums=(0,))
+
+def save(state, batch):
+    return _step(state, batch)
+
+def train(state, batches):
+    for b in batches:
+        state = save(state, b)   # rebind idiom holds through the wrapper
+    return state
+'''
+
+RECOMPILE_FACTORY_SCALAR = '''
+import jax
+
+def make_step():
+    return jax.jit(lambda x: x * 2)
+
+step = make_step()
+
+def drive(n):
+    for k in range(n):
+        step(k)                  # raw loop scalar into a factory-built jit
+'''
+
+RECOMPILE_FACTORY_WRAPPED_OK = RECOMPILE_FACTORY_SCALAR.replace(
+    "step(k)", "step(jnp.asarray(k))"
+)
+
+
+def test_donation_follows_one_call_hop(tmp_path):
+    ctx = ctx_of(tmp_path, {"mod.py": DONATION_WRAPPER_BUG})
+    findings = DonationChecker().run(ctx)
+    assert findings and all(f.rule == "donation-after-use" for f in findings)
+    assert any("train:state" in f.context for f in findings)
+
+
+def test_donation_wrapper_rebind_is_quiet(tmp_path):
+    ctx = ctx_of(tmp_path, {"mod.py": DONATION_WRAPPER_REBIND_OK})
+    assert DonationChecker().run(ctx) == []
+
+
+def test_recompile_sees_factory_returned_jit(tmp_path):
+    ctx = ctx_of(tmp_path, {"mod.py": RECOMPILE_FACTORY_SCALAR})
+    findings = RecompileChecker().run(ctx)
+    assert any("scalar:k" in f.context for f in findings), [
+        f.render() for f in findings
+    ]
+
+
+def test_recompile_factory_wrapped_scalar_quiet(tmp_path):
+    ctx = ctx_of(tmp_path, {"mod.py": RECOMPILE_FACTORY_WRAPPED_OK})
+    findings = RecompileChecker().run(ctx)
+    assert not any("scalar" in f.context for f in findings), [
+        f.render() for f in findings
+    ]
+
+
+def test_module_call_graph_resolution(tmp_path):
+    import ast as _ast
+
+    src = (
+        "def helper(x):\n    return x\n\n"
+        "class C:\n"
+        "    def m(self):\n        return helper(self.n())\n"
+        "    def n(self):\n        return 1\n"
+    )
+    graph = core.module_call_graph(_ast.parse(src))
+    assert set(graph.defs) == {"helper", "C.m", "C.n"}
+    resolved = dict(graph.callees("C.m"))
+    assert "helper" in resolved and "C.n" in resolved
+
+
+# -- format-drift (persisted-format lockfile) ------------------------------
+
+def _formats_ctx_and_lock(tmp_path, telemetry_src):
+    root = tmp_path / "fr"
+    pkg = root / "fast_tffm_tpu"
+    pkg.mkdir(parents=True)
+    (pkg / "telemetry.py").write_text(telemetry_src)
+    ctx = core.RepoContext(str(root), ["fast_tffm_tpu/telemetry.py"])
+    lock = str(root / "formats.lock.json")
+    check_formats.write_lock(lock, check_formats.extract_registries(ctx))
+    return root, lock
+
+
+def test_formats_round_trip_green(tmp_path):
+    root, lock = _formats_ctx_and_lock(tmp_path, MINI_TELEMETRY)
+    ctx = core.RepoContext(str(root), ["fast_tffm_tpu/telemetry.py"])
+    assert FormatsChecker(lock).run(ctx) == []
+
+
+def test_formats_missing_lock_is_a_finding(tmp_path):
+    root, lock = _formats_ctx_and_lock(tmp_path, MINI_TELEMETRY)
+    os.remove(lock)
+    ctx = core.RepoContext(str(root), ["fast_tffm_tpu/telemetry.py"])
+    (f,) = FormatsChecker(lock).run(ctx)
+    assert f.context == "lock:missing"
+
+
+def test_formats_corrupt_lock_is_a_finding(tmp_path):
+    root, lock = _formats_ctx_and_lock(tmp_path, MINI_TELEMETRY)
+    with open(lock, "w") as fh:
+        fh.write("{not json")
+    ctx = core.RepoContext(str(root), ["fast_tffm_tpu/telemetry.py"])
+    (f,) = FormatsChecker(lock).run(ctx)
+    assert f.context == "lock:corrupt"
+
+
+@pytest.mark.parametrize(
+    "mutated,needle",
+    [
+        # drop a kind entirely
+        ("SCHEMAS = {'train': ('loss',)}\n", "removed"),
+        # drop a required key from a kind
+        ("SCHEMAS = {'train': ('loss',), 'ckpt': ()}\n", "lost required key"),
+    ],
+    ids=["kind-removed", "key-removed"],
+)
+def test_formats_drift_detected(tmp_path, mutated, needle):
+    root, lock = _formats_ctx_and_lock(tmp_path, MINI_TELEMETRY)
+    (root / "fast_tffm_tpu" / "telemetry.py").write_text(mutated)
+    ctx = core.RepoContext(str(root), ["fast_tffm_tpu/telemetry.py"])
+    findings = FormatsChecker(lock).run(ctx)
+    assert findings and all(f.rule == "format-drift" for f in findings)
+    assert any(needle in f.message for f in findings)
+    assert all(f.context.endswith(":drift") for f in findings)
+
+
+def test_formats_addition_requires_write_lock(tmp_path):
+    root, lock = _formats_ctx_and_lock(tmp_path, MINI_TELEMETRY)
+    grown = MINI_TELEMETRY.replace("}", ", 'fresh': ('a', 'b')}")
+    (root / "fast_tffm_tpu" / "telemetry.py").write_text(grown)
+    ctx = core.RepoContext(str(root), ["fast_tffm_tpu/telemetry.py"])
+    findings = FormatsChecker(lock).run(ctx)
+    assert findings and all(":addition" in f.context for f in findings)
+    # regeneration legalizes the addition
+    check_formats.write_lock(lock, check_formats.extract_registries(ctx))
+    assert FormatsChecker(lock).run(ctx) == []
+
+
+def test_diff_lock_ordered_semantics():
+    locked = {"s": {"SEQ": ["a", "b"]}}
+    check_formats._ORDERED.add(("s", "SEQ"))
+    try:
+        drift, adds = check_formats.diff_lock(locked, {"s": {"SEQ": ["a", "b", "c"]}})
+        assert not drift and adds  # append = addition
+        drift, adds = check_formats.diff_lock(locked, {"s": {"SEQ": ["b", "a"]}})
+        assert drift and not adds  # reorder = drift
+        drift, adds = check_formats.diff_lock(locked, {"s": {"SEQ": ["a"]}})
+        assert drift  # removal = drift
+    finally:
+        check_formats._ORDERED.discard(("s", "SEQ"))
+
+
 # -- suppressions ----------------------------------------------------------
 
 def test_reasoned_suppression_silences_finding(tmp_path):
@@ -637,7 +1051,7 @@ MINI_CONFIG = CONFIG_PY
 
 
 def _mini_repo(tmp_path, bad_module: str | None = None, sample=SAMPLE_OK,
-               design=DESIGN_OK):
+               design=DESIGN_OK, lock: bool = True):
     root = tmp_path / "mini"
     pkg = root / "fast_tffm_tpu"
     pkg.mkdir(parents=True, exist_ok=True)
@@ -649,6 +1063,12 @@ def _mini_repo(tmp_path, bad_module: str | None = None, sample=SAMPLE_OK,
     (root / "tools").mkdir(exist_ok=True)
     if bad_module is not None:
         (pkg / "injected.py").write_text(bad_module)
+    if lock:
+        # the formats checker requires a committed lockfile wherever
+        # lockable registries exist — generate it the way a real repo
+        # does, through the CLI
+        r = _run_cli(root, "--write-lock")
+        assert r.returncode == 0, r.stdout + r.stderr
     return root
 
 
@@ -672,8 +1092,14 @@ def test_cli_green_mini_repo_exits_0(tmp_path):
         (LOCKS_PR8, "lock-discipline"),
         (DONATION_BUG, "donation-after-use"),
         (TELEMETRY_BAD_KIND, "telemetry"),
+        (PUBLISH_DIRECT, "atomic-publish"),
+        (EXC_BARE, "exception-hygiene"),
+        (DONATION_WRAPPER_BUG, "donation-after-use"),
     ],
-    ids=["fresh-jit-per-save", "unlocked-flag", "donated-then-read", "bad-kind"],
+    ids=[
+        "fresh-jit-per-save", "unlocked-flag", "donated-then-read",
+        "bad-kind", "torn-publish", "bare-except", "wrapped-donation",
+    ],
 )
 def test_cli_injected_historical_bug_exits_1(tmp_path, bad, needle):
     """The acceptance contract: --strict demonstrably exits 1 when a
@@ -681,6 +1107,79 @@ def test_cli_injected_historical_bug_exits_1(tmp_path, bad, needle):
     r = _run_cli(_mini_repo(tmp_path, bad_module=bad), "--strict")
     assert r.returncode == 1, r.stdout + r.stderr
     assert needle in r.stdout
+
+
+def test_cli_registry_drift_exits_1(tmp_path):
+    """The lockfile gate end to end: locking, then mutating a pinned
+    registry (dropping a SCHEMAS kind = deleting a FAULT_KIND's moral
+    twin in this mini tree) exits 1 naming format-drift."""
+    root = _mini_repo(tmp_path)
+    (root / "fast_tffm_tpu" / "telemetry.py").write_text(
+        "SCHEMAS = {'train': ('loss',)}\n"  # 'ckpt' kind deleted
+    )
+    r = _run_cli(root, "--strict")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "format-drift" in r.stdout and "removed" in r.stdout
+
+
+def test_cli_registry_addition_write_lock_flow(tmp_path):
+    """An APPENDED registry entry fails strict until --write-lock
+    regenerates the lockfile in the same diff — then goes green."""
+    root = _mini_repo(tmp_path)
+    (root / "fast_tffm_tpu" / "telemetry.py").write_text(
+        MINI_TELEMETRY.replace("}", ", 'fresh': ('a',)}")
+    )
+    r = _run_cli(root, "--strict")
+    assert r.returncode == 1 and "regenerate the lockfile" in r.stdout
+    assert _run_cli(root, "--write-lock").returncode == 0
+    r = _run_cli(root, "--strict")
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_write_lock_refuses_removal(tmp_path):
+    """--write-lock must never bake in a removal: a persisted format is
+    append-only, so regeneration over a removal exits 2 naming it."""
+    root = _mini_repo(tmp_path)
+    (root / "fast_tffm_tpu" / "telemetry.py").write_text(
+        "SCHEMAS = {'train': ('loss',)}\n"
+    )
+    r = _run_cli(root, "--write-lock")
+    assert r.returncode == 2, r.stdout + r.stderr
+    assert "never legal" in r.stderr
+
+
+def test_cli_write_lock_refuses_corrupt_lockfile(tmp_path):
+    root = _mini_repo(tmp_path)
+    (root / "tools" / "analysis" / "formats.lock.json").write_text("<<<<")
+    r = _run_cli(root, "--write-lock")
+    assert r.returncode == 2, r.stdout + r.stderr
+    assert "unreadable" in r.stderr
+    # and the checker itself reports the corruption as a finding
+    r = _run_cli(root, "--strict")
+    assert r.returncode == 1 and "lockfile unreadable" in r.stdout
+
+
+def test_cli_lock_sections_subset_preserves_others(tmp_path):
+    """--write-lock --lock-sections S rewrites only S; other sections
+    survive verbatim (the --rules-subset analogue for the lockfile)."""
+    root = _mini_repo(tmp_path)
+    lock_path = root / "tools" / "analysis" / "formats.lock.json"
+    data = json.loads(lock_path.read_text())
+    # plant a foreign section the mini tree cannot regenerate
+    data["sections"]["fault_kinds"] = {"FAULT_KINDS": ["kill"]}
+    lock_path.write_text(json.dumps(data))
+    # grow the telemetry registry and rewrite ONLY its section
+    (root / "fast_tffm_tpu" / "telemetry.py").write_text(
+        MINI_TELEMETRY.replace("}", ", 'fresh': ('a',)}")
+    )
+    r = _run_cli(root, "--write-lock", "--lock-sections", "telemetry_schemas")
+    assert r.returncode == 0, r.stdout + r.stderr
+    data2 = json.loads(lock_path.read_text())
+    assert data2["sections"]["fault_kinds"] == {"FAULT_KINDS": ["kill"]}
+    assert "fresh" in data2["sections"]["telemetry_schemas"]["SCHEMAS"]
+    # usage errors: unknown section / --lock-sections without --write-lock
+    assert _run_cli(root, "--write-lock", "--lock-sections", "nope").returncode == 2
+    assert _run_cli(root, "--lock-sections", "telemetry_schemas").returncode == 2
 
 
 def test_cli_injected_dead_config_key_exits_1(tmp_path):
@@ -782,7 +1281,7 @@ def _load_report_tool():
     return mod
 
 
-def _analysis_payload(debt=2, new=0, stale=0, unjustified=0):
+def _analysis_payload(debt=2, new=0, stale=0, unjustified=0, lock_drift=0):
     return {
         "version": 1,
         "root": "/x",
@@ -793,7 +1292,9 @@ def _analysis_payload(debt=2, new=0, stale=0, unjustified=0):
         "baseline": {
             "pinned": debt, "stale": stale, "unjustified": unjustified,
             "debt": debt,
+            "debt_by_rule": {"lock-discipline": debt} if debt else {},
         },
+        "lock_drift": lock_drift,
         "new": [
             {"rule": "lock-discipline", "path": "x.py", "line": 1,
              "message": "m", "severity": "warning", "context": "C.x",
@@ -821,6 +1322,30 @@ def test_report_gates_on_debt_growth(tmp_path):
     assert rpt.compare_analysis(base, base) == []
     # new findings also regress
     assert rpt.compare_analysis(_analysis_payload(debt=2, new=2), base)
+    # the per-rule attribution rides the message
+    (msg,) = rpt.compare_analysis(worse, base)
+    assert "lock-discipline +2" in msg
+
+
+def test_report_gates_on_lockfile_drift(tmp_path):
+    """Lockfile drift gates even when debt is flat — drift pinned into
+    the baseline must not sneak past the report gate."""
+    rpt = _load_report_tool()
+    base = _analysis_payload(debt=2)
+    drifted = _analysis_payload(debt=2, lock_drift=3)
+    regs = rpt.compare_analysis(drifted, base)
+    assert regs and any("lockfile drift" in r for r in regs)
+    assert rpt.compare_analysis(base, base) == []
+
+
+def test_report_renders_per_rule_debt_delta(tmp_path):
+    rpt = _load_report_tool()
+    base = _analysis_payload(debt=1)
+    run = _analysis_payload(debt=3, lock_drift=1)
+    text = rpt.render_analysis(run, base)
+    assert "Δ debt vs base" in text
+    assert "| lock-discipline | 3 | 3 | +2 |" in text
+    assert "LOCKFILE DRIFT" in text
 
 
 def test_report_cli_analysis_gate(tmp_path):

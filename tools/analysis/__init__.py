@@ -1,10 +1,12 @@
-"""AST-based invariant checker suite (ISSUE 13).
+"""AST-based invariant checker suite (ISSUE 13; cross-boundary contracts ISSUE 14).
 
 The repo's hard invariants — buffer-donation safety, zero steady-state
-recompiles, lock-guarded shared state, config/schema conformance — are
-machine-checked here at commit time instead of rediscovered in review.
-``python tools/analysis/run.py --strict`` runs every checker over the
-tree and is wired into tier-1 (tests/test_analysis.py).
+recompiles, lock-guarded shared state, config/schema conformance,
+persisted-format stability, atomic publish discipline, exception
+hygiene — are machine-checked here at commit time instead of
+rediscovered in review.  ``python tools/analysis/run.py --strict`` runs
+every checker over the tree and is wired into tier-1
+(tests/test_analysis.py).
 
 Modules:
   core.py             shared infra: Finding model, suppressions, baseline,
@@ -16,4 +18,9 @@ Modules:
   check_config.py     config.py ⇄ sample.cfg ⇄ DESIGN.md key conformance
   check_telemetry.py  RunMonitor envelope conformance (absorbed from the
                       old tools/check_telemetry.py regex checker)
+  check_formats.py    persisted/wire registries vs the committed
+                      formats.lock.json (append-only; removal never legal)
+  check_publish.py    published files land via tmp + os.replace
+  check_exceptions.py bare excepts / thread-silent broad swallows /
+                      diagnosis-dropping re-raises
 """

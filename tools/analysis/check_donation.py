@@ -20,6 +20,14 @@ per module:
     loop-carried read-after-donate (consumed at the bottom, read at the
     top of the next iteration) is caught.
 
+Interprocedural (PR 14): the module call graph (core.module_call_graph)
+follows donation through ONE call boundary — a local def that passes its
+own parameter into a donated position (``def save(state): _step(state)``
+where ``_step`` donates arg 0) becomes a donating callable itself, so
+``save(x); x.sum()`` in the same module is caught.  One hop only, no
+fixpoint: a wrapper-of-a-wrapper is rare and each layer can earn its own
+finding when touched.
+
 Scope: same-module resolution only.  A factory returning a jitted
 closure that another module calls is invisible here — the runtime
 donation error (and the recompile sentinel's twin) covers that path.
@@ -36,6 +44,7 @@ from analysis.core import (
     call_name,
     enclosing_function,
     jax_aliases,
+    module_call_graph,
     parent_map,
     resolves_to,
 )
@@ -141,7 +150,39 @@ def _collect_donated(tree: ast.AST, aliases) -> dict[str, set[int]]:
                         pos = positions_for(dec, node)
                         if pos:
                             out[node.name] = pos
+    _propagate_through_wrappers(tree, out)
     return out
+
+
+def _propagate_through_wrappers(tree: ast.AST, donated: dict[str, set[int]]) -> None:
+    """ONE interprocedural hop: a local def that forwards its own
+    parameter into a donated position of an already-donating callable
+    donates that parameter too — registered under every spelling its
+    callers use ('helper' for module-level defs, 'self.m' for methods,
+    self excluded from the position count)."""
+    graph = module_call_graph(tree)
+    base = {k: set(v) for k, v in donated.items()}  # strictly one hop
+    for qual, fn in graph.defs.items():
+        params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+        is_method = "." in qual and params[:1] == ["self"]
+        for spelling, call in graph.calls.get(qual, ()):
+            pos = base.get(spelling)
+            if not pos:
+                continue
+            for i, arg in enumerate(call.args):
+                if i not in pos or not isinstance(arg, ast.Name):
+                    continue
+                if arg.id not in params:
+                    continue
+                p = params.index(arg.id)
+                if is_method:
+                    if p == 0:
+                        continue  # donating self: not expressible at call sites
+                    name, cpos = f"self.{qual.split('.', 1)[1]}", p - 1
+                else:
+                    name, cpos = qual, p
+                # never overwrite a direct-jit entry's positions; merge
+                donated.setdefault(name, set()).add(cpos)
 
 
 class _ScopeWalker:

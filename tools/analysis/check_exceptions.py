@@ -1,0 +1,238 @@
+"""exception-hygiene: handlers that eat diagnoses the operator needed.
+
+Three sub-rules, each distilled from a bug this repo actually shipped:
+
+  * **bare** — ``except:`` catches SystemExit/KeyboardInterrupt and can
+    wedge shutdown paths.  Always an error, everywhere.
+  * **broad-swallow** — ``except Exception``/``BaseException`` in a
+    THREADED module whose handler neither re-raises, nor logs/emits the
+    failure, nor increments a counter, nor stashes the exception for a
+    consumer.  In a thread, a swallowed exception is a silent corpse:
+    the PR-6 prefetch producer used to die exactly this way and the
+    trainer wedged on ``q.get()`` forever.  A module counts as threaded
+    when it constructs ``threading.Thread``/``Timer``, submits to an
+    executor, or spawns through a trampoline (``self._spawn``).
+  * **diagnosis-dropped** — a handler that answers a caught exception by
+    raising a DIFFERENT one built from constants only: no ``from``
+    chain, no reference to the caught exception in the new message.  The
+    PR-8 bug class: ``validate_classes``'s actionable duplicate-name
+    ValueError was swallowed by a generic "bad format" re-raise.  The
+    fix idiom — ``raise New(f"...: {e}") from e`` (or ``from None`` WITH
+    the original text folded in, protocol.decode-style) — stays quiet.
+
+"Logs" is judged generously (any call whose name suggests reporting:
+log/emit/warn/error/print/put/set_exception/...), because the point is
+not style — it is that SOME trace of the failure escapes the handler.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from analysis.core import (
+    Finding,
+    RepoContext,
+    attr_chain,
+    call_name,
+    enclosing_function,
+    jax_aliases,
+    parent_map,
+    resolves_to,
+)
+
+RULE = "exception-hygiene"
+
+BROAD = ("Exception", "BaseException")
+
+# Call spellings that count as "the failure left a trace".  Matched on
+# the LAST attribute segment (self._log, monitor.emit, fut.set_exception,
+# stderr.write, q.put, counters.append...).
+_REPORTING_TAILS = {
+    "log", "emit", "warn", "warning", "error", "exception", "print",
+    "put", "put_nowait", "set_exception", "append", "write", "add",
+    "debug", "info", "critical", "fail", "abort",
+}
+_REPORTING_HEADS = {"print"}
+
+
+def _is_threaded_module(tree: ast.AST, aliases) -> bool:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        if name is None:
+            continue
+        if (
+            resolves_to(name, "threading.Thread", aliases)
+            or resolves_to(name, "threading.Timer", aliases)
+            or name.split(".")[-1] in ("Thread", "Timer")
+            or name.endswith(".submit")
+            or name.endswith("._spawn")
+        ):
+            return True
+    return False
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare — handled separately but also broad
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [attr_chain(el) or "" for el in t.elts]
+    else:
+        names = [attr_chain(t) or ""]
+    return any(n.split(".")[-1] in BROAD for n in names)
+
+
+def _handler_reports(handler: ast.ExceptHandler) -> bool:
+    """Does anything in the handler body re-raise, log, count, or even
+    LOOK AT the failure?  Referencing the bound exception counts: a
+    handler that forwards ``e`` into a response/box/condition has
+    consulted the diagnosis — the rule targets handlers that throw it
+    away sight unseen."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.AugAssign):
+            return True  # counter += 1
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name is None:
+                continue
+            tail = name.split(".")[-1].lstrip("_")
+            if tail in _REPORTING_TAILS or name in _REPORTING_HEADS:
+                return True
+        if (
+            handler.name
+            and isinstance(node, ast.Name)
+            and node.id == handler.name
+            and isinstance(node.ctx, ast.Load)
+        ):
+            return True
+    return False
+
+
+def _raise_drops_diagnosis(handler: ast.ExceptHandler, node: ast.Raise) -> bool:
+    """True when ``raise`` inside ``handler`` manufactures a NEW
+    exception from constants only, with no chain to (or mention of) the
+    caught one."""
+    if node.exc is None:
+        return False  # bare re-raise preserves everything
+    # ``raise X from e`` chains the diagnosis (PEP 3134); ``from None``
+    # only counts when the message itself folds the original in.
+    if (
+        isinstance(node.cause, ast.Name)
+        and handler.name
+        and node.cause.id == handler.name
+    ):
+        return False
+    if not isinstance(node.exc, ast.Call):
+        # re-raising the bound name (or a pre-built exc object) keeps it
+        return not (
+            isinstance(node.exc, ast.Name)
+            and handler.name
+            and node.exc.id == handler.name
+        )
+    if handler.name:
+        # the idiom is "embed e in the new message", but a handler that
+        # INSPECTED e anywhere (the PEP-562 e.name check) diagnosed it —
+        # only flag handlers that never looked
+        for sub in ast.walk(handler):
+            if isinstance(sub, ast.Name) and sub.id == handler.name and isinstance(sub.ctx, ast.Load):
+                return False
+        return True
+    # no bound name at all: a constants-only re-raise cannot preserve;
+    # but raising with dynamic context (locals in an f-string) is a
+    # judgment call — only flag pure-constant args.
+    for arg in node.exc.args:
+        if not isinstance(arg, ast.Constant):
+            return False
+    return bool(node.exc.args)
+
+
+class ExceptionChecker:
+    name = "exceptions"
+    rules = (RULE,)
+    description = "handlers keep (or forward) the diagnosis they caught"
+
+    def run(self, ctx: RepoContext) -> list[Finding]:
+        findings: list[Finding] = []
+        for sf in ctx.files:
+            tree = sf.tree
+            if tree is None:
+                continue
+            aliases = jax_aliases(tree)
+            parents = parent_map(tree)
+            threaded = _is_threaded_module(tree, aliases)
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                anchor = enclosing_function(node, parents)
+                caught = (
+                    "bare"
+                    if node.type is None
+                    else (attr_chain(node.type) or "tuple")
+                )
+                if node.type is None:
+                    findings.append(
+                        Finding(
+                            rule=RULE,
+                            path=sf.rel,
+                            line=node.lineno,
+                            message=(
+                                "bare 'except:' — catches SystemExit/"
+                                "KeyboardInterrupt and wedges shutdown; name "
+                                "the exceptions this handler can actually "
+                                "deal with"
+                            ),
+                            context=f"{anchor}:bare",
+                            fix_hint="except Exception at the broadest (and report it)",
+                        )
+                    )
+                elif threaded and _is_broad(node) and not _handler_reports(node):
+                    findings.append(
+                        Finding(
+                            rule=RULE,
+                            path=sf.rel,
+                            line=node.lineno,
+                            message=(
+                                f"broad 'except {caught}' in a threaded "
+                                "module swallows the failure without a "
+                                "trace (no re-raise, no log/emit, no "
+                                "counter) — a thread dying here is "
+                                "invisible until something wedges"
+                            ),
+                            context=f"{anchor}:swallow:{caught}",
+                            severity="warning",
+                            fix_hint=(
+                                "narrow to the exceptions this site expects, "
+                                "or log/count the failure before moving on"
+                            ),
+                        )
+                    )
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Raise) and _raise_drops_diagnosis(
+                        node, sub
+                    ):
+                        findings.append(
+                            Finding(
+                                rule=RULE,
+                                path=sf.rel,
+                                line=sub.lineno,
+                                message=(
+                                    "handler replaces the caught exception "
+                                    "with a generic one — the specific "
+                                    "diagnosis (the PR-8 duplicate-"
+                                    "serve_classes class) is lost"
+                                ),
+                                context=f"{anchor}:dropped",
+                                severity="warning",
+                                fix_hint=(
+                                    "chain it (raise New(...) from e) or fold "
+                                    "the original into the message "
+                                    "(f'...: {e}')"
+                                ),
+                            )
+                        )
+        return findings

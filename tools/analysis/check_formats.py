@@ -1,0 +1,553 @@
+"""format-drift: persisted/wire registries pinned by a committed lockfile.
+
+The repo's recurring cross-boundary bug class is FORMAT drift: a fault
+kind inserted mid-tuple reshuffles every seeded chaos schedule, a renamed
+npz member strands every checkpoint on disk, a reordered wire code breaks
+a deployed client.  Five PRs (6, 7, 8, 11, 12) each re-verified "new
+kinds append LAST" by hand; this checker turns that discipline into a
+gate.  ``formats.lock.json`` (committed next to this file) pins every
+persisted/wire registry:
+
+  * ``fault_kinds``        — resilience.FAULT_KINDS (+ the SERVING/STREAM
+                             subsets): ORDER is the seeded-schedule
+                             contract, so the lock must be a prefix of
+                             the current tuple (append-only);
+  * ``telemetry_schemas``  — telemetry.SCHEMAS kinds and per-kind
+                             required keys, plus ENVELOPE_FIELDS: a
+                             removed kind/key orphans every committed
+                             JSONL consumer;
+  * ``fmb_flags``          — data/binary.py FLAG_* bit values: v2 files
+                             on disk carry these bits forever;
+  * ``fms_header``         — data/stream.py magic/version/header
+                             layout/record geometry: append-only streams
+                             outlive any one trainer;
+  * ``wire_protocol``      — serving/protocol.py WIRE_CODES (ordered),
+                             per-exception codes, error-response fields,
+                             readiness prefixes;
+  * ``checkpoint_members`` — checkpoint.py full/delta npz member names
+                             and the training.py input-cursor keys +
+                             version.
+
+Judgment: a REMOVAL, REORDER, or VALUE CHANGE of anything locked is an
+error — for a persisted format, removal is never legal (readers of
+yesterday's bytes still exist).  An ADDITION is legal but must land with
+a same-diff lockfile regeneration: ``run.py --write-lock`` (which itself
+refuses to bake in a removal).  Everything is extracted from the AST —
+stdlib-only, no imports of the (possibly jax-heavy) modules.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+
+from analysis.core import Finding, RepoContext
+
+RULE = "format-drift"
+
+LOCK_BASENAME = "formats.lock.json"
+
+# section -> list of (entry, kind) where kind ∈ ordered | mapping | scalar.
+# ``ordered`` entries are append-only sequences (lock must be a prefix of
+# current); ``mapping`` entries are name->value maps whose values are
+# key SETS (removal illegal, addition needs --write-lock); ``scalar``
+# entries must match exactly.
+SECTIONS = {
+    "fault_kinds": "fast_tffm_tpu/resilience.py",
+    "telemetry_schemas": "fast_tffm_tpu/telemetry.py",
+    "fmb_flags": "fast_tffm_tpu/data/binary.py",
+    "fms_header": "fast_tffm_tpu/data/stream.py",
+    "wire_protocol": "fast_tffm_tpu/serving/protocol.py",
+    "checkpoint_members": "fast_tffm_tpu/checkpoint.py",  # + training.py cursor
+}
+
+
+def lock_path_for(root: str) -> str:
+    return os.path.join(root, "tools", "analysis", LOCK_BASENAME)
+
+
+# -- AST extraction ---------------------------------------------------------
+
+
+def _const_seq(node) -> list | None:
+    """['kill', ...] from a Tuple/List of Constants, else None."""
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return None
+    out = []
+    for el in node.elts:
+        if not isinstance(el, ast.Constant):
+            return None
+        v = el.value
+        if isinstance(v, bytes):
+            v = v.decode("latin-1")
+        out.append(v)
+    return out
+
+
+def _module_assigns(tree: ast.AST):
+    """(name, value-node) for every module-level Assign/AnnAssign."""
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    yield tgt.id, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            if isinstance(node.target, ast.Name):
+                yield node.target.id, node.value
+
+
+def _extract_fault_kinds(tree) -> dict:
+    out = {}
+    for name, value in _module_assigns(tree):
+        if name in ("FAULT_KINDS", "SERVING_FAULT_KINDS", "STREAM_FAULT_KINDS"):
+            seq = _const_seq(value)
+            if seq is not None:
+                out[name] = seq
+    return out
+
+
+def _extract_telemetry(tree) -> dict:
+    out = {}
+    for name, value in _module_assigns(tree):
+        if name == "ENVELOPE_FIELDS":
+            seq = _const_seq(value)
+            if seq is not None:
+                out["ENVELOPE_FIELDS"] = seq
+        elif name == "SCHEMAS" and isinstance(value, ast.Dict):
+            kinds = {}
+            for k, v in zip(value.keys, value.values):
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    keys = _const_seq(v)
+                    if keys is not None:
+                        kinds[k.value] = sorted(keys)
+            out["SCHEMAS"] = kinds
+    return out
+
+
+def _extract_fmb_flags(tree) -> dict:
+    out = {}
+    for name, value in _module_assigns(tree):
+        if name.startswith("FLAG_") and isinstance(value, ast.Constant):
+            if isinstance(value.value, int):
+                out[name] = value.value
+    return out
+
+
+def _extract_fms_header(tree) -> dict:
+    out = {}
+    for name, value in _module_assigns(tree):
+        if name == "FMS_MAGIC" and isinstance(value, ast.Constant):
+            v = value.value
+            out["magic"] = v.decode("latin-1") if isinstance(v, bytes) else v
+        elif name in ("FMS_VERSION", "FMS_HEADER_BYTES"):
+            if isinstance(value, ast.Constant):
+                out[{"FMS_VERSION": "version", "FMS_HEADER_BYTES": "header_bytes"}[name]] = value.value
+        elif name == "_HEADER" and isinstance(value, ast.Call):
+            if value.args and isinstance(value.args[0], ast.Constant):
+                out["struct_format"] = value.args[0].value
+    # record geometry: fms_record_bytes's `A + B * int(width)` constants;
+    # if the formula shape ever changes, pin its source text instead so
+    # the change still reads as drift.
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef) and node.name == "fms_record_bytes":
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Return) and sub.value is not None:
+                    expr = sub.value
+                    if (
+                        isinstance(expr, ast.BinOp)
+                        and isinstance(expr.op, ast.Add)
+                        and isinstance(expr.left, ast.Constant)
+                        and isinstance(expr.right, ast.BinOp)
+                        and isinstance(expr.right.op, ast.Mult)
+                        and isinstance(expr.right.left, ast.Constant)
+                    ):
+                        out["record_bytes_fixed"] = expr.left.value
+                        out["record_bytes_per_width"] = expr.right.left.value
+                    else:
+                        out["record_bytes_formula"] = ast.unparse(expr)
+    return out
+
+
+def _extract_wire_protocol(tree) -> dict:
+    out = {}
+    codes = {}
+    prefixes = {}
+    for name, value in _module_assigns(tree):
+        if name == "WIRE_CODES":
+            seq = _const_seq(value)
+            if seq is not None:
+                out["WIRE_CODES"] = seq
+        elif name.endswith("_READY_PREFIX") and isinstance(value, ast.Constant):
+            prefixes[name] = value.value
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            for stmt in node.body:
+                if (
+                    isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and stmt.targets[0].id == "code"
+                    and isinstance(stmt.value, ast.Constant)
+                ):
+                    codes[node.name] = stmt.value.value
+        if isinstance(node, ast.FunctionDef) and node.name == "error_response":
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Return) and isinstance(sub.value, ast.Dict):
+                    fields = [
+                        k.value
+                        for k in sub.value.keys
+                        if isinstance(k, ast.Constant)
+                    ]
+                    out["error_response_fields"] = {f: "required" for f in fields}
+    if codes:
+        out["exception_codes"] = codes
+    if prefixes:
+        out["ready_prefixes"] = prefixes
+    return out
+
+
+def _dict_member_keys(fn: ast.FunctionDef, var: str) -> list[str] | None:
+    """npz member names written into ``var`` inside ``fn``: the literal
+    keys of its dict construction plus every ``var["k"] = ...`` subscript
+    assignment (f-string keys render as patterns: ``dense_{}``)."""
+    keys: list[str] = []
+    found = False
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if (
+                    isinstance(tgt, ast.Name)
+                    and tgt.id == var
+                    and isinstance(node.value, ast.Dict)
+                ):
+                    found = True
+                    for k in node.value.keys:
+                        if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                            keys.append(k.value)
+                if (
+                    isinstance(tgt, ast.Subscript)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == var
+                ):
+                    sl = tgt.slice
+                    if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                        keys.append(sl.value)
+                    elif isinstance(sl, ast.JoinedStr):
+                        pat = "".join(
+                            v.value if isinstance(v, ast.Constant) else "{}"
+                            for v in sl.values
+                        )
+                        keys.append(pat)
+    return sorted(set(keys)) if found else None
+
+
+def _extract_checkpoint_members(tree, training_tree=None) -> dict:
+    out = {}
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef) and node.name == "_save_npz":
+            keys = _dict_member_keys(node, "entries")
+            if keys is not None:
+                out["full"] = keys
+        elif isinstance(node, ast.FunctionDef) and node.name == "save_delta":
+            keys = _dict_member_keys(node, "entries")
+            if keys is not None:
+                out["delta"] = keys
+    if training_tree is not None:
+        for node in ast.walk(training_tree):
+            if (
+                isinstance(node, ast.FunctionDef)
+                and node.name == "input_cursor"
+            ):
+                keys = []
+                version = None
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Dict):
+                        for k, v in zip(sub.keys, sub.values):
+                            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                                keys.append(k.value)
+                                if k.value == "version" and isinstance(v, ast.Constant):
+                                    version = v.value
+                    if isinstance(sub, ast.Assign):
+                        for tgt in sub.targets:
+                            if (
+                                isinstance(tgt, ast.Subscript)
+                                and isinstance(tgt.slice, ast.Constant)
+                                and isinstance(tgt.slice.value, str)
+                            ):
+                                keys.append(tgt.slice.value)
+                if keys:
+                    out["cursor_keys"] = sorted(set(keys))
+                if version is not None:
+                    out["cursor_version"] = version
+    return out
+
+
+def extract_registries(ctx: RepoContext) -> dict:
+    """Current registry state, AST-extracted per section.  Sections whose
+    source file is absent (fixture mini-repos) are simply omitted — the
+    lock comparison then only judges what exists on both sides."""
+    out: dict = {}
+    extractors = {
+        "fault_kinds": _extract_fault_kinds,
+        "telemetry_schemas": _extract_telemetry,
+        "fmb_flags": _extract_fmb_flags,
+        "fms_header": _extract_fms_header,
+        "wire_protocol": _extract_wire_protocol,
+    }
+    for section, rel in SECTIONS.items():
+        sf = ctx.file(rel)
+        if sf is None or sf.tree is None:
+            continue
+        if section == "checkpoint_members":
+            tsf = ctx.file("fast_tffm_tpu/training.py")
+            data = _extract_checkpoint_members(
+                sf.tree, tsf.tree if tsf is not None else None
+            )
+        else:
+            data = extractors[section](sf.tree)
+        if data:
+            out[section] = data
+    return out
+
+
+# -- lockfile ---------------------------------------------------------------
+
+
+def load_lock(path: str) -> dict:
+    """{"version": 1, "sections": {...}}; raises ValueError on any other
+    shape so --write-lock (and the checker) refuse corrupt lockfiles
+    loudly instead of treating them as empty."""
+    with open(path) as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or not isinstance(data.get("sections"), dict):
+        raise ValueError(f"{path}: not a formats lockfile (no 'sections' map)")
+    return data
+
+
+def write_lock(path: str, sections: dict) -> None:
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    with open(path + ".tmp", "w") as f:
+        json.dump({"version": 1, "sections": sections}, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(path + ".tmp", path)
+
+
+# Entries compared as append-only ORDERED sequences (the lock must be a
+# prefix of the current value); everything else compares as a map/scalar.
+_ORDERED = {
+    ("fault_kinds", "FAULT_KINDS"),
+    ("fault_kinds", "SERVING_FAULT_KINDS"),
+    ("fault_kinds", "STREAM_FAULT_KINDS"),
+    ("telemetry_schemas", "ENVELOPE_FIELDS"),
+    ("wire_protocol", "WIRE_CODES"),
+}
+
+
+def diff_lock(locked_sections: dict, current: dict):
+    """(drift, additions): ``drift`` = findings-worth of removals /
+    reorders / value changes (never legal), ``additions`` = entries
+    present in the code but not in the lock (legal, but the lockfile must
+    be regenerated in the same diff).  Each item is (section, name,
+    message)."""
+    drift: list[tuple[str, str, str]] = []
+    additions: list[tuple[str, str, str]] = []
+
+    def diff_value(section, name, locked, cur):
+        if (section, name) in _ORDERED:
+            if not isinstance(cur, list):
+                drift.append((section, name, f"locked sequence became {type(cur).__name__}"))
+                return
+            if list(cur[: len(locked)]) != list(locked):
+                # name the first divergence for the human
+                for i, want in enumerate(locked):
+                    got = cur[i] if i < len(cur) else "<removed>"
+                    if got != want:
+                        drift.append(
+                            (
+                                section,
+                                name,
+                                f"position {i} is {got!r}, locked as {want!r} "
+                                "— persisted order is append-only (seeded "
+                                "schedules / wire readers key on it)",
+                            )
+                        )
+                        return
+            elif len(cur) > len(locked):
+                tail = cur[len(locked):]
+                additions.append(
+                    (section, name, f"appended {tail!r} not yet in the lockfile")
+                )
+        elif isinstance(locked, dict):
+            if not isinstance(cur, dict):
+                drift.append((section, name, f"locked mapping became {type(cur).__name__}"))
+                return
+            for k, lv in locked.items():
+                if k not in cur:
+                    drift.append(
+                        (
+                            section,
+                            name,
+                            f"{k!r} removed — readers of already-persisted "
+                            "data still require it",
+                        )
+                    )
+                elif isinstance(lv, list):
+                    missing = sorted(set(lv) - set(cur[k]))
+                    if missing:
+                        drift.append(
+                            (section, name, f"{k!r} lost required key(s) {missing}")
+                        )
+                    added = sorted(set(cur[k]) - set(lv))
+                    if added:
+                        additions.append(
+                            (section, name, f"{k!r} gained key(s) {added}")
+                        )
+                elif cur[k] != lv:
+                    drift.append(
+                        (section, name, f"{k!r} changed: {lv!r} -> {cur[k]!r}")
+                    )
+            for k in sorted(set(cur) - set(locked)):
+                additions.append((section, name, f"new entry {k!r}"))
+        elif isinstance(locked, list):  # unordered member/key sets
+            missing = sorted(set(locked) - set(cur or ()))
+            if missing:
+                drift.append(
+                    (
+                        section,
+                        name,
+                        f"removed {missing} — persisted members/keys are "
+                        "forever (old files still carry them)",
+                    )
+                )
+            added = sorted(set(cur or ()) - set(locked))
+            if added:
+                additions.append((section, name, f"added {added}"))
+        elif cur != locked:
+            drift.append((section, name, f"changed: {locked!r} -> {cur!r}"))
+
+    for section, locked in locked_sections.items():
+        if section not in current:
+            if section in SECTIONS:
+                drift.append(
+                    (
+                        section,
+                        "<section>",
+                        f"registry source {SECTIONS[section]} is gone or no "
+                        "longer defines the locked names",
+                    )
+                )
+            continue
+        cur = current[section]
+        for name, lv in locked.items():
+            if name not in cur:
+                drift.append((section, name, "locked registry no longer extractable"))
+            else:
+                diff_value(section, name, lv, cur[name])
+        for name in sorted(set(cur) - set(locked)):
+            additions.append((section, name, "new registry not yet locked"))
+    for section in sorted(set(current) - set(locked_sections)):
+        additions.append((section, "<section>", "new section not yet locked"))
+    return drift, additions
+
+
+class FormatsChecker:
+    """``lock_path`` defaults to ``<root>/tools/analysis/formats.lock.json``
+    (the committed one when root is this checkout)."""
+
+    name = "formats"
+    rules = (RULE,)
+    description = "persisted/wire registries match the committed lockfile"
+
+    def __init__(self, lock_path: str | None = None):
+        self._lock_path = lock_path
+
+    def run(self, ctx: RepoContext) -> list[Finding]:
+        lock_path = self._lock_path or lock_path_for(ctx.root)
+        current = extract_registries(ctx)
+        rel_lock = os.path.relpath(lock_path, ctx.root).replace(os.sep, "/")
+        if not os.path.isfile(lock_path):
+            if not current:
+                return []  # nothing lockable in this tree
+            return [
+                Finding(
+                    rule=RULE,
+                    path=rel_lock,
+                    line=0,
+                    message=(
+                        f"no {LOCK_BASENAME} — the persisted-format registries "
+                        "are unpinned; generate and commit it"
+                    ),
+                    context="lock:missing",
+                    fix_hint="python -m tools.analysis.run --write-lock",
+                )
+            ]
+        try:
+            lock = load_lock(lock_path)
+        except (ValueError, json.JSONDecodeError) as e:
+            return [
+                Finding(
+                    rule=RULE,
+                    path=rel_lock,
+                    line=0,
+                    message=f"lockfile unreadable: {e}",
+                    context="lock:corrupt",
+                    fix_hint=(
+                        "restore the committed lockfile (git checkout) — do "
+                        "not hand-edit it; --write-lock regenerates"
+                    ),
+                )
+            ]
+        findings = []
+        drift, additions = diff_lock(lock.get("sections", {}), current)
+        for section, name, msg in drift:
+            src = SECTIONS.get(section, rel_lock)
+            sf = ctx.file(src)
+            findings.append(
+                Finding(
+                    rule=RULE,
+                    path=src if sf is not None else rel_lock,
+                    line=_anchor_line(sf, name),
+                    message=f"[{section}] {name}: {msg}",
+                    context=f"{section}:{name}:drift",
+                    fix_hint=(
+                        "removal/reorder/value-change of a persisted format "
+                        "is never legal — append instead (and --write-lock); "
+                        "a deliberate format break needs a version bump and "
+                        "a migration story first"
+                    ),
+                )
+            )
+        for section, name, msg in additions:
+            src = SECTIONS.get(section, rel_lock)
+            sf = ctx.file(src)
+            findings.append(
+                Finding(
+                    rule=RULE,
+                    path=src if sf is not None else rel_lock,
+                    line=_anchor_line(sf, name),
+                    message=(
+                        f"[{section}] {name}: {msg} — regenerate the lockfile "
+                        "in this same diff"
+                    ),
+                    context=f"{section}:{name}:addition",
+                    fix_hint="python -m tools.analysis.run --write-lock",
+                )
+            )
+        return findings
+
+
+def _anchor_line(sf, name: str) -> int:
+    """Best-effort clickable line: the registry name's first definition
+    line in its source file (0 when unknown)."""
+    if sf is None or not name or name.startswith("<"):
+        return 0
+    for i, line in enumerate(sf.lines, 1):
+        if line.startswith(name):  # the definition, not the __all__ entry
+            return i
+    for i, line in enumerate(sf.lines, 1):
+        if line.lstrip().startswith(f'"{name}"'):
+            return i
+    return 0

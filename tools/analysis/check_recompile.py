@@ -33,6 +33,7 @@ from analysis.core import (
     call_name,
     enclosing_function,
     jax_aliases,
+    function_defs,
     parent_map,
     resolves_to,
 )
@@ -53,10 +54,37 @@ def _is_jit(call: ast.Call, aliases) -> bool:
     )
 
 
+def _jit_factories(tree: ast.AST, aliases) -> set[str]:
+    """Local def qualnames that RETURN a jitted callable (directly, or a
+    local bound to one) — the PR-14 interprocedural upgrade: ``step =
+    make_step(...)`` makes ``step`` a known-jitted callable at its call
+    sites, so the traced-scalar check sees through the helper."""
+    out: set[str] = set()
+    for qual, fn in function_defs(tree).items():
+        jit_locals: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                if _is_jit(node.value, aliases):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            jit_locals.add(tgt.id)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Return) and node.value is not None:
+                v = node.value
+                if isinstance(v, ast.Call) and _is_jit(v, aliases):
+                    out.add(qual)
+                elif isinstance(v, ast.Name) and v.id in jit_locals:
+                    out.add(qual)
+    return out
+
+
 def _jit_callables(tree: ast.AST, aliases) -> set[str]:
     """Names (as written at call sites) bound to jitted callables in this
-    module — the traced-scalar check's target set."""
+    module — the traced-scalar check's target set.  Includes names bound
+    from a local jit FACTORY's return value (one call hop)."""
     out: set[str] = set()
+    factories = _jit_factories(tree, aliases)
+    factory_tails = {q.split(".")[-1] for q in factories}
     for node in ast.walk(tree):
         if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
             if _is_jit(node.value, aliases):
@@ -64,6 +92,16 @@ def _jit_callables(tree: ast.AST, aliases) -> set[str]:
                     name = attr_chain(tgt)
                     if name:
                         out.add(name)
+            else:
+                cname = call_name(node.value)
+                if cname is not None and (
+                    cname in factories
+                    or cname.split(".")[-1] in factory_tails
+                ):
+                    for tgt in node.targets:
+                        name = attr_chain(tgt)
+                        if name:
+                            out.add(name)
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             for dec in node.decorator_list:
                 if isinstance(dec, ast.Call) and _is_jit(dec, aliases):

@@ -38,6 +38,9 @@ RULES = (
     "lock-order",
     "config-key",
     "telemetry",
+    "format-drift",
+    "atomic-publish",
+    "exception-hygiene",
     "suppression",
     "parse",
 )
@@ -174,14 +177,20 @@ class RepoContext:
 
 
 DEFAULT_EXCLUDE_DIRS = {
-    "__pycache__", ".git", "csrc", "docs", "data", "configs", "tests"
+    "__pycache__", ".git", "csrc", "docs", "configs", "tests"
 }
 
 
 def discover(root: str) -> list[str]:
     """Default target set: the package, tools (including this suite),
     and the top-level drivers.  tests/ is excluded on purpose — its
-    fixtures (including test_analysis's own) violate rules by design."""
+    fixtures (including test_analysis's own) violate rules by design.
+    (PR 14 note: ``data`` used to be excluded here for the root-level
+    dataset directory — but the walk never visits the root, and the
+    entry silently pruned the ``fast_tffm_tpu/data`` PACKAGE out of the
+    whole suite: the wire/binary/stream format modules were unanalyzed
+    for a full PR cycle.  The format registries live exactly there, so
+    the blind spot is gone.)"""
     rels: list[str] = []
     for base in ("fast_tffm_tpu", "tools"):
         for dirpath, dirnames, filenames in os.walk(os.path.join(root, base)):
@@ -396,6 +405,85 @@ def enclosing_function(node: ast.AST, parents: dict) -> str:
     return ".".join(reversed(names)) or "<module>"
 
 
+# -- interprocedural call graph (PR 14) -------------------------------------
+#
+# One module, one graph: every def (module-level 'helper', methods as
+# 'Class.method') plus the calls each makes, with call-site spellings
+# resolved back to local defs where possible ('helper' → helper;
+# 'self.m' → '<enclosing class>.m').  Deliberately ONE module deep and
+# ONE hop at a time: the checkers that ride it (donation wrappers,
+# factory-returned jit callables) follow a single call boundary, which
+# is where the historical bugs lived — a whole-repo fixpoint would buy
+# noise, not signal.
+
+
+def function_defs(tree: ast.AST) -> dict[str, ast.FunctionDef]:
+    """Qualname → def node: module-level defs under their bare name,
+    methods as 'Class.method'.  Nested (closure) defs are skipped — they
+    are not callable from outside their scope."""
+    out: dict[str, ast.FunctionDef] = {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[node.name] = node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    out[f"{node.name}.{sub.name}"] = sub
+    return out
+
+
+class CallGraph:
+    """``defs``: qualname → def node.  ``calls``: caller qualname →
+    [(callee spelling as written, Call node)].  ``resolve`` maps a
+    spelling at a call site inside ``caller`` to a local def qualname
+    (or None for externals)."""
+
+    def __init__(self, defs, calls):
+        self.defs = defs
+        self.calls = calls
+
+    def resolve(self, caller: str, spelling: str) -> str | None:
+        if spelling in self.defs:
+            return spelling
+        head, _, rest = spelling.partition(".")
+        if head == "self" and rest and "." in caller:
+            qual = f"{caller.split('.')[0]}.{rest.split('.')[0]}"
+            if qual in self.defs:
+                return qual
+        return None
+
+    def callees(self, caller: str):
+        """Resolved (qualname, Call) pairs for one caller."""
+        for spelling, call in self.calls.get(caller, ()):
+            qual = self.resolve(caller, spelling)
+            if qual is not None:
+                yield qual, call
+
+
+def _walk_own_scope(fn: ast.AST):
+    """Nodes of ``fn``'s body excluding nested def/class bodies (those
+    are their own scopes; a closure's calls are not the enclosing def's)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def module_call_graph(tree: ast.AST) -> CallGraph:
+    defs = function_defs(tree)
+    calls: dict[str, list] = {q: [] for q in defs}
+    for qual, fn in defs.items():
+        for node in _walk_own_scope(fn):
+            if isinstance(node, ast.Call):
+                name = call_name(node)
+                if name is not None:
+                    calls[qual].append((name, node))
+    return CallGraph(defs, calls)
+
+
 # -- output ----------------------------------------------------------------
 
 
@@ -434,9 +522,13 @@ def render_text(
 def to_json(findings, new, stale, baseline, root) -> dict:
     by_rule: dict[str, int] = {}
     by_sev: dict[str, int] = {}
+    debt_by_rule: dict[str, int] = {}
+    new_keys = {f.key for f in new}
     for f in findings:
         by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
         by_sev[f.severity] = by_sev.get(f.severity, 0) + 1
+        if f.key not in new_keys:
+            debt_by_rule[f.rule] = debt_by_rule.get(f.rule, 0) + 1
     return {
         "version": 1,
         "root": root,
@@ -446,7 +538,12 @@ def to_json(findings, new, stale, baseline, root) -> dict:
             "stale": len(stale),
             "unjustified": len(unjustified(baseline)),
             "debt": len(findings) - len(new),
+            "debt_by_rule": debt_by_rule,
         },
+        # The lockfile gate's input: ANY live format-drift finding —
+        # pinned or not — is persisted-format drift (pinning drift in the
+        # baseline must not hide it from the report gate).
+        "lock_drift": by_rule.get("format-drift", 0),
         "new": [f.to_dict() for f in new],
         "findings": [f.to_dict() for f in findings],
     }

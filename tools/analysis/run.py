@@ -15,6 +15,11 @@
                                                     #   (justifications still
                                                     #   owed: --strict refuses
                                                     #   empty ones)
+    python tools/analysis/run.py --write-lock       # re-pin the persisted-
+                                                    #   format registries into
+                                                    #   formats.lock.json after
+                                                    #   an APPEND (refuses
+                                                    #   removals/reorders)
 
 Exit codes: 0 = conformant; 1 = gate failed (--strict only); 2 = usage.
 Stdlib-only — the suite runs where jax can't import.
@@ -25,6 +30,13 @@ New findings fail --strict; paying off debt leaves stale entries the
 report tells you to prune.  Per-line escapes use the suppression
 comment (``# analysis: ok <rule> <reason>``) — reasons required there
 too.
+
+Lockfile policy: ``formats.lock.json`` (committed next to this file)
+pins every persisted/wire registry — fault kinds, telemetry schemas,
+FMB flags, FMS header, serving wire protocol, checkpoint members +
+cursor.  Removal/reorder/value change is never legal (readers of
+yesterday's bytes still exist); additions regenerate with --write-lock
+in the same diff.  DESIGN.md "Static analysis" has the full policy.
 """
 
 from __future__ import annotations
@@ -40,9 +52,13 @@ if _TOOLS not in sys.path:
     sys.path.insert(0, _TOOLS)
 
 from analysis import core  # noqa: E402
+from analysis import check_formats  # noqa: E402
 from analysis.check_config import ConfigChecker  # noqa: E402
 from analysis.check_donation import DonationChecker  # noqa: E402
+from analysis.check_exceptions import ExceptionChecker  # noqa: E402
+from analysis.check_formats import FormatsChecker  # noqa: E402
 from analysis.check_locks import LockChecker  # noqa: E402
+from analysis.check_publish import PublishChecker  # noqa: E402
 from analysis.check_recompile import RecompileChecker  # noqa: E402
 from analysis.check_telemetry import TelemetryChecker  # noqa: E402
 
@@ -54,6 +70,9 @@ CHECKERS = {
     "locks": LockChecker,
     "config": ConfigChecker,
     "telemetry": TelemetryChecker,
+    "formats": FormatsChecker,
+    "publish": PublishChecker,
+    "exceptions": ExceptionChecker,
 }
 
 
@@ -65,7 +84,8 @@ def _rule_prefixes(rules) -> tuple[str, ...]:
     ) + ("suppression::", "parse::")
 
 
-def run_suite(root: str, rules=None, ctx: core.RepoContext | None = None):
+def run_suite(root: str, rules=None, ctx: core.RepoContext | None = None,
+              lock_path: str | None = None):
     """(findings, ctx) over ``root`` for the named checkers (all by
     default).  Suppressions are already applied; baseline is not."""
     if ctx is None:
@@ -74,18 +94,89 @@ def run_suite(root: str, rules=None, ctx: core.RepoContext | None = None):
     for name, cls in CHECKERS.items():
         if rules and name not in rules:
             continue
-        findings.extend(cls().run(ctx))
+        checker = cls(lock_path) if name == "formats" else cls()
+        findings.extend(checker.run(ctx))
     findings = core.apply_suppressions(findings, ctx)
     core.disambiguate(findings)
     findings.sort(key=lambda f: (f.rule, f.path, f.line))
     return findings, ctx
 
 
+def _write_lock(root: str, lock_path: str, sections_arg: str | None) -> int:
+    """Regenerate the formats lockfile (mirrors --write-baseline): refuse
+    a corrupt existing lockfile (rewriting would silently launder drift),
+    refuse to bake in a removal/reorder (never legal for a persisted
+    format — appending is the only move), and on a --lock-sections subset
+    rewrite preserve the other sections verbatim."""
+    ctx = core.RepoContext(root, core.discover(root))
+    current = check_formats.extract_registries(ctx)
+    if not current:
+        print("analysis: no lockable registries under this root", file=sys.stderr)
+        return 2
+    wanted = None
+    if sections_arg:
+        wanted = {s.strip() for s in sections_arg.split(",") if s.strip()}
+        unknown = wanted - set(check_formats.SECTIONS)
+        if unknown:
+            print(
+                f"analysis: unknown lock section(s) {sorted(unknown)} "
+                f"(one of {','.join(check_formats.SECTIONS)})",
+                file=sys.stderr,
+            )
+            return 2
+    existing: dict = {}
+    if os.path.isfile(lock_path):
+        try:
+            existing = check_formats.load_lock(lock_path).get("sections", {})
+        except (ValueError, json.JSONDecodeError) as e:
+            print(
+                f"analysis: refusing --write-lock: existing {lock_path} is "
+                f"unreadable ({e}) — restore it from git first (rewriting "
+                "over corruption would silently launder any drift)",
+                file=sys.stderr,
+            )
+            return 2
+        scope = {
+            k: v for k, v in existing.items() if wanted is None or k in wanted
+        }
+        drift, _additions = check_formats.diff_lock(scope, current)
+        if drift:
+            print(
+                "analysis: refusing --write-lock — regeneration would bake "
+                "in removals/reorders, which are never legal for a "
+                "persisted format:",
+                file=sys.stderr,
+            )
+            for section, name, msg in drift:
+                print(f"  [{section}] {name}: {msg}", file=sys.stderr)
+            print(
+                "restore the removed entries (append-only), or — for a "
+                "deliberate format break with a migration story — delete "
+                "the affected section from the lockfile by hand first.",
+                file=sys.stderr,
+            )
+            return 2
+    out = dict(existing)
+    for section, data in current.items():
+        if wanted is None or section in wanted:
+            out[section] = data
+    check_formats.write_lock(lock_path, out)
+    kept = sorted(set(existing) - set(current)) if wanted is None else sorted(
+        set(existing) - (wanted or set())
+    )
+    print(
+        f"analysis: locked {len(out)} section(s) into {lock_path}"
+        + (f" ({len(kept)} preserved verbatim)" if kept else "")
+        + " — commit it in the same diff as the registry change"
+    )
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="analysis",
         description="AST invariant checkers: donation, recompile, locks, "
-        "config, telemetry.",
+        "config, telemetry, formats, publish, exceptions.",
     )
     ap.add_argument(
         "--root",
@@ -112,6 +203,26 @@ def main(argv=None) -> int:
         help="pin the current findings into --baseline and exit",
     )
     ap.add_argument(
+        "--lock",
+        metavar="PATH",
+        help="formats lockfile (default: <root>/tools/analysis/"
+        + check_formats.LOCK_BASENAME + ")",
+    )
+    ap.add_argument(
+        "--write-lock",
+        action="store_true",
+        help="regenerate the formats lockfile from the current registries "
+        "and exit (refuses to bake in a removal/reorder — those are never "
+        "legal for a persisted format)",
+    )
+    ap.add_argument(
+        "--lock-sections",
+        metavar="S1,S2",
+        help="with --write-lock: rewrite only these sections "
+        f"({','.join(check_formats.SECTIONS)}); the others are preserved "
+        "verbatim",
+    )
+    ap.add_argument(
         "--strict",
         action="store_true",
         help="exit 1 on new findings, unjustified baseline entries, or "
@@ -129,7 +240,15 @@ def main(argv=None) -> int:
             return 2
 
     root = os.path.abspath(args.root)
-    findings, _ctx = run_suite(root, rules)
+    lock_path = args.lock or check_formats.lock_path_for(root)
+
+    if args.lock_sections and not args.write_lock:
+        print("analysis: --lock-sections requires --write-lock", file=sys.stderr)
+        return 2
+    if args.write_lock:
+        return _write_lock(root, lock_path, args.lock_sections)
+
+    findings, _ctx = run_suite(root, rules, lock_path=lock_path)
 
     if args.write_baseline:
         # Regeneration is non-destructive: justifications of persisting

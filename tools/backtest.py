@@ -282,7 +282,7 @@ def main(argv=None) -> int:
         gate_ok = worst <= args.threshold and not any(
             "backtest" in r or "online" in r for r in regressions
         )
-        from fast_tffm_tpu.telemetry import artifact_stamp
+        from fast_tffm_tpu.telemetry import artifact_stamp, write_json_artifact
 
         result = {
             **artifact_stamp(run_id),
@@ -310,8 +310,7 @@ def main(argv=None) -> int:
             "gate": "OK" if gate_ok else "REGRESSED",
             "report_regressions": regressions,
         }
-        with open(args.out, "w") as f:
-            json.dump(result, f, indent=1)
+        write_json_artifact(args.out, result, sort_keys=False)
         print(f"wrote {args.out} (gate: {result['gate']})")
         return 0 if gate_ok else 1
     finally:

@@ -338,7 +338,7 @@ def _serve_chaos(args) -> int:
         print("chaos: --serve-plan has no serving faults", file=sys.stderr)
         return 1
     lines = _serve_lines(SERVE_REQUESTS, args.seed)
-    from fast_tffm_tpu.telemetry import artifact_stamp
+    from fast_tffm_tpu.telemetry import artifact_stamp, write_json_artifact
 
     result: dict = {
         "probe": "SERVE_CHAOS",
@@ -414,6 +414,7 @@ def _serve_chaos(args) -> int:
                     # while serving continues on the loaded state.
                     print("chaos: publishing a torn successor checkpoint",
                           flush=True)
+                    # analysis: ok atomic-publish deliberate corruption injection — tearing the publish IS the fault under test
                     with open(model_file, "wb") as f:
                         f.write(corrupt_bytes)
 
@@ -432,6 +433,7 @@ def _serve_chaos(args) -> int:
             # bytes back up (same content ⇒ same scores) — reload
             # failures were counted while it was torn.
             if any(e["kind"] == "reload_corrupt" for e in serving):
+                # analysis: ok atomic-publish healing the injected corruption in place — same deliberate-fault channel as the tear
                 with open(model_file, "wb") as f:
                     f.write(good_bytes)
 
@@ -521,9 +523,7 @@ def _serve_chaos(args) -> int:
     if hard_fail:
         result["error"] = hard_fail
     result["ok"] = ok
-    with open(out_path, "w") as f:
-        json.dump(result, f, indent=1, sort_keys=True)
-        f.write("\n")
+    write_json_artifact(out_path, result)
     print(f"chaos: wrote {out_path} (ok={ok})")
     return 0 if ok else 1
 
@@ -564,7 +564,7 @@ def main(argv=None) -> int:
         if pod
         else ["train"] + (["dist_train"] if args.sharded else [])
     )
-    from fast_tffm_tpu.telemetry import artifact_stamp
+    from fast_tffm_tpu.telemetry import artifact_stamp, write_json_artifact
 
     result: dict = {
         # Envelope identity keys: the chaos trials' JSONL lives (and dies)
@@ -624,9 +624,7 @@ def main(argv=None) -> int:
             "mttr_s_max": round(max(mttrs), 3) if mttrs else None,
             "all_losses_bit_identical": path_ok,
         }
-    with open(out_path, "w") as f:
-        json.dump(result, f, indent=1, sort_keys=True)
-        f.write("\n")
+    write_json_artifact(out_path, result)
     print(f"chaos: wrote {out_path} (ok={ok})")
     return 0 if ok else 1
 

@@ -382,7 +382,7 @@ def run_freshness_probe(args, cfg, log) -> int:
 
     from fast_tffm_tpu.checkpoint import restore_checkpoint, save_checkpoint
     from fast_tffm_tpu.config import build_model
-    from fast_tffm_tpu.telemetry import artifact_stamp
+    from fast_tffm_tpu.telemetry import artifact_stamp, write_json_artifact
     from fast_tffm_tpu.trainer import init_state
 
     if cfg.serve_reload_interval_s <= 0:
@@ -476,8 +476,7 @@ def run_freshness_probe(args, cfg, log) -> int:
     out = json.dumps(result, indent=2)
     print(out)
     if args.out:
-        with open(args.out, "w") as f:
-            f.write(out + "\n")
+        write_json_artifact(args.out, result, indent=2, sort_keys=False)
     return 0 if flips_ms and not unanswered else 1
 
 
@@ -676,7 +675,7 @@ def main(argv=None) -> int:
                 for e in engines.values()
                 if isinstance(e.get("steady_compiles"), int)
             ]
-            from fast_tffm_tpu.telemetry import artifact_stamp
+            from fast_tffm_tpu.telemetry import artifact_stamp, write_json_artifact
 
             result.update(
                 # Join keys: the tier's run_id + envelope schema version —
@@ -738,7 +737,7 @@ def main(argv=None) -> int:
         snap = engine.metrics_snapshot()
         run_id = engine.run_id
         engine.close()
-        from fast_tffm_tpu.telemetry import artifact_stamp
+        from fast_tffm_tpu.telemetry import artifact_stamp, write_json_artifact
 
         result.update(
             **artifact_stamp(run_id),
@@ -771,8 +770,7 @@ def main(argv=None) -> int:
     out = json.dumps(result, indent=2)
     print(out)
     if args.out:
-        with open(args.out, "w") as f:
-            f.write(out + "\n")
+        write_json_artifact(args.out, result, indent=2, sort_keys=False)
     return 0
 
 

@@ -38,7 +38,12 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from fast_tffm_tpu.telemetry import arm_hang_exit, artifact_stamp, new_run_id
+from fast_tffm_tpu.telemetry import (
+    arm_hang_exit,
+    artifact_stamp,
+    new_run_id,
+    write_json_artifact,
+)
 
 _watchdog = arm_hang_exit(seconds=3000, what="probe_idstats.py")
 
@@ -172,8 +177,7 @@ def main(argv=None) -> int:
     }
     out = json.dumps(result, indent=1, sort_keys=True)
     print(out)
-    with open(args.out, "w") as fo:
-        fo.write(out + "\n")
+    write_json_artifact(args.out, result)
     print(f"probe -> {args.out}", file=sys.stderr)
     _watchdog.cancel()
     return 0 if not steady else 1

@@ -234,8 +234,9 @@ def main(argv=None) -> int:
         print("tpu stages ->", {k: v for k, v in res.items() if "rate" in k or "h2d" in k or "read" in k}, flush=True)
     input_scaling(res, args.rows)
     print("input scaling ->", res["input_scaling"], flush=True)
-    with open(args.out, "w") as f:
-        json.dump(res, f, indent=1)
+    from fast_tffm_tpu.telemetry import write_json_artifact
+
+    write_json_artifact(args.out, res, sort_keys=False)
     print("wrote", args.out)
     return 0
 

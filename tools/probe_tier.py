@@ -36,7 +36,12 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from fast_tffm_tpu.telemetry import arm_hang_exit, artifact_stamp, new_run_id
+from fast_tffm_tpu.telemetry import (
+    arm_hang_exit,
+    artifact_stamp,
+    new_run_id,
+    write_json_artifact,
+)
 
 _watchdog = arm_hang_exit(seconds=3000, what="probe_tier.py")
 
@@ -204,9 +209,7 @@ def main(argv=None) -> int:
             "would need on device (vs the ~11.5 GB single-chip wall)."
         ),
     }
-    with open(args.out, "w") as f:
-        json.dump(out, f, indent=1, sort_keys=True)
-        f.write("\n")
+    write_json_artifact(args.out, out)
     shutil.rmtree(work, ignore_errors=True)
     print(json.dumps(out, indent=1, sort_keys=True))
     print(f"wrote {args.out}")

@@ -247,8 +247,9 @@ def main(argv=None) -> int:
             v for k, v in par.items() if isinstance(v, bool)
         ),
     }
-    with open(args.out, "w") as f:
-        json.dump(res, f, indent=1)
+    from fast_tffm_tpu.telemetry import write_json_artifact
+
+    write_json_artifact(args.out, res, sort_keys=False)
     print("wrote", args.out)
     return 0
 

@@ -270,8 +270,9 @@ def main(argv=None) -> int:
             }
             print("deepfm ->", res["families"]["deepfm"], flush=True)
 
-    with open(args.out, "w") as f:
-        json.dump(res, f, indent=1)
+    from fast_tffm_tpu.telemetry import write_json_artifact
+
+    write_json_artifact(args.out, res, sort_keys=False)
     print("wrote", args.out)
     return 0
 

@@ -1133,20 +1133,49 @@ def load_analysis(path: str) -> dict:
     return data
 
 
-def render_analysis(a: dict) -> str:
-    """The "Analysis" section: findings by rule/severity + baseline debt.
-    Debt = findings the committed baseline excuses; the compare gate
-    fails --strict when it grows."""
+def render_analysis(a: dict, base_a: dict | None = None) -> str:
+    """The "Analysis" section: findings by rule/severity + baseline debt,
+    per rule.  Debt = findings the committed baseline excuses; the
+    compare gate fails --strict when it grows.  With ``base_a`` (the
+    --analysis-base payload) each rule row also shows its debt DELTA, so
+    "who re-pinned instead of fixing" is visible per checker, not just in
+    the total."""
     counts = a.get("counts", {})
     base = a.get("baseline", {})
     new = a.get("new", [])
+    debt_by_rule = (base.get("debt_by_rule") or {})
+    base_debt_by_rule = (
+        ((base_a.get("baseline") or {}).get("debt_by_rule") or {})
+        if base_a is not None
+        else None
+    )
     L = ["## Analysis (static invariant checkers)", ""]
-    L.append("| rule | findings |")
-    L.append("|---|---:|")
-    for rule, n in sorted((counts.get("by_rule") or {}).items()):
-        L.append(f"| {rule} | {n} |")
-    if not (counts.get("by_rule") or {}):
-        L.append("| – | 0 |")
+    if base_debt_by_rule is None:
+        L.append("| rule | findings | pinned debt |")
+        L.append("|---|---:|---:|")
+    else:
+        L.append("| rule | findings | pinned debt | Δ debt vs base |")
+        L.append("|---|---:|---:|---:|")
+    rules = sorted(set(counts.get("by_rule") or {}) | set(debt_by_rule)
+                   | set(base_debt_by_rule or {}))
+    for rule in rules:
+        n = (counts.get("by_rule") or {}).get(rule, 0)
+        d = debt_by_rule.get(rule, 0)
+        if base_debt_by_rule is None:
+            L.append(f"| {rule} | {n} | {d} |")
+        else:
+            delta = d - base_debt_by_rule.get(rule, 0)
+            L.append(f"| {rule} | {n} | {d} | {delta:+d} |")
+    if not rules:
+        L.append("| – | 0 | 0 |" if base_debt_by_rule is None else "| – | 0 | 0 | +0 |")
+    if a.get("lock_drift"):
+        L.append("")
+        L.append(
+            f"**LOCKFILE DRIFT: {a['lock_drift']} format-drift finding(s)** — "
+            "a persisted/wire registry diverged from formats.lock.json "
+            "(removal/reorder is never legal; additions need --write-lock "
+            "in the same diff)."
+        )
     sev = counts.get("by_severity") or {}
     L.append("")
     L.append(
@@ -1176,21 +1205,38 @@ def render_analysis(a: dict) -> str:
 
 
 def compare_analysis(run_a: dict, base_a: dict) -> list[str]:
-    """Strict-gate regressions: baseline-debt growth and new findings.
-    (run.py --strict already fails on new findings in CI; this gate
-    catches the debt creeping up between two otherwise-green runs —
-    i.e. someone re-baselining instead of fixing.)"""
+    """Strict-gate regressions: baseline-debt growth (total and per
+    rule), new findings, and PERSISTED-FORMAT LOCKFILE DRIFT.  (run.py
+    --strict already fails on new findings in CI; this gate catches the
+    debt creeping up between two otherwise-green runs — i.e. someone
+    re-baselining instead of fixing — and drift someone pinned into the
+    baseline to sneak past run.py.)"""
     regressions = []
     rd = (run_a.get("baseline") or {}).get("debt", 0) or 0
     bd = (base_a.get("baseline") or {}).get("debt", 0) or 0
     if rd > bd:
+        rbr = (run_a.get("baseline") or {}).get("debt_by_rule") or {}
+        bbr = (base_a.get("baseline") or {}).get("debt_by_rule") or {}
+        grew = [
+            f"{r} +{rbr.get(r, 0) - bbr.get(r, 0)}"
+            for r in sorted(set(rbr) | set(bbr))
+            if rbr.get(r, 0) > bbr.get(r, 0)
+        ]
         regressions.append(
             f"analysis baseline debt grew: {bd} -> {rd} pinned finding(s) "
-            "(fix findings instead of re-pinning them)"
+            f"({', '.join(grew) or 'total'}) — fix findings instead of "
+            "re-pinning them"
         )
     rn, bn = len(run_a.get("new") or ()), len(base_a.get("new") or ())
     if rn > bn:
         regressions.append(f"new analysis findings: {bn} -> {rn}")
+    drift = run_a.get("lock_drift", 0) or 0
+    if drift:
+        regressions.append(
+            f"persisted-format lockfile drift: {drift} format-drift "
+            "finding(s) — registries diverged from formats.lock.json "
+            "(append-only; removal/reorder is never legal)"
+        )
     return regressions
 
 
@@ -1320,14 +1366,20 @@ def main(argv=None) -> int:
             file=sys.stderr,
         )
         return 2
-    run_analysis = None
+    run_analysis = base_analysis = None
     if args.analysis:
         try:
             run_analysis = load_analysis(args.analysis)
         except (OSError, ValueError, json.JSONDecodeError) as e:
             print(f"report: {e}", file=sys.stderr)
             return 2
-        text = text + "\n" + render_analysis(run_analysis)
+        if args.analysis_base:
+            try:
+                base_analysis = load_analysis(args.analysis_base)
+            except (OSError, ValueError, json.JSONDecodeError) as e:
+                print(f"report: {e}", file=sys.stderr)
+                return 2
+        text = text + "\n" + render_analysis(run_analysis, base_analysis)
     if args.compare:
         try:
             base = summarize(_load_many(args.compare))
@@ -1345,11 +1397,6 @@ def main(argv=None) -> int:
                     file=sys.stderr,
                 )
             else:
-                try:
-                    base_analysis = load_analysis(args.analysis_base)
-                except (OSError, ValueError, json.JSONDecodeError) as e:
-                    print(f"report: {e}", file=sys.stderr)
-                    return 2
                 extra = compare_analysis(run_analysis, base_analysis)
                 if extra:
                     cmp_text += "**ANALYSIS REGRESSED:**\n" + "\n".join(
@@ -1360,8 +1407,12 @@ def main(argv=None) -> int:
         if regressions:
             rc = 1
     if args.out:
-        with open(args.out, "w") as f:
+        # tmp + os.replace, inline (this tool stays stdlib-only): a
+        # regenerated report must never be readable half-written.
+        tmp = f"{args.out}.{os.getpid():x}.tmp"
+        with open(tmp, "w") as f:
             f.write(text)
+        os.replace(tmp, args.out)
         print(f"report -> {args.out}", file=sys.stderr)
     else:
         print(text)

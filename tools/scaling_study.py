@@ -149,8 +149,9 @@ def main():
         ],
         "points": points,
     }
-    with open(args.out, "w") as f:
-        json.dump(artifact, f, indent=1)
+    from fast_tffm_tpu.telemetry import write_json_artifact
+
+    write_json_artifact(args.out, artifact, sort_keys=False)
     print(json.dumps({"written": args.out}))
 
 

@@ -307,7 +307,12 @@ def main(argv=None) -> int:
     from fast_tffm_tpu.checkpoint import read_delta_chain
     from fast_tffm_tpu.resilience import FaultPlan
     from fast_tffm_tpu.serving.client import ServeConnection, spawn_serve
-    from fast_tffm_tpu.telemetry import RunMonitor, artifact_stamp, new_run_id
+    from fast_tffm_tpu.telemetry import (
+        RunMonitor,
+        artifact_stamp,
+        new_run_id,
+        write_json_artifact,
+    )
 
     plan = FaultPlan.parse(fault_plan)
     stream_faults = plan.stream_events()
@@ -345,8 +350,8 @@ def main(argv=None) -> int:
         ticks.append(rec)
         try:
             monitor.emit("soak", step=len(ticks), **rec)
-        except Exception:
-            pass
+        except (OSError, ValueError):
+            pass  # lost soak record; the tick verdict is in `ticks` either way
         log(
             f"[{rec['elapsed_s']:7.1f}s] {phase}: "
             + ("OK" if ok else "FAIL " + str([k for k, v in checks.items() if not v]))
@@ -576,8 +581,8 @@ def main(argv=None) -> int:
         final_stats = {}
         try:
             final_stats = control.request({"op": "stats"}, timeout=30)
-        except Exception:
-            pass
+        except Exception as e:
+            log(f"final stats poll failed (fleet already torn down?): {e!r}")
 
         # Trainer-side telemetry digest (restarts, stalls, ckpt counters,
         # steady compiles) from its JSONL.
@@ -674,8 +679,7 @@ def main(argv=None) -> int:
             "gate": "OK" if ok and failures == 0 else "REGRESSED",
             "ticks": ticks[-50:],
         }
-        with open(out_path, "w") as f:
-            json.dump(result, f, indent=1)
+        write_json_artifact(out_path, result, sort_keys=False)
         log(f"wrote {out_path} (gate: {result['gate']})")
         return 0 if result["gate"] == "OK" else 1
     finally:
