@@ -129,8 +129,23 @@ class ServeConnection:
         return self.inflight()
 
     def close(self) -> None:
+        import socket as _socket
+
+        # shutdown() before close(): the makefile in _f holds an io ref,
+        # so close() alone defers the real fd close and the reader's
+        # readline never sees EOF — shutdown delivers it immediately.
+        try:
+            self.sock.shutdown(_socket.SHUT_RDWR)
+        except OSError:
+            pass  # peer already hung up
         try:
             self.sock.close()
+        except OSError:
+            pass
+        if self._reader is not threading.current_thread():
+            self._reader.join(timeout=2.0)
+        try:
+            self._f.close()
         except OSError:
             pass
 

@@ -70,6 +70,7 @@ class _Conn:
         data = encode(obj)
         try:
             with self._wlock:
+                # analysis: ok blocking-under-lock the peer is the ROUTER, which reads eagerly on a dedicated reader thread; if it wedges, its own health layer SIGKILLs this replica (wedge conjunction) or closes the socket, which unblocks sendall with OSError — a settimeout here would also bound the reader loop sharing this socket
                 self._sock.sendall(data)
         except OSError:
             pass  # router gone; its reconnect (or our exit) handles it

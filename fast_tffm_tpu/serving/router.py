@@ -263,6 +263,10 @@ class Router:
         )
         self._closed = False
         self._stop = threading.Event()
+        # Maintenance threads (assigned after bring-up; close() may run
+        # on a bring-up failure before either exists).
+        self._health_thread: threading.Thread | None = None
+        self._watch_thread: threading.Thread | None = None
         self._next_id = itertools.count(1)
         self._rr = itertools.count()
         # Cross-replica counters (the router's own story for report.py).
@@ -319,7 +323,9 @@ class Router:
                 errs.append(e)
 
         threads = [
-            threading.Thread(target=up, args=(s,), name=f"router-up-{s.index}")
+            threading.Thread(
+                target=up, args=(s,), name=f"router-up-{s.index}", daemon=True
+            )
             for s in self.slots
         ]
         for t in threads:
@@ -944,6 +950,14 @@ class Router:
             if h.alive():
                 h.kill()
                 h.wait(timeout=2.0)
+        # Bound the maintenance threads' lifetime: _stop is set, so both
+        # exit at their next wait() tick — a bounded join keeps close()
+        # from returning while they still touch slots/monitor state.
+        me = threading.current_thread()
+        if self._health_thread is not None and self._health_thread is not me:
+            self._health_thread.join(timeout=2.0)
+        if self._watch_thread is not None and self._watch_thread is not me:
+            self._watch_thread.join(timeout=2.0)
         try:
             fresh = self.freshness_percentiles()
             with self._stats_lock:

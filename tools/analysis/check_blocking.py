@@ -1,0 +1,446 @@
+"""blocking-under-lock: unbounded waits while holding a lock.
+
+The flow bug class that actually cost this repo review cycles: PR 8's
+router v1 conflated data and control connections so health pings queued
+behind a blocked socket; PR 6's prefetch consumer wedged forever in
+``q.get()``; the readiness waiter parked in ``readline`` on a silent
+child.  Each is the same shape — a call that can block UNBOUNDEDLY
+executed while a lock is held, turning one slow/dead peer into a
+whole-object deadlock (every other thread then queues on the lock).
+
+Flow-sensitive on the PR-15 CFG core: the set of locks held at each
+statement is the lexical ``with``-stack of its CFG node plus a forward
+MUST-dataflow over explicit ``.acquire()``/``.release()`` pairs (a lock
+counts as held only when it is held on EVERY path reaching the
+statement — branches that may or may not have acquired stay quiet).
+Lock identities come from the PR-13 catalogs: ``self.X =
+threading.Lock()`` class attributes, annotation-typed cross-object
+locks (``slot: _Slot`` → ``slot.lock``), and module-level locks.
+
+What counts as blocking (the timeout allowlist — a bounded wait is not
+a finding):
+
+  * ``queue.get()`` / ``.join()`` / ``.wait()`` / ``.result()`` with no
+    timeout (argument or keyword) — Queue, Thread, Event, Condition,
+    Popen, Future all spell their bounded forms the same way;
+  * ``subprocess.run/check_call/check_output/communicate`` without
+    ``timeout=``;
+  * socket ops — ``recv``/``recv_into``/``accept``/``send``/``sendall``,
+    and ``readline``/``read`` on a socket-backed file or subprocess
+    pipe — unless the module establishes a deadline for that endpoint
+    (``settimeout(...)`` with a non-None value, or
+    ``create_connection(..., timeout=...)``); the evidence is tracked by
+    endpoint name through makefile()/attribute hand-offs.
+
+``os.replace`` and plain file I/O are deliberately NOT in the set (they
+block on disk, not on a peer), and a with-lock body that only snapshots
+counters — the sanctioned leaf-lock pattern — has nothing to flag.
+
+One-hop interprocedural composition (PR-14 call graph): a call made
+while a lock is held into a same-module function whose own body blocks
+unboundedly is flagged at the call site — the PR-7-era "the lock is in
+the caller, the wait is in the callee" split must not hide the pair.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from analysis.core import (
+    Finding,
+    RepoContext,
+    attr_chain,
+    build_cfg,
+    call_name,
+    forward_must,
+    function_defs,
+    jax_aliases,
+    module_call_graph,
+    resolves_to,
+)
+from analysis.check_locks import _lock_attrs_of
+
+RULE = "blocking-under-lock"
+
+_SOCKET_ONLY_TAILS = {"recv", "recv_into", "accept", "sendall", "send"}
+_STREAM_TAILS = {"readline", "readlines", "read"}
+_PIPE_SEGMENTS = {"stdout", "stderr", "stdin", "rfile"}
+_SUBPROCESS_FNS = (
+    "subprocess.run",
+    "subprocess.check_call",
+    "subprocess.check_output",
+    "subprocess.call",
+)
+
+
+def _leaf(expr) -> str | None:
+    chain = attr_chain(expr)
+    if chain is None:
+        return None
+    return chain.split(".")[-1]
+
+
+def _has_timeout_kw(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "timeout" and not (
+            isinstance(kw.value, ast.Constant) and kw.value.value is None
+        ):
+            return True
+    return False
+
+
+class _SocketFacts:
+    """Per-module endpoint tracking: which names denote socket/pipe-like
+    endpoints, and which of those have deadline evidence.  Keyed by LEAF
+    name (``slot.sock`` and the local ``sock`` meet at ``sock``) — the
+    coarse join is deliberate: one settimeout on an endpoint name is
+    read as that endpoint's policy module-wide."""
+
+    def __init__(self, tree: ast.AST, aliases):
+        self.socketish: set[str] = set()
+        self.bounded: set[str] = set()
+        makefile_edges: list[tuple[str, str]] = []
+        alias_edges: list[tuple[str, str]] = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                name = call_name(node)
+                if name and name.split(".")[-1] == "settimeout":
+                    args = node.args
+                    if args and not (
+                        isinstance(args[0], ast.Constant) and args[0].value is None
+                    ):
+                        base = _leaf(node.func.value) if isinstance(
+                            node.func, ast.Attribute
+                        ) else None
+                        if base:
+                            self.bounded.add(base)
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            tgt = node.targets[0]
+            targets = (
+                [tgt]
+                if not isinstance(tgt, ast.Tuple)
+                else list(tgt.elts)
+            )
+            leaves = [t for t in (_leaf(x) for x in targets) if t]
+            if not leaves:
+                continue
+            value = node.value
+            if isinstance(value, ast.Call):
+                name = call_name(value) or ""
+                tail = name.split(".")[-1]
+                if resolves_to(name, "socket.create_connection", aliases) or tail == "create_connection":
+                    self.socketish.update(leaves)
+                    if _has_timeout_kw(value) or (
+                        len(value.args) > 1
+                        and not (
+                            isinstance(value.args[1], ast.Constant)
+                            and value.args[1].value is None
+                        )
+                    ):
+                        self.bounded.update(leaves)
+                elif resolves_to(name, "socket.socket", aliases) or tail in (
+                    "create_server",
+                ):
+                    self.socketish.update(leaves)
+                elif tail == "accept":
+                    # conn, addr = sock.accept() — first target is a socket
+                    self.socketish.add(leaves[0])
+                elif tail == "makefile" and isinstance(value.func, ast.Attribute):
+                    src = _leaf(value.func.value)
+                    if src:
+                        self.socketish.update(leaves)
+                        for lf in leaves:
+                            makefile_edges.append((src, lf))
+            elif isinstance(value, (ast.Name, ast.Attribute)):
+                src = _leaf(value)
+                if src:
+                    for lf in leaves:
+                        alias_edges.append((src, lf))
+            elif isinstance(value, ast.IfExp):
+                for branch in (value.body, value.orelse):
+                    src = _leaf(branch)
+                    if src:
+                        for lf in leaves:
+                            alias_edges.append((src, lf))
+        # One propagation round each: facts flow through makefile() and
+        # plain-alias assignments (x = slot.sock).
+        for _ in range(2):
+            for src, dst in makefile_edges + alias_edges:
+                if src in self.socketish:
+                    self.socketish.add(dst)
+                if src in self.bounded:
+                    self.bounded.add(dst)
+
+    def is_socketish(self, leaf: str | None) -> bool:
+        return leaf is not None and leaf in self.socketish
+
+    def is_bounded(self, leaf: str | None) -> bool:
+        return leaf is not None and leaf in self.bounded
+
+
+def classify_blocking(call: ast.Call, aliases, sockets: _SocketFacts) -> str | None:
+    """A human-readable description of why this call can block forever,
+    or None when it is bounded/not in the blocking vocabulary."""
+    name = call_name(call)
+    if name is None:
+        return None
+    tail = name.split(".")[-1]
+    receiver_leaf = None
+    if isinstance(call.func, ast.Attribute):
+        receiver_leaf = _leaf(call.func.value)
+    if any(resolves_to(name, fn, aliases) for fn in _SUBPROCESS_FNS):
+        return None if _has_timeout_kw(call) else f"{tail}() without timeout"
+    if tail == "communicate":
+        return None if _has_timeout_kw(call) else "communicate() without timeout"
+    if tail == "get":
+        if _has_timeout_kw(call):
+            return None
+        # block=False is non-blocking; block=True is exactly bare get()
+        if any(
+            kw.arg == "block"
+            and isinstance(kw.value, ast.Constant)
+            and kw.value.value is False
+            for kw in call.keywords
+        ):
+            return None
+        # get(True, t): positional timeout bounds it unless it is None
+        if call.args[1:]:
+            second = call.args[1]
+            if not (isinstance(second, ast.Constant) and second.value is None):
+                return None
+        if call.args and not (
+            isinstance(call.args[0], ast.Constant) and call.args[0].value is True
+        ):
+            return None  # dict.get(key) and friends
+        if not call.args and any(
+            kw.arg not in ("block", "timeout") for kw in call.keywords
+        ):
+            return None  # some other get(...) API, not queue.get
+        return "queue.get() without timeout"
+    if tail in ("join", "wait", "result"):
+        if call.args or _has_timeout_kw(call):
+            return None
+        return f"{tail}() without timeout"
+    if tail in _SOCKET_ONLY_TAILS:
+        if sockets.is_bounded(receiver_leaf):
+            return None
+        return f"socket {tail}() with no deadline"
+    if tail in _STREAM_TAILS:
+        chain = name.split(".")
+        piped = len(chain) >= 2 and chain[-2] in _PIPE_SEGMENTS
+        if not (piped or sockets.is_socketish(receiver_leaf)):
+            return None  # plain-file read: blocks on disk, not a peer
+        if sockets.is_bounded(receiver_leaf):
+            return None
+        return f"{tail}() on a socket/pipe with no deadline"
+    return None
+
+
+class _ModuleLocks:
+    """Lock identity resolution for one module: class catalogs (PR 13),
+    annotation-typed parameters, module-level locks."""
+
+    def __init__(self, tree: ast.AST, aliases):
+        self.aliases = aliases
+        self.classes = {
+            n.name: n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)
+        }
+        self.class_locks = {
+            name: _lock_attrs_of(node, aliases)
+            for name, node in self.classes.items()
+        }
+        self.module_locks: set[str] = set()
+        for node in tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+            ):
+                cname = call_name(node.value)
+                if cname and any(
+                    resolves_to(cname, t, aliases)
+                    for t in (
+                        "threading.Lock",
+                        "threading.RLock",
+                        "threading.Condition",
+                    )
+                ):
+                    self.module_locks.add(node.targets[0].id)
+
+    def param_types(self, fn) -> dict[str, str]:
+        out = {}
+        for a in fn.args.args + fn.args.posonlyargs + fn.args.kwonlyargs:
+            ann = a.annotation
+            if isinstance(ann, ast.Name) and ann.id in self.classes:
+                out[a.arg] = ann.id
+            elif (
+                isinstance(ann, ast.Constant)
+                and isinstance(ann.value, str)
+                and ann.value in self.classes
+            ):
+                out[a.arg] = ann.value
+        return out
+
+    def lock_id(self, expr, owner_cls: str | None, param_types) -> str | None:
+        if isinstance(expr, ast.Name) and expr.id in self.module_locks:
+            return f"<module>.{expr.id}"
+        chain = attr_chain(expr)
+        if chain is None:
+            return None
+        parts = chain.split(".")
+        if len(parts) != 2:
+            return None
+        base, attr = parts
+        if base == "self" and owner_cls is not None:
+            if attr in self.class_locks.get(owner_cls, ()):
+                return f"{owner_cls}.{attr}"
+            return None
+        cls = param_types.get(base)
+        if cls is not None and attr in self.class_locks.get(cls, ()):
+            return f"{cls}.{attr}"
+        return None
+
+
+def _own_scope_calls(fn):
+    """Call nodes in ``fn``'s own scope (nested defs excluded — they run
+    on their own thread/time, with their own lock state)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class BlockingChecker:
+    name = "blocking"
+    rules = (RULE,)
+    description = "unbounded blocking calls while a lock is held"
+
+    def run(self, ctx: RepoContext) -> list[Finding]:
+        findings: list[Finding] = []
+        for sf in ctx.files:
+            tree = sf.tree
+            if tree is None:
+                continue
+            aliases = jax_aliases(tree)
+            locks = _ModuleLocks(tree, aliases)
+            sockets = _SocketFacts(tree, aliases)
+            graph = module_call_graph(tree)
+            # Pass 1: which local defs block unboundedly in their own scope
+            # (lock state aside) — the one-hop composition's callee side.
+            blockers: dict[str, str] = {}
+            for qual, fn in graph.defs.items():
+                for call in _own_scope_calls(fn):
+                    why = classify_blocking(call, aliases, sockets)
+                    if why is not None:
+                        blockers.setdefault(qual, why)
+            # Pass 2: per function, locks held at each statement.
+            for qual, fn in function_defs(tree).items():
+                owner_cls = qual.split(".")[0] if "." in qual else None
+                findings.extend(
+                    self._check_fn(
+                        sf, fn, qual, owner_cls, locks, sockets, aliases,
+                        graph, blockers,
+                    )
+                )
+        return findings
+
+    def _check_fn(self, sf, fn, qual, owner_cls, locks, sockets, aliases,
+                  graph, blockers) -> list[Finding]:
+        param_types = locks.param_types(fn)
+        cfg = build_cfg(fn)
+
+        def lock_of(expr):
+            return locks.lock_id(expr, owner_cls, param_types)
+
+        def gen_kill(node):
+            gen, kill = [], []
+            for expr in node.own_exprs():
+                for call in ast.walk(expr):
+                    if not isinstance(call, ast.Call) or not isinstance(
+                        call.func, ast.Attribute
+                    ):
+                        continue
+                    if call.func.attr == "acquire":
+                        lid = lock_of(call.func.value)
+                        if lid is not None:
+                            gen.append(lid)
+                    elif call.func.attr == "release":
+                        lid = lock_of(call.func.value)
+                        if lid is not None:
+                            kill.append(lid)
+            return gen, kill
+
+        flow = forward_must(cfg, gen_kill)
+        out: list[Finding] = []
+        seen_lines: set[tuple[int, str]] = set()
+        for node in cfg.nodes:
+            with_held = [
+                lid
+                for lid in (lock_of(e) for e in node.with_items)
+                if lid is not None
+            ]
+            held = sorted(set(with_held) | flow.get(node, frozenset()))
+            if not held:
+                continue
+            # own_exprs: compound headers contribute only their header
+            # expressions (bodies have their own nodes), and nested defs
+            # are opaque — their bodies run without our locks.  Calls
+            # under a lambda run later, elsewhere — exclude them.
+            exprs = node.own_exprs()
+            deferred = {
+                id(sub)
+                for expr in exprs
+                for lam in ast.walk(expr)
+                if isinstance(lam, ast.Lambda)
+                for sub in ast.walk(lam.body)
+            }
+            for call in (
+                sub
+                for expr in exprs
+                for sub in ast.walk(expr)
+            ):
+                if not isinstance(call, ast.Call) or id(call) in deferred:
+                    continue
+                why = classify_blocking(call, aliases, sockets)
+                callee = None
+                if why is None:
+                    # one hop: a local function that itself blocks
+                    spelling = call_name(call)
+                    if spelling is not None:
+                        target = graph.resolve(qual, spelling)
+                        if target is not None and target in blockers:
+                            callee = target
+                            why = f"{target}() -> {blockers[target]}"
+                if why is None:
+                    continue
+                key = (call.lineno, why)
+                if key in seen_lines:
+                    continue
+                seen_lines.add(key)
+                locks_s = ", ".join(held)
+                out.append(
+                    Finding(
+                        rule=RULE,
+                        path=sf.rel,
+                        line=call.lineno,
+                        message=(
+                            f"{why} while holding {locks_s} — one slow/dead "
+                            "peer wedges every thread that needs the lock "
+                            "(the PR-8 router/readline class)"
+                        ),
+                        context=f"{qual}:{why.split('(')[0].split(' ')[-1]}:{held[0]}"
+                        if callee is None
+                        else f"{qual}:call:{callee}:{held[0]}",
+                        fix_hint=(
+                            "add a timeout/deadline, or move the wait outside "
+                            "the lock (snapshot under the lock, block outside)"
+                        ),
+                    )
+                )
+        return out

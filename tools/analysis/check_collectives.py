@@ -1,0 +1,365 @@
+"""collective-divergence: SPMD uniformity of collective dispatch.
+
+PR 7's pod rule, until now enforced only in prose: every host must
+dispatch every collective/barrier in the same order, or the pod hangs
+until jax's ~100 s coordination timeout (and the generation machinery
+treats the survivor as wedged).  The checker machine-checks it in the
+pod-executed modules: any call to a collective — ``barrier``,
+``agree``, ``broadcast``, ``allgather``, ``share_cursor``,
+``wait_at_barrier``, eager ``psum``/``all_gather``/``all_to_all`` — that
+is CONTROL-DEPENDENT on host-varying data is an error.
+
+Host-varying taint sources: ``process_index`` (attribute or call),
+``is_lead``, ``process_identity()``, ``read_heartbeat(...)`` (per-host
+liveness), plus anything assigned from them — locals within a function,
+``self.X`` attributes across a class (``self._is_writer = ...is_lead``
+taints every later ``if not self._is_writer:``).  Control dependence
+covers the branch bodies AND the code after a host-divergent early
+return (only some hosts reach it).
+
+The sanctioned single-writer idiom (DESIGN.md invariant 6) is exactly
+the pair this checker does NOT flag: ``publish_signature`` (lead-only
+KV set) / ``await_signature`` (peer-only KV get) are asymmetric BY
+PROTOCOL, and host-divergent *I/O* (only the lead opens the score file,
+writes the sidecar, logs) is fine — divergent *dispatch* is the
+deadlock.  Classes that DEFINE the collective API (a ``barrier`` or
+``agree`` method) are implementation, not dispatch, and are skipped.
+
+Second rule in the same pass: write-once KV key reuse.  The pod KV
+store's keys are write-once (jax's coordination service refuses a
+second set); DistributedRuntime self-namespaces with per-tag counters,
+so a CONSTANT key string passed to a raw ``kv.set(...)`` from two or
+more call sites is a latent second-write failure — flagged at every
+site past the first.
+
+One-hop interprocedural composition: a local function whose own body
+dispatches a collective makes its call sites collective too, so
+``if is_lead: self._sync_peers()`` is caught even though the barrier
+lives one call away.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from analysis.core import (
+    Finding,
+    RepoContext,
+    attr_chain,
+    call_name,
+    module_call_graph,
+)
+
+RULE = "collective-divergence"
+
+# Modules that execute on every pod host in lock step.
+POD_MODULE_PREFIXES = (
+    "fast_tffm_tpu/distributed.py",
+    "fast_tffm_tpu/parallel/",
+    "fast_tffm_tpu/training.py",
+    "fast_tffm_tpu/checkpoint_async.py",
+    "fast_tffm_tpu/checkpoint.py",
+    "fast_tffm_tpu/prediction.py",
+)
+
+COLLECTIVE_TAILS = {
+    "barrier",
+    "agree",
+    "broadcast",
+    "allgather",
+    "share_cursor",
+    "wait_at_barrier",
+    "sync_global_devices",
+    "psum",
+    "all_gather",
+    "all_to_all",
+    "pmean",
+}
+
+# The sanctioned single-writer publish pair: asymmetric by protocol.
+SANCTIONED_TAILS = {"publish_signature", "await_signature"}
+
+_TAINT_TAILS = {"process_index", "is_lead"}
+_TAINT_CALLS = {"process_index", "process_identity", "read_heartbeat"}
+
+
+def _defines_collective_api(cls: ast.ClassDef) -> bool:
+    method_names = {
+        n.name
+        for n in cls.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    return bool(method_names & {"barrier", "agree", "broadcast", "allgather"})
+
+
+def _uniform_by_construction(value) -> bool:
+    """A value produced BY a collective is host-uniform even when its
+    arguments varied per host — ``broadcast(lead_value)`` / ``agree(x)``
+    exist precisely to manufacture agreement.  Assignments from them must
+    not taint the target."""
+    return (
+        isinstance(value, ast.Call)
+        and (call_name(value) or "").split(".")[-1]
+        in (COLLECTIVE_TAILS | SANCTIONED_TAILS)
+    )
+
+
+def _tainted_attrs(tree: ast.AST) -> dict[str, set[str]]:
+    """Per class: self-attributes assigned (anywhere) from a host-varying
+    expression."""
+    out: dict[str, set[str]] = {}
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        attrs: set[str] = set()
+        # Two passes so attr-from-attr chains settle (rare, cheap).
+        for _ in range(2):
+            for node in ast.walk(cls):
+                if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                    continue
+                name = attr_chain(node.targets[0])
+                if not (name and name.startswith("self.") and name.count(".") == 1):
+                    continue
+                if _uniform_by_construction(node.value):
+                    continue
+                if _taint_reason(node.value, set(), attrs) is not None:
+                    attrs.add(name.split(".", 1)[1])
+        out[cls.name] = attrs
+    return out
+
+
+def _taint_reason(expr, tainted_locals: set[str], tainted_attrs: set[str]):
+    """Why ``expr`` is host-varying, or None."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name):
+            if node.id in _TAINT_TAILS or node.id in tainted_locals:
+                return node.id
+        elif isinstance(node, ast.Attribute):
+            chain = attr_chain(node)
+            if chain is None:
+                continue
+            tail = chain.split(".")[-1]
+            if tail in _TAINT_TAILS:
+                return chain
+            if chain.startswith("self.") and chain.split(".")[1] in tainted_attrs:
+                return chain
+        elif isinstance(node, ast.Call):
+            cname = call_name(node)
+            if cname and cname.split(".")[-1] in _TAINT_CALLS:
+                return f"{cname}()"
+    return None
+
+
+def _always_exits(body: list[ast.stmt]) -> bool:
+    return bool(body) and isinstance(body[-1], (ast.Return, ast.Raise))
+
+
+class CollectivesChecker:
+    name = "collectives"
+    rules = (RULE,)
+    description = "collective dispatch must be host-uniform; KV keys write-once"
+
+    def __init__(self, module_prefixes=POD_MODULE_PREFIXES):
+        self._prefixes = tuple(module_prefixes)
+
+    def run(self, ctx: RepoContext) -> list[Finding]:
+        findings: list[Finding] = []
+        for sf in ctx.files:
+            if not sf.rel.startswith(self._prefixes):
+                continue
+            tree = sf.tree
+            if tree is None:
+                continue
+            findings.extend(self._check_module(sf, tree))
+        return findings
+
+    # -- divergence ---------------------------------------------------------
+
+    def _check_module(self, sf, tree) -> list[Finding]:
+        findings: list[Finding] = []
+        graph = module_call_graph(tree)
+        attr_taint = _tainted_attrs(tree)
+        api_classes = {
+            n.name
+            for n in ast.walk(tree)
+            if isinstance(n, ast.ClassDef) and _defines_collective_api(n)
+        }
+        # One-hop callee side: local defs whose own scope dispatches a
+        # collective (nested defs excluded by the call graph's own-scope
+        # walk).
+        collective_defs: dict[str, str] = {}
+        for qual, calls in graph.calls.items():
+            if qual.split(".")[0] in api_classes:
+                continue
+            for spelling, _call in calls:
+                tail = spelling.split(".")[-1]
+                if tail in COLLECTIVE_TAILS:
+                    collective_defs.setdefault(qual, tail)
+        for qual, fn in graph.defs.items():
+            owner = qual.split(".")[0] if "." in qual else None
+            if owner in api_classes:
+                continue
+            findings.extend(
+                self._check_fn(
+                    sf, fn, qual,
+                    attr_taint.get(owner, set()),
+                    graph, collective_defs,
+                )
+            )
+        findings.extend(self._kv_reuse(sf, tree, api_classes))
+        return findings
+
+    def _check_fn(self, sf, fn, qual, tainted_attrs, graph, collective_defs):
+        findings: list[Finding] = []
+        tainted_locals: set[str] = set()
+        # Locals assigned from host-varying expressions (two passes so
+        # later-defined helpers assigned before use still settle).
+        for _ in range(2):
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    tgt = node.targets[0]
+                    if (
+                        isinstance(tgt, ast.Name)
+                        and not _uniform_by_construction(node.value)
+                        and _taint_reason(
+                            node.value, tainted_locals, tainted_attrs
+                        )
+                    ):
+                        tainted_locals.add(tgt.id)
+
+        def reason_of(test):
+            return _taint_reason(test, tainted_locals, tainted_attrs)
+
+        def flag(call, reason, where):
+            spelling = call_name(call) or "?"
+            tail = spelling.split(".")[-1]
+            findings.append(
+                Finding(
+                    rule=RULE,
+                    path=sf.rel,
+                    line=call.lineno,
+                    message=(
+                        f"collective {tail}() dispatched under host-varying "
+                        f"control ({where} on {reason}) — hosts that skip it "
+                        "desync the pod and every peer hangs in the "
+                        "collective until the ~100s coordination timeout"
+                    ),
+                    context=f"{qual}:{tail}:{reason}",
+                    fix_hint=(
+                        "dispatch the collective on EVERY host (hoist it out "
+                        "of the branch); keep only the I/O divergent — or, "
+                        "for a true single-writer publish, use the "
+                        "publish_signature/await_signature pair"
+                    ),
+                )
+            )
+
+        def collective_calls(stmt):
+            for node in ast.walk(stmt):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                spelling = call_name(node)
+                if spelling is None:
+                    continue
+                tail = spelling.split(".")[-1]
+                if tail in SANCTIONED_TAILS:
+                    continue
+                if tail in COLLECTIVE_TAILS:
+                    yield node, tail
+                    continue
+                target = graph.resolve(qual, spelling)
+                if target is not None and target in collective_defs:
+                    yield node, f"{target} -> {collective_defs[target]}"
+
+        def walk(body, divergent):
+            post_div = None  # set once a host-divergent early exit is seen
+            for stmt in body:
+                reason = divergent or post_div
+                if isinstance(stmt, (ast.If, ast.While)):
+                    r = reason_of(stmt.test)
+                    inner = reason or r
+                    # the header expression itself runs on every host
+                    for call, _tail in collective_calls(stmt.test):
+                        if reason:
+                            flag(call, reason, "branch")
+                    walk(stmt.body, inner)
+                    walk(stmt.orelse, inner)
+                    if (
+                        isinstance(stmt, ast.If)
+                        and r
+                        and not reason
+                        and _always_exits(stmt.body)
+                        and not stmt.orelse
+                    ):
+                        post_div = r  # only some hosts execute what follows
+                    continue
+                if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    r = reason_of(stmt.iter)
+                    walk(stmt.body, reason or r)
+                    walk(stmt.orelse, reason or r)
+                    continue
+                if isinstance(stmt, ast.Try):
+                    walk(stmt.body, reason)
+                    for h in stmt.handlers:
+                        walk(h.body, reason)
+                    walk(stmt.orelse, reason)
+                    walk(stmt.finalbody, reason)
+                    continue
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    walk(stmt.body, reason)
+                    continue
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                    continue
+                if reason:
+                    for call, _tail in collective_calls(stmt):
+                        flag(call, reason, "branch")
+
+        walk(fn.body, None)
+        return findings
+
+    # -- write-once KV keys -------------------------------------------------
+
+    def _kv_reuse(self, sf, tree, api_classes) -> list[Finding]:
+        sites: dict[str, list[int]] = {}
+        parents_cls: dict[int, str] = {}
+        for cls in ast.walk(tree):
+            if isinstance(cls, ast.ClassDef):
+                for sub in ast.walk(cls):
+                    parents_cls[id(sub)] = cls.name
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not isinstance(
+                node.func, ast.Attribute
+            ):
+                continue
+            if node.func.attr != "set" or not node.args:
+                continue
+            recv = attr_chain(node.func.value) or ""
+            if "kv" not in recv.split(".")[-1].lower():
+                continue
+            if parents_cls.get(id(node)) in api_classes:
+                continue  # the KV implementation / namespacing layer
+            key = node.args[0]
+            if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                sites.setdefault(key.value, []).append(node.lineno)
+        findings = []
+        for key, lines in sorted(sites.items()):
+            for line in sorted(lines)[1:]:
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=sf.rel,
+                        line=line,
+                        message=(
+                            f"KV key {key!r} is set from {len(lines)} call "
+                            "sites — pod KV keys are write-once (the second "
+                            "set fails or is ignored); namespace per site "
+                            "like DistributedRuntime._key does"
+                        ),
+                        context=f"kv-reuse:{key}",
+                        severity="warning",
+                        fix_hint="derive the key from a per-site tag + counter",
+                    )
+                )
+        return findings

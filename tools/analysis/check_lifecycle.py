@@ -1,0 +1,606 @@
+"""resource-lifecycle: threads, child processes, sockets/files that can
+escape their owner without cleanup.
+
+The chaos/soak harnesses and the serving fleet manage dozens of OS
+resources by hand; the bugs that slipped review were all of one shape —
+the cleanup exists on the happy path and is skipped on the exceptional
+one (a SIGINT mid-join leaves non-daemon loadgen threads wedging
+interpreter exit; a TimeoutExpired out of a cleanup ``wait(timeout=)``
+leaves the child alive AND breaks the rest of the finally).  Four
+sub-rules, all built on the PR-15 CFG's may-escape-without-cleanup
+query plus lexical finally/handler classification:
+
+  * **thread-never-joined** — a Thread/Timer (or a local
+    ``threading.Thread`` subclass) stored on ``self`` with NO
+    ``.join`` on that attribute anywhere in the class: no shutdown path
+    can bound it.  Locally-created NON-daemon threads that are never
+    joined in their scope are worse (they block interpreter exit) and
+    read as errors.  Fire-and-forget ``daemon=True`` locals are the
+    sanctioned detached idiom and stay quiet.
+  * **thread-join-not-exception-safe** — non-daemon threads whose joins
+    all sit on the normal path (none in a ``finally``/handler): an
+    exception — including KeyboardInterrupt, the SIGINT path — between
+    ``start()`` and ``join()`` abandons them and the process cannot
+    exit.  Fix: ``daemon=True`` (abandonable by declaration) or join in
+    a ``finally``.
+  * **popen-cleanup** — a ``subprocess.Popen`` that does not escape its
+    scope must reach a ``wait``/``kill``/``terminate``/``communicate``
+    on every path out (CFG query), and must have one reachable on the
+    EXCEPTION path (a cleanup inside ``finally``/``except``, or the
+    Popen used as a context manager) — else the child outlives the
+    harness.  Inside a cleanup block, ``X.wait(timeout=...)`` on a
+    process that was ``terminate()``d needs a TimeoutExpired guard with
+    a ``kill`` fallback: a child that ignores SIGTERM otherwise
+    survives AND the raise aborts the rest of the finally.
+  * **open-no-cleanup** — sockets/files opened outside ``with`` whose
+    ``close`` is missing or normal-path-only while later statements can
+    raise.
+
+Escape analysis: a resource that is returned, stored into an attribute
+or container, or passed to another call has transferred ownership —
+the holder is responsible, not this scope.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from analysis.core import (
+    Finding,
+    RepoContext,
+    attr_chain,
+    build_cfg,
+    call_name,
+    jax_aliases,
+    reaches_without,
+    resolves_to,
+)
+
+RULE = "resource-lifecycle"
+
+_THREAD_CTORS = ("threading.Thread", "threading.Timer")
+_POPEN_CTORS = ("subprocess.Popen",)
+_SOCKET_CTORS = ("socket.socket", "socket.create_connection", "socket.create_server")
+_POPEN_CLEANUP = {"wait", "kill", "terminate", "communicate"}
+
+
+def _leaf(expr) -> str | None:
+    chain = attr_chain(expr)
+    return chain.split(".")[-1] if chain else None
+
+
+def _ctor_call(value):
+    """The constructor Call under an Assign value: direct, or the elt of
+    a list/set comprehension / list literal (a pool of N resources)."""
+    if isinstance(value, ast.Call):
+        return value
+    if isinstance(value, (ast.ListComp, ast.SetComp)) and isinstance(
+        value.elt, ast.Call
+    ):
+        return value.elt
+    if isinstance(value, (ast.List, ast.Set)) and value.elts and isinstance(
+        value.elts[0], ast.Call
+    ):
+        return value.elts[0]
+    return None
+
+
+class _ModuleShapes:
+    """Module-level facts: local Thread subclasses (and whether they
+    default to daemon), import aliases."""
+
+    def __init__(self, tree: ast.AST):
+        self.aliases = jax_aliases(tree)
+        self.thread_subclasses: dict[str, bool] = {}  # name -> daemon default
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            bases = [attr_chain(b) or "" for b in node.bases]
+            if not any(
+                resolves_to(b, "threading.Thread", self.aliases) or b.endswith("Thread")
+                for b in bases
+                if b
+            ):
+                continue
+            daemon = False
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign):
+                    name = attr_chain(sub.targets[0]) if len(sub.targets) == 1 else None
+                    if (
+                        name == "self.daemon"
+                        and isinstance(sub.value, ast.Constant)
+                        and sub.value.value is True
+                    ):
+                        daemon = True
+                elif isinstance(sub, ast.Call) and isinstance(
+                    sub.func, ast.Attribute
+                ):
+                    # super().__init__(daemon=True) — the base of the
+                    # attribute is a Call, so attr_chain can't spell it
+                    if sub.func.attr == "__init__":
+                        for kw in sub.keywords:
+                            if (
+                                kw.arg == "daemon"
+                                and isinstance(kw.value, ast.Constant)
+                                and kw.value.value is True
+                            ):
+                                daemon = True
+            self.thread_subclasses[node.name] = daemon
+
+    def classify_ctor(self, call: ast.Call):
+        """('thread', daemon) | ('popen', None) | ('socket', None) | None"""
+        name = call_name(call)
+        if name is None:
+            return None
+        if any(resolves_to(name, t, self.aliases) for t in _THREAD_CTORS):
+            return ("thread", self._daemon_kw(call))
+        if name in self.thread_subclasses:
+            return ("thread", self.thread_subclasses[name] or self._daemon_kw(call))
+        if any(resolves_to(name, t, self.aliases) for t in _POPEN_CTORS):
+            return ("popen", None)
+        if any(resolves_to(name, t, self.aliases) for t in _SOCKET_CTORS):
+            return ("socket", None)
+        tail = name.split(".")[-1]
+        if tail == "open" and name in ("open", "io.open"):
+            return ("file", None)
+        return None
+
+    @staticmethod
+    def _daemon_kw(call: ast.Call) -> bool:
+        for kw in call.keywords:
+            if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+                return bool(kw.value.value)
+        return False
+
+
+def _cleanup_regions(fn) -> dict[int, int]:
+    """AST-node id → cleanup-region ordinal, for every node lexically
+    inside a finally body or an except handler — the exception-path
+    cleanup surface.  The ordinal distinguishes one try's finally from
+    another's (a kill fallback in a LATER finally does not cover an
+    earlier cleanup's unguarded wait)."""
+    out: dict[int, int] = {}
+    region = 0
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Try):
+            regions = [node.finalbody] + [h.body for h in node.handlers]
+            for body in regions:
+                if not body:
+                    continue
+                region += 1
+                for stmt in body:
+                    for sub in ast.walk(stmt):
+                        out.setdefault(id(sub), region)
+    return out
+
+
+def _own_scope(fn):
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _contains_owned(expr, leaf: str) -> bool:
+    """Is the object named ``leaf`` handed over AS A VALUE by this
+    expression — the bare name, or the name embedded in a container
+    literal / constructor call?  (Mere mentions — an f-string logging
+    ``proc.pid`` — are not ownership transfer.)"""
+    if isinstance(expr, ast.Name):
+        return expr.id == leaf
+    if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+        return any(_contains_owned(e, leaf) for e in expr.elts)
+    if isinstance(expr, ast.Dict):
+        return any(
+            _contains_owned(v, leaf)
+            for v in list(expr.values) + [k for k in expr.keys if k is not None]
+        )
+    if isinstance(expr, ast.Call):
+        return any(
+            _contains_owned(a, leaf)
+            for a in list(expr.args) + [kw.value for kw in expr.keywords]
+        )
+    if isinstance(expr, ast.Starred):
+        return _contains_owned(expr.value, leaf)
+    return False
+
+
+def _escapes(fn, leaf: str, acquisition: ast.Assign) -> bool:
+    """Ownership transfer: the name is returned, yielded, stored into an
+    attribute/subscript/container literal or another binding, or passed
+    as a call argument — the holder is responsible, not this scope."""
+    for node in _own_scope(fn):
+        if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+            v = getattr(node, "value", None)
+            if v is not None and _contains_owned(v, leaf):
+                return True
+        elif isinstance(node, ast.Call):
+            if _contains_owned(node, leaf):
+                return True
+        elif isinstance(node, ast.Assign) and node is not acquisition:
+            if _contains_owned(node.value, leaf):
+                return True  # aliased / embedded in another value
+    return False
+
+
+class LifecycleChecker:
+    name = "lifecycle"
+    rules = (RULE,)
+    description = "threads/processes/handles cleaned up on every exit path"
+
+    def run(self, ctx: RepoContext) -> list[Finding]:
+        findings: list[Finding] = []
+        for sf in ctx.files:
+            tree = sf.tree
+            if tree is None:
+                continue
+            shapes = _ModuleShapes(tree)
+            for node in ast.walk(tree):
+                if isinstance(node, ast.ClassDef):
+                    findings.extend(self._check_class(sf, node, shapes))
+            for node in ast.walk(tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    findings.extend(self._check_fn(sf, node, shapes))
+        return findings
+
+    # -- class scope: self-attr resources -----------------------------------
+
+    def _check_class(self, sf, cls, shapes) -> list[Finding]:
+        findings = []
+        created: dict[str, tuple[str, int]] = {}  # attr -> (kind, line)
+        cleaned: set[tuple[str, str]] = set()  # (attr, tail)
+        # local aliases of self-attrs, per method: `t = self._thread` then
+        # `t.join()` is the attr's join (checkpoint_async's swap idiom)
+        alias: dict[str, str] = {}
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+                if isinstance(tgt, ast.Name):
+                    src = attr_chain(node.value)
+                    if src and src.startswith("self.") and src.count(".") == 1:
+                        alias[tgt.id] = src.split(".", 1)[1]
+                name = attr_chain(tgt)
+                if not (name and name.startswith("self.") and name.count(".") == 1):
+                    continue
+                call = _ctor_call(node.value)
+                if call is None:
+                    continue
+                kind = shapes.classify_ctor(call)
+                if kind is None:
+                    continue
+                if kind[0] in ("thread", "popen"):
+                    created.setdefault(name.split(".", 1)[1], (kind[0], node.lineno))
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+                chain = attr_chain(node.func.value)
+                if chain and chain.startswith("self.") and chain.count(".") == 1:
+                    cleaned.add((chain.split(".", 1)[1], node.func.attr))
+                elif isinstance(node.func.value, ast.Name):
+                    attr = alias.get(node.func.value.id)
+                    if attr is not None:
+                        cleaned.add((attr, node.func.attr))
+        for attr, (kind, line) in sorted(created.items()):
+            if kind == "thread" and not any(
+                t == "join" for a, t in cleaned if a == attr
+            ):
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=sf.rel,
+                        line=line,
+                        message=(
+                            f"{cls.name}.{attr} thread is started but no "
+                            "method of the class ever joins it — no shutdown "
+                            "path can bound its lifetime"
+                        ),
+                        context=f"{cls.name}.{attr}:unjoined-thread",
+                        severity="warning",
+                        fix_hint="join it (with a timeout) in close()/finalize()",
+                    )
+                )
+            elif kind == "popen" and not any(
+                t in _POPEN_CLEANUP for a, t in cleaned if a == attr
+            ):
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=sf.rel,
+                        line=line,
+                        message=(
+                            f"{cls.name}.{attr} child process is never "
+                            "waited/killed by any method — it outlives (or "
+                            "zombifies under) the owner"
+                        ),
+                        context=f"{cls.name}.{attr}:unreaped-popen",
+                        severity="warning",
+                        fix_hint="terminate + bounded wait + kill fallback on close",
+                    )
+                )
+        return findings
+
+    # -- function scope: locals ---------------------------------------------
+
+    def _check_fn(self, sf, fn, shapes) -> list[Finding]:
+        findings = []
+        cleanup_ids = _cleanup_regions(fn)
+        acquisitions = []  # (stmt, leaf, kind, daemon)
+        per_leaf_calls: dict[str, list[ast.Call]] = {}
+        daemon_attr: set[str] = set()  # X.daemon = True after construction
+        loop_alias: dict[str, str] = {}  # loop var -> iterated collection
+        for node in _own_scope(fn):
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                it = _leaf(node.iter)
+                if isinstance(node.target, ast.Name) and it is not None:
+                    loop_alias[node.target.id] = it
+            elif isinstance(
+                node, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)
+            ):
+                for g in node.generators:
+                    it = _leaf(g.iter)
+                    if isinstance(g.target, ast.Name) and it is not None:
+                        loop_alias[g.target.id] = it
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+                name = attr_chain(tgt)
+                if (
+                    name
+                    and name.endswith(".daemon")
+                    and isinstance(node.value, ast.Constant)
+                    and node.value.value is True
+                ):
+                    daemon_attr.add(name.split(".")[-2])
+                if not isinstance(tgt, ast.Name):
+                    continue
+                call = _ctor_call(node.value)
+                if call is None:
+                    continue
+                kind = shapes.classify_ctor(call)
+                if kind is not None:
+                    acquisitions.append((node, tgt.id, kind[0], kind[1]))
+            elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+                leaf = _leaf(node.func.value)
+                if leaf is not None:
+                    per_leaf_calls.setdefault(leaf, []).append(node)
+        # `for p in procs: p.wait()` — cleanup on the loop variable counts
+        # for the collection it iterates.
+        for var, coll in loop_alias.items():
+            for c in per_leaf_calls.get(var, ()):
+                per_leaf_calls.setdefault(coll, []).append(c)
+        cfg = None
+        for stmt, leaf, kind, daemon in acquisitions:
+            if kind == "thread":
+                # Joins are credited PER LEAF (loop/comprehension vars
+                # alias their collection above): a join of pool `a` must
+                # not excuse pool `b` in the same function.  Any receiver
+                # we already know is a thread takes positional timeouts
+                # too (`t.join(5.0)` — the str.join ambiguity is gone).
+                leaf_joins = [
+                    c
+                    for c in per_leaf_calls.get(leaf, ())
+                    if c.func.attr == "join"
+                ]
+                findings.extend(
+                    self._local_thread(
+                        sf, fn, stmt, leaf, daemon or leaf in daemon_attr,
+                        leaf_joins, cleanup_ids,
+                    )
+                )
+            elif kind == "popen":
+                if _escapes(fn, leaf, stmt):
+                    continue
+                if cfg is None:
+                    cfg = build_cfg(fn)
+                findings.extend(
+                    self._local_popen(
+                        sf, fn, cfg, stmt, leaf, per_leaf_calls, cleanup_ids
+                    )
+                )
+            elif kind in ("socket", "file"):
+                if _escapes(fn, leaf, stmt):
+                    continue
+                findings.extend(
+                    self._local_handle(
+                        sf, fn, stmt, leaf, kind, per_leaf_calls, cleanup_ids
+                    )
+                )
+        # Cleanup-block bounded waits without a TimeoutExpired guard.
+        findings.extend(self._cleanup_waits(sf, fn, cleanup_ids, per_leaf_calls))
+        return findings
+
+    def _local_thread(self, sf, fn, stmt, leaf, daemon, joins, cleanup_ids):
+        if not joins:
+            if daemon:
+                return []  # declared detached: the sanctioned idiom
+            return [
+                Finding(
+                    rule=RULE,
+                    path=sf.rel,
+                    line=stmt.lineno,
+                    message=(
+                        f"non-daemon thread(s) {leaf!r} started in "
+                        f"{fn.name}() and never joined — they block "
+                        "interpreter exit forever if they outlive the caller"
+                    ),
+                    context=f"{fn.name}:{leaf}:unjoined-thread",
+                    fix_hint="join them, or pass daemon=True if abandonable",
+                )
+            ]
+        if daemon:
+            return []
+        if any(id(j) in cleanup_ids for j in joins):
+            return []
+        return [
+            Finding(
+                rule=RULE,
+                path=sf.rel,
+                line=stmt.lineno,
+                message=(
+                    f"non-daemon thread(s) {leaf!r} in {fn.name}() are only "
+                    "joined on the normal path — an exception (incl. "
+                    "KeyboardInterrupt: the SIGINT path) between start() "
+                    "and join() abandons them and the process cannot exit"
+                ),
+                context=f"{fn.name}:{leaf}:join-not-exception-safe",
+                severity="warning",
+                fix_hint=(
+                    "daemon=True (abandonable by declaration) or join in a "
+                    "finally"
+                ),
+            )
+        ]
+
+    def _local_popen(self, sf, fn, cfg, stmt, leaf, per_leaf_calls, cleanup_ids):
+        cleanups = [
+            c
+            for c in per_leaf_calls.get(leaf, ())
+            if c.func.attr in _POPEN_CLEANUP
+        ]
+        if not cleanups:
+            return [
+                Finding(
+                    rule=RULE,
+                    path=sf.rel,
+                    line=stmt.lineno,
+                    message=(
+                        f"Popen {leaf!r} in {fn.name}() has no reachable "
+                        "wait/kill/terminate — the child outlives the harness "
+                        "on every path"
+                    ),
+                    context=f"{fn.name}:{leaf}:unreaped-popen",
+                    fix_hint="wait for it; kill in a finally on the error path",
+                )
+            ]
+        cleanup_lines = {c.lineno for c in cleanups}
+
+        def is_cleanup(node):
+            return node.stmt is not None and any(
+                isinstance(c, ast.Call)
+                and c.lineno in cleanup_lines
+                for c in ast.walk(node.stmt)
+            )
+
+        acq_node = cfg.by_stmt.get(stmt)
+        leaky_normal = acq_node is not None and reaches_without(
+            cfg, acq_node, is_cleanup
+        )
+        exception_safe = any(id(c) in cleanup_ids for c in cleanups)
+        if exception_safe and not leaky_normal:
+            return []
+        if exception_safe:
+            what = "a normal path leaves without wait/kill"
+        elif leaky_normal:
+            what = "no cleanup on the exception path (and a normal path leaks too)"
+        else:
+            what = (
+                "no cleanup on the exception path — an exception between "
+                "spawn and wait leaves the child running"
+            )
+        return [
+            Finding(
+                rule=RULE,
+                path=sf.rel,
+                line=stmt.lineno,
+                message=f"Popen {leaf!r} in {fn.name}(): {what}",
+                context=f"{fn.name}:{leaf}:popen-exception-path",
+                severity="warning",
+                fix_hint=(
+                    "spawn inside try, terminate + bounded wait + kill "
+                    "fallback in the finally"
+                ),
+            )
+        ]
+
+    def _local_handle(self, sf, fn, stmt, leaf, kind, per_leaf_calls, cleanup_ids):
+        closes = [
+            c for c in per_leaf_calls.get(leaf, ()) if c.func.attr == "close"
+        ]
+        if closes and any(id(c) in cleanup_ids for c in closes):
+            return []
+        # A handle whose whole life is the next statement or two is below
+        # the noise floor only when it IS closed; unclosed is always worth
+        # a finding.
+        if not closes:
+            return [
+                Finding(
+                    rule=RULE,
+                    path=sf.rel,
+                    line=stmt.lineno,
+                    message=(
+                        f"{kind} {leaf!r} opened in {fn.name}() outside "
+                        "with/finally and never closed in this scope"
+                    ),
+                    context=f"{fn.name}:{leaf}:unclosed-{kind}",
+                    severity="warning",
+                    fix_hint="use a with block, or close in a finally",
+                )
+            ]
+        return [
+            Finding(
+                rule=RULE,
+                path=sf.rel,
+                line=stmt.lineno,
+                message=(
+                    f"{kind} {leaf!r} opened in {fn.name}() outside with/"
+                    "finally — an exception before close() leaks it"
+                ),
+                context=f"{fn.name}:{leaf}:close-not-exception-safe",
+                severity="warning",
+                fix_hint="use a with block, or move close() into a finally",
+            )
+        ]
+
+    def _cleanup_waits(self, sf, fn, cleanup_ids, per_leaf_calls):
+        """X.wait(timeout=...) inside a finally/handler on a terminated
+        process, with no TimeoutExpired guard around it."""
+        findings = []
+        terminated = {
+            leaf
+            for leaf, calls in per_leaf_calls.items()
+            if any(c.func.attr in ("terminate", "kill") for c in calls)
+        }
+        guarded: set[int] = set()
+        for node in _own_scope(fn):
+            if isinstance(node, ast.Try) and node.handlers:
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call):
+                        guarded.add(id(sub))
+        for leaf, calls in per_leaf_calls.items():
+            if leaf not in terminated:
+                continue
+            kill_regions = {
+                cleanup_ids[id(c)]
+                for c in calls
+                if c.func.attr == "kill" and id(c) in cleanup_ids
+            }
+            for c in calls:
+                if c.func.attr != "wait" or id(c) not in cleanup_ids:
+                    continue
+                if cleanup_ids[id(c)] in kill_regions:
+                    continue  # a kill fallback exists in THIS cleanup
+                if not (c.args or any(kw.arg == "timeout" for kw in c.keywords)):
+                    continue  # unbounded cleanup wait: bounded by design intent
+                if id(c) in guarded:
+                    continue
+                findings.append(
+                    Finding(
+                        rule=RULE,
+                        path=sf.rel,
+                        line=c.lineno,
+                        message=(
+                            f"cleanup does {leaf}.wait(timeout=...) after "
+                            "terminate with no TimeoutExpired guard — a child "
+                            "that ignores SIGTERM survives AND the raise "
+                            "aborts the rest of the cleanup"
+                        ),
+                        context=f"{fn.name}:{leaf}:cleanup-wait-unguarded",
+                        severity="warning",
+                        fix_hint=(
+                            "except subprocess.TimeoutExpired: proc.kill() "
+                            "(then wait again)"
+                        ),
+                    )
+                )
+        return findings

@@ -41,6 +41,9 @@ RULES = (
     "format-drift",
     "atomic-publish",
     "exception-hygiene",
+    "blocking-under-lock",
+    "collective-divergence",
+    "resource-lifecycle",
     "suppression",
     "parse",
 )
@@ -85,17 +88,45 @@ _SUPPRESS_RE = re.compile(
 )
 
 
+# Process-wide parse cache keyed by (abspath, mtime_ns, size): the
+# 11-rule suite builds several RepoContexts per process (the full run,
+# a --changed-only pass, every test helper), and parsing + tokenizing
+# ~100 files dominates its runtime.  Trees are read-only to every
+# checker, so sharing them across contexts is safe; a touched file gets
+# a new (mtime, size) key and re-parses.
+_PARSE_CACHE: dict[tuple[str, int, int], dict] = {}
+
+
+def _cache_key(abspath: str) -> tuple[str, int, int] | None:
+    try:
+        st = os.stat(abspath)
+    except OSError:
+        return None
+    return (abspath, st.st_mtime_ns, st.st_size)
+
+
 class SourceFile:
     """One parsed file: text, lines, AST (lazy), suppression map."""
 
     def __init__(self, abspath: str, rel: str):
         self.abspath = abspath
         self.rel = rel
+        key = _cache_key(abspath)
+        cached = _PARSE_CACHE.get(key) if key is not None else None
+        if cached is not None:
+            self.text = cached["text"]
+            self.lines = cached["lines"]
+            self._tree = cached["tree"]
+            self._parse_error = cached["error"]
+            self._parsed = cached["parsed"]
+            self.suppressions = cached["suppressions"]
+            return
         with open(abspath, encoding="utf-8") as f:
             self.text = f.read()
         self.lines = self.text.splitlines()
         self._tree: ast.AST | None = None
         self._parse_error: SyntaxError | None = None
+        self._parsed = False
         # line -> list[(rule, reason)]; reason may be "" (an error).
         # Tokenized, not line-regexed: the marker inside a STRING literal
         # ("# analysis: ok recompile-hazard ...") must not mute anything.
@@ -115,14 +146,27 @@ class SourceFile:
                 self.suppressions.setdefault(tok.start[0], []).append(
                     (m.group(1), m.group(2).strip())
                 )
+        if key is not None:
+            self._cache_entry = key  # filled into _PARSE_CACHE post-parse
 
     @property
     def tree(self) -> ast.AST | None:
-        if self._tree is None and self._parse_error is None:
+        if not self._parsed:
+            self._parsed = True
             try:
                 self._tree = ast.parse(self.text, filename=self.rel)
             except SyntaxError as e:
                 self._parse_error = e
+            key = getattr(self, "_cache_entry", None)
+            if key is not None:
+                _PARSE_CACHE[key] = {
+                    "text": self.text,
+                    "lines": self.lines,
+                    "tree": self._tree,
+                    "error": self._parse_error,
+                    "parsed": True,
+                    "suppressions": self.suppressions,
+                }
         return self._tree
 
     @property
@@ -482,6 +526,282 @@ def module_call_graph(tree: ast.AST) -> CallGraph:
                 if name is not None:
                     calls[qual].append((name, node))
     return CallGraph(defs, calls)
+
+
+# -- intraprocedural CFG + forward dataflow (PR 15) --------------------------
+#
+# The flow-sensitive core the concurrency checkers ride: basic blocks
+# over if/for/while/try/with, one node per statement occurrence, plus a
+# generic forward "facts held here" fixpoint.  Deliberately small:
+# no expression-level flow, no interprocedural edges (module_call_graph
+# above supplies the one-hop composition), exception edges approximated
+# as "any statement inside a try can jump to its handlers".  That is
+# exactly enough to answer the questions the checkers ask — which locks
+# are held AT this statement, can this function leave without reaching
+# a cleanup — without modelling Python it doesn't need.
+
+
+class CFGNode:
+    """One statement occurrence.  ``with_items`` is the lexical stack of
+    ``with`` context expressions entered around this statement (innermost
+    last) — with-scoped facts (lock held) are precise lexically, so they
+    ride the node instead of the dataflow.  ``kind`` ∈ stmt | entry |
+    exit."""
+
+    __slots__ = ("stmt", "kind", "succ", "pred", "with_items", "index")
+
+    def __init__(self, stmt=None, kind="stmt", with_items=()):
+        self.stmt = stmt
+        self.kind = kind
+        self.succ: list[CFGNode] = []
+        self.pred: list[CFGNode] = []
+        self.with_items = tuple(with_items)
+        self.index = -1
+
+    def link(self, other: "CFGNode") -> None:
+        if other not in self.succ:
+            self.succ.append(other)
+            other.pred.append(self)
+
+    def own_exprs(self) -> tuple:
+        """The AST subtrees that execute AT this node.  A compound
+        statement's node is its HEADER (test/iter/subject/context
+        expressions) — the body statements have their own nodes, so
+        transfer functions and call scans must not walk the subtree
+        twice."""
+        s = self.stmt
+        if s is None:
+            return ()
+        if isinstance(s, (ast.If, ast.While)):
+            return (s.test,)
+        if isinstance(s, (ast.For, ast.AsyncFor)):
+            return (s.iter,)
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            return tuple(item.context_expr for item in s.items)
+        if isinstance(s, ast.Match):
+            return (s.subject,)
+        if isinstance(
+            s,
+            (ast.Try, ast.ExceptHandler, ast.FunctionDef,
+             ast.AsyncFunctionDef, ast.ClassDef),
+        ):
+            return ()
+        return (s,)
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        what = self.kind if self.kind != "stmt" else type(self.stmt).__name__
+        return f"<CFGNode {self.index} {what}>"
+
+
+class CFG:
+    """entry → statement nodes → exit.  ``nodes`` excludes entry/exit;
+    ``by_stmt`` maps a statement AST node to its CFGNode (headers of
+    compound statements get the node; their bodies get their own)."""
+
+    def __init__(self):
+        self.entry = CFGNode(kind="entry")
+        self.exit = CFGNode(kind="exit")
+        self.nodes: list[CFGNode] = []
+        self.by_stmt: dict[ast.AST, CFGNode] = {}
+
+    def _new(self, stmt, with_items) -> CFGNode:
+        node = CFGNode(stmt, with_items=with_items)
+        node.index = len(self.nodes)
+        self.nodes.append(node)
+        self.by_stmt[stmt] = node
+        return node
+
+
+class _CFGBuilder:
+    """Recursive-descent CFG construction.  The frontier is the set of
+    nodes whose control continues at the NEXT statement; terminators
+    (return/raise/break/continue) empty it."""
+
+    def __init__(self):
+        self.cfg = CFG()
+        self._breaks: list[list[CFGNode]] = []
+        self._loop_heads: list[CFGNode] = []
+        self._handlers: list[list[CFGNode]] = []  # enclosing try handler heads
+        self._with: list[ast.expr] = []
+        # Returns (and unhandled raises) inside a try-with-finally run the
+        # finalbody on the way out: they park here and become extra preds
+        # of the finally instead of edges straight to exit.
+        self._final_pending: list[list[CFGNode]] = []
+
+    def build(self, fn) -> CFG:
+        frontier = self._seq(fn.body, [self.cfg.entry])
+        for node in frontier:
+            node.link(self.cfg.exit)
+        return self.cfg
+
+    def _seq(self, body, preds) -> list[CFGNode]:
+        # An empty frontier (code after a terminator, a finally whose try
+        # always exits) still gets nodes — predecessor-less, so dataflow
+        # treats them as unreached — because by_stmt must cover every
+        # statement the lexical checks ask about.
+        frontier = list(preds)
+        for stmt in body:
+            frontier = self._stmt(stmt, frontier)
+        return frontier
+
+    def _node(self, stmt, preds) -> CFGNode:
+        node = self.cfg._new(stmt, tuple(self._with))
+        for p in preds:
+            p.link(node)
+        # Conservative exception edge: any statement inside a try may
+        # transfer to its (innermost) handlers — or, in a finally-only
+        # try, straight into the finalbody (the exception runs it on the
+        # way out, so the finally must meet every body statement's OUT,
+        # including pre-acquire ones).
+        if self._handlers:
+            for h in self._handlers[-1]:
+                node.link(h)
+        elif self._final_pending:
+            self._final_pending[-1].append(node)
+        return node
+
+    def _stmt(self, stmt, preds) -> list[CFGNode]:
+        if isinstance(stmt, ast.If):
+            test = self._node(stmt, preds)
+            then_f = self._seq(stmt.body, [test])
+            else_f = self._seq(stmt.orelse, [test]) if stmt.orelse else [test]
+            return then_f + else_f
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            head = self._node(stmt, preds)
+            self._breaks.append([])
+            self._loop_heads.append(head)
+            body_f = self._seq(stmt.body, [head])
+            for node in body_f:
+                node.link(head)  # back edge
+            self._loop_heads.pop()
+            breaks = self._breaks.pop()
+            else_f = self._seq(stmt.orelse, [head]) if stmt.orelse else [head]
+            return else_f + breaks
+        if isinstance(stmt, ast.Try):
+            handler_heads = [
+                self.cfg._new(h, tuple(self._with)) for h in stmt.handlers
+            ]
+            if stmt.finalbody:
+                self._final_pending.append([])
+            # Only a try WITH handlers claims the exception edges — an
+            # empty list on the stack would swallow raises in a
+            # finally-only try instead of routing them to the finalbody.
+            if handler_heads:
+                self._handlers.append(handler_heads)
+            body_f = self._seq(stmt.body, preds)
+            if handler_heads:
+                self._handlers.pop()
+            for p in preds:  # an exception can fire before any body stmt ran
+                for h in handler_heads:
+                    p.link(h)
+            out = []
+            for head, h in zip(handler_heads, stmt.handlers):
+                out += self._seq(h.body, [head])
+            out += self._seq(stmt.orelse, body_f) if stmt.orelse else body_f
+            if stmt.finalbody:
+                pending = self._final_pending.pop()
+                # Return/raise paths meet the normal fall-through at the
+                # finally's entry (conservative: after the finally they
+                # continue with the frontier rather than forking back to
+                # exit — extra predecessors only shrink must-facts).
+                out = self._seq(stmt.finalbody, out + pending)
+            return out
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            head = self._node(stmt, preds)
+            self._with.extend(item.context_expr for item in stmt.items)
+            body_f = self._seq(stmt.body, [head])
+            del self._with[len(self._with) - len(stmt.items):]
+            return body_f
+        if isinstance(stmt, ast.Match):
+            subject = self._node(stmt, preds)
+            out = [subject]  # no case may match
+            for case in stmt.cases:
+                out += self._seq(case.body, [subject])
+            return out
+        # simple statements (incl. nested def/class, one opaque node each)
+        node = self._node(stmt, preds)
+        if isinstance(stmt, ast.Return):
+            if self._final_pending:
+                self._final_pending[-1].append(node)
+            else:
+                node.link(self.cfg.exit)
+            return []
+        if isinstance(stmt, ast.Raise):
+            if self._handlers:
+                for h in self._handlers[-1]:
+                    node.link(h)
+            elif self._final_pending:
+                self._final_pending[-1].append(node)
+            else:
+                node.link(self.cfg.exit)
+            return []
+        if isinstance(stmt, ast.Break):
+            if self._breaks:
+                self._breaks[-1].append(node)
+            return []
+        if isinstance(stmt, ast.Continue):
+            if self._loop_heads:
+                node.link(self._loop_heads[-1])
+            return []
+        return [node]
+
+
+def build_cfg(fn) -> CFG:
+    """CFG for one FunctionDef/AsyncFunctionDef (nested defs are opaque
+    single nodes — they execute later, on their own CFG)."""
+    return _CFGBuilder().build(fn)
+
+
+def forward_must(cfg: CFG, gen_kill) -> dict[CFGNode, frozenset]:
+    """Forward MUST dataflow to fixpoint: fact sets meet by intersection
+    at joins (a fact holds at a statement only if it holds on EVERY path
+    reaching it — the right polarity for "lock held here", where a maybe
+    is not an is).  ``gen_kill(node) -> (gen, kill)``.  Returns the IN
+    fact per node (facts established BEFORE the statement runs); TOP
+    (unvisited) is represented internally as None.  Convergence is
+    guaranteed: facts only leave a set at a kill, and intersection is
+    monotone on the finite fact universe."""
+    IN: dict[CFGNode, frozenset | None] = {cfg.entry: frozenset()}
+    OUT: dict[CFGNode, frozenset | None] = {cfg.entry: frozenset()}
+    work = list(cfg.entry.succ)
+    while work:
+        node = work.pop()
+        acc = None
+        for p in node.pred:
+            po = OUT.get(p)
+            if po is None:
+                continue  # TOP: identity for intersection
+            acc = po if acc is None else (acc & po)
+        if acc is None:
+            continue  # no computed predecessor yet
+        gen, kill = gen_kill(node)
+        out = (acc - frozenset(kill)) | frozenset(gen)
+        if IN.get(node) != acc or OUT.get(node) != out:
+            IN[node] = acc
+            OUT[node] = out
+            work.extend(node.succ)
+    return {n: (IN.get(n) if IN.get(n) is not None else frozenset())
+            for n in cfg.nodes}
+
+
+def reaches_without(cfg: CFG, start: CFGNode, stop_pred) -> bool:
+    """May-escape query: is ``cfg.exit`` reachable from ``start`` without
+    passing through a node satisfying ``stop_pred``?  The lifecycle
+    checker's core question — can control leave the function while the
+    resource acquired at ``start`` has seen no cleanup."""
+    seen = set()
+    work = list(start.succ)
+    while work:
+        node = work.pop()
+        if node is cfg.exit:
+            return True
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        if node.kind == "stmt" and stop_pred(node):
+            continue
+        work.extend(node.succ)
+    return False
 
 
 # -- output ----------------------------------------------------------------

@@ -11,6 +11,10 @@
                                                     #   report.py Analysis
                                                     #   section's input)
     python tools/analysis/run.py --rules locks,config
+    python tools/analysis/run.py --changed-only     # seconds-fast iteration
+                                                    #   loop: only files
+                                                    #   changed vs merge-base
+                                                    #   + their importers
     python tools/analysis/run.py --write-baseline   # pin the current findings
                                                     #   (justifications still
                                                     #   owed: --strict refuses
@@ -53,10 +57,13 @@ if _TOOLS not in sys.path:
 
 from analysis import core  # noqa: E402
 from analysis import check_formats  # noqa: E402
+from analysis.check_blocking import BlockingChecker  # noqa: E402
+from analysis.check_collectives import CollectivesChecker  # noqa: E402
 from analysis.check_config import ConfigChecker  # noqa: E402
 from analysis.check_donation import DonationChecker  # noqa: E402
 from analysis.check_exceptions import ExceptionChecker  # noqa: E402
 from analysis.check_formats import FormatsChecker  # noqa: E402
+from analysis.check_lifecycle import LifecycleChecker  # noqa: E402
 from analysis.check_locks import LockChecker  # noqa: E402
 from analysis.check_publish import PublishChecker  # noqa: E402
 from analysis.check_recompile import RecompileChecker  # noqa: E402
@@ -73,7 +80,21 @@ CHECKERS = {
     "formats": FormatsChecker,
     "publish": PublishChecker,
     "exceptions": ExceptionChecker,
+    "blocking": BlockingChecker,
+    "collectives": CollectivesChecker,
+    "lifecycle": LifecycleChecker,
 }
+
+# Checkers that only make sense against the WHOLE tree (config's dead-key
+# rule reads every get(); formats diffs every registry against the lock):
+# a --changed-only subset run skips them unless one of their anchor files
+# changed, in which case the full scan is the honest answer anyway.
+WHOLE_REPO_RULES = {"config", "formats"}
+_WHOLE_REPO_ANCHORS = (
+    "fast_tffm_tpu/config.py",
+    "sample.cfg",
+    "DESIGN.md",
+)
 
 
 def _rule_prefixes(rules) -> tuple[str, ...]:
@@ -100,6 +121,103 @@ def run_suite(root: str, rules=None, ctx: core.RepoContext | None = None,
     core.disambiguate(findings)
     findings.sort(key=lambda f: (f.rule, f.path, f.line))
     return findings, ctx
+
+
+def _git_changed_rels(root: str):
+    """Repo-relative paths changed vs ``git merge-base HEAD main`` plus
+    staged/unstaged/untracked work — the iteration loop's diff surface.
+    None (with a reason on stderr) when git cannot answer; the caller
+    falls back to the full scan."""
+    import subprocess
+
+    def lines(*cmd):
+        r = subprocess.run(
+            ["git", *cmd], cwd=root, capture_output=True, text=True, timeout=30
+        )
+        if r.returncode != 0:
+            raise RuntimeError(r.stderr.strip() or f"git {' '.join(cmd)} failed")
+        return [ln.strip() for ln in r.stdout.splitlines() if ln.strip()]
+
+    try:
+        # diff paths come back TOPLEVEL-relative; when --root is a
+        # subdirectory of the work tree they must be rebased onto root
+        # (or they never intersect discover()'s rels and the loop goes
+        # silently green).
+        top = os.path.abspath(lines("rev-parse", "--show-toplevel")[0])
+        prefix = os.path.relpath(os.path.abspath(root), top)
+        base = lines("merge-base", "HEAD", "main")[0]
+        out = set(lines("diff", "--name-only", base, "HEAD"))
+        out |= set(lines("diff", "--name-only"))
+        out |= set(lines("diff", "--name-only", "--cached"))
+        # run ls-files from the toplevel so its paths share the diff
+        # paths' base and the single rebase below covers everything
+        out |= {
+            p.strip()
+            for p in subprocess.run(
+                ["git", "ls-files", "--others", "--exclude-standard"],
+                cwd=top, capture_output=True, text=True, timeout=30,
+            ).stdout.splitlines()
+            if p.strip()
+        }
+        if prefix not in (".", ""):
+            rebased = set()
+            for p in out:
+                rel = os.path.relpath(p, prefix)
+                if not rel.startswith(".."):
+                    rebased.add(rel.replace(os.sep, "/"))
+            out = rebased
+        return sorted(out)
+    except (RuntimeError, OSError, subprocess.SubprocessError, IndexError) as e:
+        print(f"analysis: --changed-only: git unavailable ({e}) — "
+              "falling back to the full scan", file=sys.stderr)
+        return None
+
+
+def _module_rel_candidates(dotted: str):
+    base = dotted.replace(".", "/")
+    return (f"{base}.py", f"{base}/__init__.py", f"tools/{base}.py")
+
+
+def _changed_closure(root: str, changed: list[str]) -> list[str]:
+    """Changed analyzable files plus every module that (transitively)
+    imports one of them — the set whose findings a diff can move.  Uses
+    the shared parse cache, so this costs one pass over the tree."""
+    all_rels = [r.replace(os.sep, "/") for r in core.discover(root)]
+    all_set = set(all_rels)
+    ctx = core.RepoContext(root, all_rels)
+    imports: dict[str, set[str]] = {}
+    import ast as _ast
+
+    for sf in ctx.files:
+        tree = sf.tree
+        if tree is None:
+            continue
+        deps: set[str] = set()
+        for node in _ast.walk(tree):
+            if isinstance(node, _ast.Import):
+                names = [a.name for a in node.names]
+            elif isinstance(node, _ast.ImportFrom) and node.module:
+                names = [node.module] + [
+                    f"{node.module}.{a.name}" for a in node.names
+                ]
+            else:
+                continue
+            for dotted in names:
+                for cand in _module_rel_candidates(dotted):
+                    if cand in all_set:
+                        deps.add(cand)
+        imports[sf.rel] = deps
+    changed_set = {c for c in changed if c in all_set}
+    # reverse-dependency fixpoint: if any dep changed, the importer is in
+    selected = set(changed_set)
+    grew = True
+    while grew:
+        grew = False
+        for rel, deps in imports.items():
+            if rel not in selected and deps & selected:
+                selected.add(rel)
+                grew = True
+    return sorted(selected)
 
 
 def _write_lock(root: str, lock_path: str, sections_arg: str | None) -> int:
@@ -176,7 +294,8 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="analysis",
         description="AST invariant checkers: donation, recompile, locks, "
-        "config, telemetry, formats, publish, exceptions.",
+        "config, telemetry, formats, publish, exceptions, blocking, "
+        "collectives, lifecycle.",
     )
     ap.add_argument(
         "--root",
@@ -223,6 +342,15 @@ def main(argv=None) -> int:
         "verbatim",
     )
     ap.add_argument(
+        "--changed-only",
+        action="store_true",
+        help="analyze only files changed vs `git merge-base HEAD main` "
+        "(staged/unstaged/untracked included) plus every module importing "
+        "them — the seconds-fast pre-commit loop.  Whole-repo rules "
+        "(config, formats) are skipped unless their anchor files changed; "
+        "the full scan stays the tier-1 gate",
+    )
+    ap.add_argument(
         "--strict",
         action="store_true",
         help="exit 1 on new findings, unjustified baseline entries, or "
@@ -245,10 +373,58 @@ def main(argv=None) -> int:
     if args.lock_sections and not args.write_lock:
         print("analysis: --lock-sections requires --write-lock", file=sys.stderr)
         return 2
+    if args.changed_only and args.write_baseline:
+        print(
+            "analysis: --changed-only cannot --write-baseline (a subset "
+            "scan would erase the unscanned files' pins)",
+            file=sys.stderr,
+        )
+        return 2
     if args.write_lock:
         return _write_lock(root, lock_path, args.lock_sections)
 
-    findings, _ctx = run_suite(root, rules, lock_path=lock_path)
+    changed_paths: set[str] | None = None
+    ctx = None
+    if args.changed_only:
+        changed = _git_changed_rels(root)
+        if changed is not None:
+            anchors_hit = sorted(set(changed) & set(_WHOLE_REPO_ANCHORS))
+            if anchors_hit:
+                print(
+                    f"analysis: --changed-only: anchor file(s) {anchors_hit} "
+                    "changed — whole-repo rules need the full tree, running "
+                    "the full scan"
+                )
+            else:
+                selected = _changed_closure(root, changed)
+                if not selected:
+                    print(
+                        "analysis: --changed-only: no analyzable files "
+                        "changed vs merge-base — nothing to do"
+                    )
+                    print("analysis: OK")
+                    return 0
+                rules = (rules or set(CHECKERS)) - WHOLE_REPO_RULES
+                if not rules:
+                    # the user selected ONLY whole-repo rules: an empty
+                    # set would read as "all checkers" downstream and run
+                    # formats/config over a partial tree (spurious drift)
+                    print(
+                        "analysis: --changed-only: the selected rule(s) "
+                        "are whole-repo only (config/formats) — nothing "
+                        "to do; run without --changed-only"
+                    )
+                    print("analysis: OK")
+                    return 0
+                changed_paths = set(selected)
+                ctx = core.RepoContext(root, selected)
+                print(
+                    f"analysis: --changed-only: {len(changed)} changed "
+                    f"path(s) -> {len(selected)} module(s) to re-analyze "
+                    f"({len(rules)} rule(s); config/formats skipped)"
+                )
+
+    findings, _ctx = run_suite(root, rules, ctx=ctx, lock_path=lock_path)
 
     if args.write_baseline:
         # Regeneration is non-destructive: justifications of persisting
@@ -294,6 +470,11 @@ def main(argv=None) -> int:
             k: v
             for k, v in baseline.items()
             if k.startswith(_rule_prefixes(rules))
+        }
+    if changed_paths is not None:
+        # nor pins for files outside the changed closure
+        baseline = {
+            k: v for k, v in baseline.items() if v.get("path") in changed_paths
         }
     new, _pinned, stale = core.partition(findings, baseline)
     print(core.render_text(findings, new, stale, baseline, args.strict))

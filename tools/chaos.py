@@ -377,7 +377,11 @@ def _serve_chaos(args) -> int:
             client.close()
         finally:
             proc.terminate()
-            proc.wait(timeout=30)
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()  # a child ignoring SIGTERM must not survive
+                proc.wait(timeout=5)  # reap: its port must be free below
         unscored = sum(1 for v in baseline.values() if v is None)
         result["baseline_unscored"] = unscored
         if unscored:

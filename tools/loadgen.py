@@ -234,7 +234,10 @@ def run_closed_engine(engine, lines, args, mix, res: Results):
             i += args.concurrency
 
     threads = [
-        threading.Thread(target=worker, args=(w,)) for w in range(args.concurrency)
+        # daemon: a SIGINT mid-run must be able to exit without joining
+        # every worker (the open sockets die with the process)
+        threading.Thread(target=worker, args=(w,), daemon=True)
+        for w in range(args.concurrency)
     ]
     for t in threads:
         t.start()
@@ -304,7 +307,9 @@ def run_open_socket(conns: list[ServeConnection], lines, args, mix, res: Results
             i += len(conns)
 
     threads = [
-        threading.Thread(target=sender, args=(ci, c)) for ci, c in enumerate(conns)
+        # daemon: abandonable on SIGINT, same as the worker pools
+        threading.Thread(target=sender, args=(ci, c), daemon=True)
+        for ci, c in enumerate(conns)
     ]
     for t in threads:
         t.start()
@@ -360,7 +365,10 @@ def run_closed_socket(port, host, lines, args, mix, res: Results):
             conn.close()
 
     threads = [
-        threading.Thread(target=worker, args=(w,)) for w in range(args.concurrency)
+        # daemon: a SIGINT mid-run must be able to exit without joining
+        # every worker (the open sockets die with the process)
+        threading.Thread(target=worker, args=(w,), daemon=True)
+        for w in range(args.concurrency)
     ]
     for t in threads:
         t.start()

@@ -198,27 +198,31 @@ def input_scaling(res: dict, rows: int) -> None:
             for pid in range(nproc)
         ]
         rates = []
-        for p in procs:
-            o, e = p.communicate(timeout=900)
-            if p.returncode:
-                out[f"p{nproc}_error"] = (e or o).strip().splitlines()[-1][-300:]
-                break
-            rates.append(json.loads(o.strip().splitlines()[-1])["rows_s"])
-        else:
-            # Each process iterates the SAME global batches; the global
-            # assembly rate is the slowest participant's.
-            out[f"p{nproc}_rows_s"] = round(min(rates), 1)
+        try:
+            for p in procs:
+                o, e = p.communicate(timeout=900)
+                if p.returncode:
+                    out[f"p{nproc}_error"] = (e or o).strip().splitlines()[-1][-300:]
+                    break
+                rates.append(json.loads(o.strip().splitlines()[-1])["rows_s"])
+            else:
+                # Each process iterates the SAME global batches; the global
+                # assembly rate is the slowest participant's.
+                out[f"p{nproc}_rows_s"] = round(min(rates), 1)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()  # a timed-out/odd-exit peer must not linger
+                    p.wait(timeout=5)  # reap — no zombies holding the port
     if "p1_rows_s" in out and "p2_rows_s" in out:
         out["scaling_x"] = round(out["p2_rows_s"] / out["p1_rows_s"], 2)
     res["input_scaling"] = out
 
 
 def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
 
 
 def main(argv=None) -> int:

@@ -1176,6 +1176,24 @@ def render_analysis(a: dict, base_a: dict | None = None) -> str:
             "(removal/reorder is never legal; additions need --write-lock "
             "in the same diff)."
         )
+    # Blocking-under-lock hotspots: where the wedge-class debt lives,
+    # pinned or not — the worklist for shrinking lock scopes (PR 15).
+    blocking = [
+        f
+        for f in (a.get("findings") or [])
+        if f.get("rule") == "blocking-under-lock"
+    ]
+    if blocking:
+        per_path: dict[str, int] = {}
+        for f in blocking:
+            per_path[f.get("path", "?")] = per_path.get(f.get("path", "?"), 0) + 1
+        L.append("")
+        L.append("**Blocking-under-lock hotspots** (unbounded waits while a lock is held):")
+        ranked = sorted(per_path.items(), key=lambda kv: (-kv[1], kv[0]))
+        for path, n in ranked[:8]:
+            L.append(f"- {path}: {n} site(s)")
+        if len(ranked) > 8:
+            L.append(f"- … and {len(ranked) - 8} more file(s)")
     sev = counts.get("by_severity") or {}
     L.append("")
     L.append(
