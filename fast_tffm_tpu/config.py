@@ -185,6 +185,14 @@ class Config:
     #   client class -> tier ("gold:2,std:1"); under overload the queue
     #   sheds strictly-lower tiers first (oldest of the lowest present),
     #   so degradation follows priority.  Unknown/absent class = tier 0
+    serve_wire: str = "binary"  # DATA-plane wire a client may negotiate
+    #   via {"op":"hello"}: "binary" allows the batched frame protocol
+    #   (protocol.py DATA frames; JSONL stays the fallback), "jsonl"
+    #   refuses the upgrade so every data connection stays line-oriented
+    serve_affinity: bool = True  # hello hands the client a healthy
+    #   replica's port to pin its DATA connection to (replica answers
+    #   directly; router keeps health/reload/placement/failover only).
+    #   False: hello returns no placement and data stays on the front end
     # [Online] — online learning from an append-only event stream
     online_follow: bool = False  # tail-follow the FMS train stream: at EOF
     #   the reader polls for growth instead of ending the epoch
@@ -408,6 +416,10 @@ class Config:
                 f"{self.serve_deadline_ms}"
             )
         self.serve_classes = validate_classes(self.serve_classes)
+        if self.serve_wire not in ("binary", "jsonl"):
+            raise ValueError(
+                f"unknown serve_wire {self.serve_wire!r} (binary | jsonl)"
+            )
         if self.online_poll_s <= 0:
             raise ValueError(f"[Online] poll_s must be > 0, got {self.online_poll_s}")
         if self.online_idle_timeout_s < 0 or self.online_max_batches < 0:
@@ -870,6 +882,10 @@ def load_config(path: str) -> Config:
     cfg.serve_replicas = get(s, "replicas", int, cfg.serve_replicas)
     cfg.serve_deadline_ms = get(s, "deadline_ms", float, cfg.serve_deadline_ms)
     cfg.serve_classes = get(s, "classes", str, cfg.serve_classes)
+    cfg.serve_wire = get(s, "wire", str, cfg.serve_wire).lower()
+    cfg.serve_affinity = get(
+        s, "affinity", ini._convert_to_boolean, cfg.serve_affinity
+    )
 
     o = "Online"
     cfg.online_follow = get(o, "follow", ini._convert_to_boolean, cfg.online_follow)

@@ -94,19 +94,12 @@ class BucketLadder:
             np.zeros((bucket,), np.float32),  # weights (0 = padding row)
         )
 
-    def _batch(self, bucket: int, rows=()) -> Batch:
-        """The ONE definition of a dispatched batch's shape: ``rows``
-        placed over an all-padding base.  warmup() and assemble() both
-        build through here, so a warmed shape can never diverge from a
-        flushed shape (which would defeat the compile ladder) — and the
-        wire staging decision rides the same single path."""
-        labels, ids, vals, fields, weights = self._empty(bucket)
-        for i, (rid, rval, rfld) in enumerate(rows):
-            ids[i] = rid
-            vals[i] = rval
-            if self.uses_fields:
-                fields[i] = rfld
-        weights[: len(rows)] = 1.0
+    def _finalize(self, labels, ids, vals, fields, weights) -> Batch:
+        """Stage one fully-placed bucket batch.  EVERY dispatched batch —
+        warmup, per-row assemble, coalesced-frame assemble — funnels
+        through here, so a warmed shape can never diverge from a flushed
+        shape (which would defeat the compile ladder) and the wire
+        staging decision rides the same single path."""
         if self._wire is not None:
             from fast_tffm_tpu.data.libsvm import ParsedBatch
 
@@ -118,7 +111,7 @@ class BucketLadder:
                 ids=ids,
                 vals=vals,
                 fields=fields,
-                nnz=np.zeros((bucket,), np.int32),
+                nnz=np.zeros((labels.shape[0],), np.int32),
             )
             return self._wire(parsed, weights)
         return Batch(
@@ -129,6 +122,17 @@ class BucketLadder:
             weights=jnp.asarray(weights),
         )
 
+    def _batch(self, bucket: int, rows=()) -> Batch:
+        """``rows`` placed over an all-padding base, one row at a time."""
+        labels, ids, vals, fields, weights = self._empty(bucket)
+        for i, (rid, rval, rfld) in enumerate(rows):
+            ids[i] = rid
+            vals[i] = rval
+            if self.uses_fields:
+                fields[i] = rfld
+        weights[: len(rows)] = 1.0
+        return self._finalize(labels, ids, vals, fields, weights)
+
     def assemble(self, rows) -> tuple[Batch, int]:
         """Stack parsed request rows [(ids, vals, fields), ...] into one
         device Batch padded up to the nearest bucket.  Each row is already
@@ -137,6 +141,28 @@ class BucketLadder:
         produce an unladdered shape."""
         bucket = self.bucket_for(len(rows))
         return self._batch(bucket, rows), bucket
+
+    def assemble_parts(self, parts) -> tuple[Batch, int]:
+        """Coalesced assembly for whole-frame ingest: ``parts`` is a list
+        of ``(ids, vals, fields_or_None)`` 2-D chunks, each already width
+        ``max_nnz``; rows land contiguously in part order.  Slice
+        placement instead of assemble()'s per-row Python loop, and the
+        bucket is chosen AFTER coalescing the flush — the occupancy fix:
+        one frame of n rows pads to the bucket for n, not to whatever the
+        per-request trickle happened to accumulate."""
+        n = sum(int(p[0].shape[0]) for p in parts)
+        bucket = self.bucket_for(n)
+        labels, ids, vals, fields, weights = self._empty(bucket)
+        pos = 0
+        for pid, pval, pfld in parts:
+            k = int(pid.shape[0])
+            ids[pos : pos + k] = pid
+            vals[pos : pos + k] = pval
+            if self.uses_fields and pfld is not None:
+                fields[pos : pos + k] = pfld
+            pos += k
+        weights[:n] = 1.0
+        return self._finalize(labels, ids, vals, fields, weights), bucket
 
     def warmup(self, state) -> int:
         """Compile every bucket ONCE, before traffic: score an all-padding

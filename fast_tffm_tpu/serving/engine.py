@@ -50,7 +50,7 @@ from fast_tffm_tpu.data.libsvm import parse_lines
 from fast_tffm_tpu.serving.admission import AdmissionQueue
 from fast_tffm_tpu.serving.buckets import BucketLadder
 from fast_tffm_tpu.serving.metrics import ServingMetrics
-from fast_tffm_tpu.serving.protocol import DeadlineExceeded
+from fast_tffm_tpu.serving.protocol import FRAME_STATUS_CODES, DeadlineExceeded
 from fast_tffm_tpu.telemetry import log_quietly
 from fast_tffm_tpu.telemetry import RunMonitor
 
@@ -74,6 +74,15 @@ class EngineClosed(RuntimeError):
 
 _CLOSE = object()  # collector shutdown sentinel
 
+# Per-row status bytes for block (frame) responses — indices into
+# protocol.FRAME_STATUS_CODES, so the wire and the engine agree by
+# construction.
+_ST_OK = 0
+_ST_OVERLOADED = FRAME_STATUS_CODES.index("overloaded")
+_ST_DEADLINE = FRAME_STATUS_CODES.index("deadline")
+_ST_BAD_REQUEST = FRAME_STATUS_CODES.index("bad_request")
+_ST_UNAVAILABLE = FRAME_STATUS_CODES.index("unavailable")
+
 
 @dataclass
 class _Request:
@@ -83,6 +92,35 @@ class _Request:
     klass: str = ""  # client class name ("" = default tier)
     tier: int = 0  # admission tier (higher sheds later; from serve_classes)
     deadline_t: float | None = None  # perf_counter deadline; None = none
+
+    n_rows = 1  # admission/flush row accounting (blocks carry many)
+
+
+@dataclass
+class _Block:
+    """A whole decoded REQUEST frame admitted as ONE unit: one queue
+    slot, one decode, one coalesced placement, one response.  ``future``
+    resolves to ``(statuses u8[n], scores f32[n])`` — nonzero statuses
+    index FRAME_STATUS_CODES, so per-row typed errors survive batching.
+    Tier is the MINIMUM over its rows: under tiered overload a mixed
+    frame sheds as its weakest member (a frame is one delivery unit; a
+    caller who needs gold treatment must not staple gold rows to std
+    ones)."""
+
+    ids: np.ndarray  # (n, max_nnz) i32
+    vals: np.ndarray  # (n, max_nnz) f32
+    fields: np.ndarray | None  # (n, max_nnz) i32, or None
+    deadline_t: np.ndarray  # (n,) f64 perf_counter deadlines; +inf = none
+    statuses: np.ndarray  # (n,) u8; nonzero = decided before scoring
+    klasses: list  # per-row class names (metrics attribution)
+    future: Future = field(default_factory=Future)
+    t_submit: float = field(default_factory=time.perf_counter)
+    klass: str = ""  # representative class ("" when mixed)
+    tier: int = 0
+
+    @property
+    def n_rows(self) -> int:
+        return int(self.ids.shape[0])
 
 
 class ServingEngine:
@@ -287,6 +325,17 @@ class ServingEngine:
     def compile_count(self) -> int | None:
         return self._ladder.compile_count()
 
+    @property
+    def max_nnz(self) -> int:
+        """Static per-row feature width — what a binary-wire client must
+        pack frames at (advertised in the hello ack)."""
+        return self._score.max_nnz
+
+    @property
+    def uses_fields(self) -> bool:
+        """Whether the model reads the fields section (ffm/fwfm)."""
+        return bool(self._score.uses_fields)
+
     def submit_line(
         self,
         line: str,
@@ -378,10 +427,22 @@ class ServingEngine:
             deadline_at=deadline_at,
         )
 
-    def _shed_evicted(self, evicted: "_Request | None") -> None:
+    def _shed_evicted(self, evicted: "_Request | _Block | None") -> None:
         """Fail an evicted request's future with the typed overload error
-        — the no-silent-drop half of tiered admission."""
+        — the no-silent-drop half of tiered admission.  An evicted BLOCK
+        resolves (never raises): its per-row statuses flip to overloaded
+        so the frame's response stays row-typed."""
         if evicted is None:
+            return
+        if isinstance(evicted, _Block):
+            if evicted.future.set_running_or_notify_cancel():
+                st = evicted.statuses.copy()
+                st[st == _ST_OK] = _ST_OVERLOADED
+                evicted.future.set_result(
+                    (st, np.zeros(evicted.n_rows, np.float32))
+                )
+            for k in evicted.klasses:
+                self.metrics.on_evict(k)
             return
         if evicted.future.set_running_or_notify_cancel():
             evicted.future.set_exception(
@@ -391,6 +452,115 @@ class ServingEngine:
                 )
             )
         self.metrics.on_evict(evicted.klass)
+
+    def submit_block(
+        self,
+        ids,
+        vals,
+        fields=None,
+        *,
+        deadlines_ms=None,
+        classes=None,
+    ) -> Future:
+        """Submit a whole decoded REQUEST frame as ONE admission unit
+        (ISSUE 16: one decode, one queue slot, one coalesced placement).
+
+        ``ids``/``vals`` (and optional ``fields``) are (n, width) arrays
+        with width <= max_nnz (column-padded here); ``deadlines_ms`` are
+        per-row RELATIVE budgets anchored now (0 = serve_deadline_ms
+        default).  Returns a Future resolving to ``(statuses, scores)``
+        — u8 codes into FRAME_STATUS_CODES and float32 rows.  Frame-level
+        shape bugs raise ValueError (a typed bad_request at the wire);
+        rows with out-of-range ids fail per-row with bad_request status
+        instead of poisoning their whole frame.
+        """
+        ids = np.asarray(ids, np.int32)
+        vals = np.asarray(vals, np.float32)
+        w = self._score.max_nnz
+        if ids.ndim != 2 or vals.shape != ids.shape:
+            raise ValueError(
+                f"block ids/vals must be matching (n, width) arrays, got "
+                f"{ids.shape} / {vals.shape}"
+            )
+        n, width = ids.shape
+        if n < 1:
+            raise ValueError("empty block")
+        if n > self.max_batch:
+            raise ValueError(
+                f"block of {n} rows exceeds max_batch {self.max_batch} — "
+                "honor the negotiated max_frame_rows"
+            )
+        if width > w:
+            raise ValueError(f"block width {width} exceeds max_nnz {w}")
+        if fields is not None:
+            fields = np.asarray(fields, np.int32)
+            if fields.shape != ids.shape:
+                raise ValueError(
+                    f"fields shape {fields.shape} != ids shape {ids.shape}"
+                )
+        if width < w:
+            pad = ((0, 0), (0, w - width))
+            ids = np.pad(ids, pad)
+            vals = np.pad(vals, pad)
+            if fields is not None:
+                fields = np.pad(fields, pad)
+        v = self._cfg.vocabulary_size
+        bad = ((ids < 0) | (ids >= v)).any(axis=1)
+        statuses = np.where(bad, np.uint8(_ST_BAD_REQUEST), np.uint8(_ST_OK))
+        if classes is None:
+            klasses = [""] * n
+        else:
+            klasses = [str(c or "") for c in classes]
+            if len(klasses) != n:
+                raise ValueError(f"classes carries {len(klasses)} entries for {n} rows")
+        t_submit = time.perf_counter()
+        base = self._default_deadline_s
+        base_t = (t_submit + base) if (base is not None and base > 0) else np.inf
+        if deadlines_ms is None:
+            deadline_t = np.full(n, base_t)
+        else:
+            d = np.asarray(deadlines_ms, np.float64).reshape(-1)
+            if d.shape != (n,):
+                raise ValueError(f"deadlines_ms carries {d.shape} entries for {n} rows")
+            deadline_t = np.where(d > 0, t_submit + d / 1e3, base_t)
+        tiers = [self._tiers.get(k, 0) for k in klasses]
+        block = _Block(
+            ids=ids,
+            vals=vals,
+            fields=fields,
+            deadline_t=deadline_t,
+            statuses=statuses,
+            klasses=klasses,
+            t_submit=t_submit,
+            klass=klasses[0] if len(set(klasses)) == 1 else "",
+            tier=min(tiers),
+        )
+        if self._closed:
+            raise EngineClosed("engine is closed")
+        if self._policy == "reject":
+            try:
+                self._shed_evicted(self._q.put_nowait(block, tier=block.tier))
+            except queue.Full:
+                self.metrics.on_submit_many(n, accepted=False, klasses=klasses)
+                raise OverloadError(
+                    f"admission queue full ({self._q.maxsize} pending) — "
+                    "overload; shed load or raise serve_queue_size / switch "
+                    "serve_overload to block"
+                ) from None
+        else:
+            while True:
+                if self._closed:
+                    raise EngineClosed("engine closed while blocked on admission")
+                try:
+                    self._shed_evicted(self._q.put(block, tier=block.tier, timeout=0.1))
+                    break
+                except queue.Full:
+                    continue
+        self.metrics.on_submit_many(n, accepted=True)
+        # Same close-race epilogue as _submit_row: see the comment there.
+        if self._closed and not self._collector.is_alive():
+            self._drain_with_exception(EngineClosed("engine closed"))
+        return block.future
 
     def _submit_row(
         self,
@@ -450,14 +620,16 @@ class ServingEngine:
     # -- collector -------------------------------------------------------
 
     def _collect(self) -> None:
-        pending: list[_Request] = []
+        pending: list[_Request | _Block] = []
+        rows = 0  # real rows across `pending` (a block counts its n)
         deadline = 0.0
         draining = False
         try:
             while True:
-                if pending and len(pending) >= self.max_batch:
+                if pending and rows >= self.max_batch:
                     self._flush(pending, deadline_fired=False)
                     pending = []
+                    rows = 0
                     continue
                 timeout = None
                 if pending:
@@ -469,7 +641,7 @@ class ServingEngine:
                         # it is popped; flushing it alone would collapse
                         # micro-batching to singleton dispatches exactly
                         # when load is highest.
-                        while len(pending) < self.max_batch:
+                        while rows < self.max_batch:
                             try:
                                 extra = self._q.get_nowait()
                             except queue.Empty:
@@ -478,11 +650,13 @@ class ServingEngine:
                                 draining = True
                                 break
                             pending.append(extra)
+                            rows += extra.n_rows
                         self._flush(
                             pending,
-                            deadline_fired=len(pending) < self.max_batch,
+                            deadline_fired=rows < self.max_batch,
                         )
                         pending = []
+                        rows = 0
                         continue
                 elif draining:
                     # Close requested and everything flushed: done.
@@ -504,6 +678,7 @@ class ServingEngine:
                     # against the budget — not just time in `pending`.
                     deadline = item.t_submit + self.deadline_s
                 pending.append(item)
+                rows += item.n_rows
         except BaseException as e:  # never strand submitted futures
             # Mark the engine closed FIRST: with a dead collector, a
             # block-policy submit would otherwise spin on the full queue
@@ -556,6 +731,25 @@ class ServingEngine:
                 # the two counters independent: reloads = full re-reads.
                 self.metrics.on_reload(ok=True)
             log_quietly(self._log, f"serving: swapped in checkpoint step {staged_step}")
+        # Blocks make `pending` row counts lumpy: a close-time drain (or
+        # a block-heavy top-up) can exceed max_batch rows, which has no
+        # compiled shape.  Partition into <=max_batch-row groups; a
+        # single block never exceeds max_batch (submit_block enforces).
+        chunk: list[_Request | _Block] = []
+        chunk_rows = 0
+        for item in pending:
+            if chunk and chunk_rows + item.n_rows > self.max_batch:
+                self._flush_units(chunk, deadline_fired)
+                chunk = []
+                chunk_rows = 0
+            chunk.append(item)
+            chunk_rows += item.n_rows
+        if chunk:
+            self._flush_units(chunk, deadline_fired)
+
+    def _flush_units(
+        self, pending: "list[_Request | _Block]", deadline_fired: bool
+    ) -> None:
         # Claim the futures: a pending Future is always cancellable, and
         # resolving a cancelled one raises InvalidStateError — which,
         # unguarded, would kill the collector over ONE impatient caller.
@@ -566,10 +760,24 @@ class ServingEngine:
         # already expired cannot be answered in time — scoring it would
         # only inflate the bucket (and the batch's latency) for an answer
         # nobody is waiting for.  Shedding first can also shrink the
-        # bucket the survivors pad to.
+        # bucket the survivors pad to (the bucket is picked AFTER the
+        # shed, over the whole coalesced flush).
         now = time.perf_counter()
-        live = []
+        reqs: list[_Request] = []  # live per-row requests, in order
+        blocks: list[tuple[_Block, np.ndarray]] = []  # (block, alive idx)
+        n_alive = 0
         for r in pending:
+            if isinstance(r, _Block):
+                st = r.statuses
+                expired = (now >= r.deadline_t) & (st == _ST_OK)
+                if expired.any():
+                    st[expired] = _ST_DEADLINE
+                    for i in np.flatnonzero(expired):
+                        self.metrics.on_deadline_drop(r.klasses[int(i)])
+                alive = np.flatnonzero(st == _ST_OK)
+                blocks.append((r, alive))
+                n_alive += int(alive.size)
+                continue
             if r.deadline_t is not None and now >= r.deadline_t:
                 r.future.set_exception(
                     DeadlineExceeded(
@@ -579,13 +787,17 @@ class ServingEngine:
                 )
                 self.metrics.on_deadline_drop(r.klass)
             else:
-                live.append(r)
-        pending = live
-        if not pending:
-            # Still PROGRESS: the collector drained (and answered) work —
-            # an all-shed flush must advance the liveness clock or a
-            # tight-deadline overload reads as a wedged collector to the
-            # router's health checks.
+                reqs.append(r)
+                n_alive += 1
+        if n_alive == 0:
+            # Every row shed — blocks still owe their ONE response (the
+            # shed rows' typed codes travel in it).  Still PROGRESS: the
+            # collector drained (and answered) work — an all-shed flush
+            # must advance the liveness clock or a tight-deadline
+            # overload reads as a wedged collector to the router's
+            # health checks.
+            for b, _ in blocks:
+                b.future.set_result((b.statuses, np.zeros(b.n_rows, np.float32)))
             self._last_flush_t = time.perf_counter()
             return
         if self._slow_flushes > 0:  # injected latency (chaos replica_slow)
@@ -593,19 +805,43 @@ class ServingEngine:
             time.sleep(self._slow_ms / 1e3)
         t_start = time.perf_counter()
         try:
-            batch, bucket = self._ladder.assemble([r.row for r in pending])
+            parts = [(r.row[0][None], r.row[1][None], r.row[2][None]) for r in reqs]
+            parts += [
+                (
+                    b.ids[alive],
+                    b.vals[alive],
+                    b.fields[alive] if b.fields is not None else None,
+                )
+                for b, alive in blocks
+            ]
+            batch, bucket = self._ladder.assemble_parts(parts)
             t_dispatch = time.perf_counter()
             scores = np.asarray(self._ladder.score(self._state, batch))
             t_done = time.perf_counter()
         except BaseException as e:
-            for r in pending:
+            for r in reqs:
                 if not r.future.done():
                     r.future.set_exception(e)
+            for b, alive in blocks:
+                if not b.future.done():
+                    # Blocks resolve, never raise: already-decided rows
+                    # (deadline/bad_request) keep their codes; only the
+                    # would-have-scored rows become unavailable.
+                    st = b.statuses.copy()
+                    st[alive] = _ST_UNAVAILABLE
+                    b.future.set_result((st, np.zeros(b.n_rows, np.float32)))
             log_quietly(self._log, f"serving: flush failed: {e!r}")
             self._last_flush_t = time.perf_counter()  # answered = progress
             return
-        for i, r in enumerate(pending):
-            r.future.set_result(float(scores[i]))
+        pos = 0
+        for r in reqs:
+            r.future.set_result(float(scores[pos]))
+            pos += 1
+        for b, alive in blocks:
+            out = np.zeros(b.n_rows, np.float32)
+            out[alive] = scores[pos : pos + alive.size]
+            pos += int(alive.size)
+            b.future.set_result((b.statuses, out))
         t_resolved = time.perf_counter()
         self._flush_seq += 1
         if self._pending_fresh is not None:
@@ -618,14 +854,20 @@ class ServingEngine:
             # it must NEVER kill the collector.
             pass
         self._last_flush_t = t_resolved
+        # One metrics group per request plus one per BLOCK: a frame's
+        # rows share submit/resolve instants, so its group carries a row
+        # count instead of n duplicate histogram insertions.
         self.metrics.on_flush(
             bucket,
-            len(pending),
-            queue_waits=[t_start - r.t_submit for r in pending],
+            n_alive,
+            queue_waits=[t_start - r.t_submit for r in reqs]
+            + [t_start - b.t_submit for b, _ in blocks],
             compute_s=t_done - t_dispatch,
-            total_s=[t_resolved - r.t_submit for r in pending],
+            total_s=[t_resolved - r.t_submit for r in reqs]
+            + [t_resolved - b.t_submit for b, _ in blocks],
             deadline_fired=deadline_fired,
-            classes=[r.klass for r in pending],
+            classes=[r.klass for r in reqs] + [b.klass for b, _ in blocks],
+            counts=[1] * len(reqs) + [int(alive.size) for _, alive in blocks],
         )
         if (
             self._metrics_every > 0

@@ -109,7 +109,29 @@ class _Handler(socketserver.StreamRequestHandler):
                 continue
             op = msg.get("op")
             try:
-                if op == "ping":
+                if op == "hello":
+                    # Wire negotiation + placement (ISSUE 16).  The ack
+                    # names the wire the tier allows; with affinity on it
+                    # also hands the client a healthy replica's port to
+                    # pin its DATA connection to — the replica answers
+                    # directly and the front end / router drop out of the
+                    # score path.  On that replica's death the CLIENT
+                    # re-hellos here for a peer (retry-once-on-peer).
+                    want = str(msg.get("wire", "jsonl") or "jsonl").lower()
+                    wire = self.server.wire  # type: ignore[attr-defined]
+                    ack = {
+                        "id": req_id,
+                        "ok": True,
+                        "op": "hello",
+                        "wire": "binary" if (want == "binary" and wire == "binary") else "jsonl",
+                        "affinity": self.server.affinity,  # type: ignore[attr-defined]
+                    }
+                    if self.server.affinity:  # type: ignore[attr-defined]
+                        idx, rport = router.assign()
+                        ack["replica"] = idx
+                        ack["port"] = rport
+                    send(ack)
+                elif op == "ping":
                     send({"id": req_id, "ok": True, "op": "ping", **router.snapshot()})
                 elif op == "stats":
                     send({"id": req_id, "ok": True, "op": "stats", **router.stats()})
@@ -144,11 +166,15 @@ class Frontend:
         port: int = 0,
         max_pipeline: int = 1024,
         default_deadline_ms: float = 0.0,
+        wire: str = "binary",
+        affinity: bool = True,
     ):
         self._srv = _Server((host, port), _Handler)
         self._srv.router = router  # type: ignore[attr-defined]
         self._srv.max_pipeline = max_pipeline  # type: ignore[attr-defined]
         self._srv.default_deadline_ms = float(default_deadline_ms)  # type: ignore[attr-defined]
+        self._srv.wire = wire  # type: ignore[attr-defined]
+        self._srv.affinity = bool(affinity)  # type: ignore[attr-defined]
         self.host, self.port = self._srv.server_address[:2]
         self._thread = threading.Thread(
             target=self._srv.serve_forever,
@@ -178,6 +204,8 @@ def run_frontend(cfg, config_path: str, *, port: int | None = None, log=None) ->
             router,
             port=cfg.serve_port if port is None else port,
             default_deadline_ms=cfg.serve_deadline_ms,
+            wire=cfg.serve_wire,
+            affinity=cfg.serve_affinity,
         )
     except Exception:
         router.close()

@@ -38,10 +38,17 @@ class LatencyHistogram:
         self._max = 0.0
 
     def add(self, seconds: float) -> None:
+        self.add_many(seconds, 1)
+
+    def add_many(self, seconds: float, k: int) -> None:
+        """``k`` samples of the same value in one bin update — how a
+        whole-frame flush records its rows without k searchsorted calls."""
+        if k <= 0:
+            return
         i = int(np.searchsorted(self._edges, seconds, side="right")) - 1
-        self._counts[min(max(i, 0), self._counts.size - 1)] += 1
-        self._n += 1
-        self._sum += seconds
+        self._counts[min(max(i, 0), self._counts.size - 1)] += k
+        self._n += k
+        self._sum += seconds * k
         self._min = min(self._min, seconds)
         self._max = max(self._max, seconds)
 
@@ -123,6 +130,9 @@ class ServingMetrics:
         self.delta_reloads = 0  # delta FILES applied in place (a delta
         #   swap does NOT also bump `reloads` — the counters are disjoint)
         self.bucket_rows: dict[int, int] = {}  # bucket size -> real rows
+        self.bucket_padded: dict[int, int] = {}  # bucket size -> padding
+        #   rows (per-bucket occupancy = rows / (rows + padded): WHERE the
+        #   padding waste lives, not just that it exists)
         # Freshness SLO distributions (ISSUE 9): one sample per reload
         # swap — checkpoint publish → state applied (collector swap) and
         # publish → first score resolved against the new state.  Wall
@@ -142,6 +152,17 @@ class ServingMetrics:
                 self.rejected += 1
                 k = self._class_key(klass)
                 self.sheds_by_class[k] = self.sheds_by_class.get(k, 0) + 1
+
+    def on_submit_many(self, n: int, accepted: bool, klasses=None) -> None:
+        """A whole frame admitted (or rejected) as one unit still counts
+        as its n requests — QPS math must not depend on the wire."""
+        with self._lock:
+            self.requests += n
+            if not accepted:
+                self.rejected += n
+                for klass in klasses if klasses is not None else [""] * n:
+                    k = self._class_key(klass)
+                    self.sheds_by_class[k] = self.sheds_by_class.get(k, 0) + 1
 
     def on_evict(self, klass: str = "") -> None:
         """A QUEUED request was shed to admit a higher-class arrival."""
@@ -167,7 +188,12 @@ class ServingMetrics:
         total_s: list[float],
         deadline_fired: bool,
         classes: list[str] | None = None,
+        counts: list[int] | None = None,
     ) -> None:
+        """``queue_waits``/``total_s``/``classes`` are parallel per-GROUP
+        lists; ``counts[i]`` is how many rows share entry i (a whole
+        frame's rows enter as one group — None = every group is 1 row,
+        the per-request path)."""
         with self._lock:
             self.flushes += 1
             if deadline_fired:
@@ -177,17 +203,23 @@ class ServingMetrics:
             self.rows += n_rows
             self.padded_rows += bucket - n_rows
             self.bucket_rows[bucket] = self.bucket_rows.get(bucket, 0) + n_rows
+            self.bucket_padded[bucket] = self.bucket_padded.get(bucket, 0) + (
+                bucket - n_rows
+            )
             self.compute.add(compute_s)
-            for w in queue_waits:
-                self.queue.add(w)
+            if counts is None:
+                counts = [1] * len(total_s)
+            for w, c in zip(queue_waits, counts):
+                self.queue.add_many(w, c)
             for i, t in enumerate(total_s):
-                self.total.add(t)
+                c = counts[i]
+                self.total.add_many(t, c)
                 if classes is not None:
                     k = self._class_key(classes[i])
                     h = self.class_total.get(k)
                     if h is None:
                         h = self.class_total[k] = LatencyHistogram()
-                    h.add(t)
+                    h.add_many(t, c)
 
     def on_reload(self, ok: bool) -> None:
         with self._lock:
@@ -240,6 +272,18 @@ class ServingMetrics:
                 "reload_giveups": self.reload_giveups,
                 "delta_reloads": self.delta_reloads,
                 "bucket_rows": {str(k): v for k, v in sorted(self.bucket_rows.items())},
+                "bucket_padded_rows": {
+                    str(k): v for k, v in sorted(self.bucket_padded.items())
+                },
+                "bucket_occupancy": {
+                    str(k): round(
+                        self.bucket_rows.get(k, 0)
+                        / (self.bucket_rows.get(k, 0) + v),
+                        4,
+                    )
+                    for k, v in sorted(self.bucket_padded.items())
+                    if self.bucket_rows.get(k, 0) + v
+                },
                 "queue_ms": self.queue.snapshot(),
                 "compute_ms": self.compute.snapshot(),
                 "total_ms": self.total.snapshot(),

@@ -44,30 +44,50 @@ import socket
 import sys
 import threading
 
+import numpy as np
+
 from fast_tffm_tpu.serving.protocol import (
+    FRAME_KIND_REQUEST,
+    FRAME_STATUS_CODES,
     REPLICA_READY_PREFIX,
+    BadRequest,
     decode,
     encode,
     error_response,
+    exc_code,
+    pack_error_frame,
+    pack_scores_frame,
+    read_frame,
+    unpack_request_frame,
 )
 
 __all__ = ["run_replica", "main"]
 
 
 class _Conn:
-    """One router connection: reader loop + a write lock (score futures
-    resolve on the collector thread, acks on the reader/reload threads —
-    whole-line writes must not interleave)."""
+    """One connection (router, or an affinity-pinned client): reader loop
+    + a write lock (score futures resolve on the collector thread, acks
+    on the reader/reload threads — whole writes must not interleave).
 
-    def __init__(self, sock: socket.socket, engine, log):
+    A connection starts in JSONL mode; a ``{"op": "hello", "wire":
+    "binary"}`` line upgrades it to the binary DATA frame protocol
+    (protocol.py) when ``serve_wire`` allows — the negotiated-fallback
+    contract: a server pinned to jsonl acks the hello WITHOUT the
+    upgrade and the client keeps speaking lines."""
+
+    def __init__(self, sock: socket.socket, engine, log, wire: str = "binary"):
         self._sock = sock
         self._engine = engine
         self._log = log
+        self._wire = wire
+        self._upgraded = False
         self._wlock = threading.Lock()
         self._reload_lock = threading.Lock()  # one reload at a time
 
     def send(self, obj: dict) -> None:
-        data = encode(obj)
+        self.send_bytes(encode(obj))
+
+    def send_bytes(self, data: bytes) -> None:
         try:
             with self._wlock:
                 # analysis: ok blocking-under-lock the peer is the ROUTER, which reads eagerly on a dedicated reader thread; if it wedges, its own health layer SIGKILLs this replica (wedge conjunction) or closes the socket, which unblocks sendall with OSError — a settimeout here would also bound the reader loop sharing this socket
@@ -111,7 +131,25 @@ class _Conn:
             self._score(msg)
             return True
         op = msg.get("op")
-        if op == "ping":
+        if op == "hello":
+            want = str(msg.get("wire", "jsonl") or "jsonl").lower()
+            granted = "binary" if (want == "binary" and self._wire == "binary") else "jsonl"
+            self.send(
+                {
+                    "id": req_id,
+                    "ok": True,
+                    "op": "hello",
+                    "wire": granted,
+                    "max_frame_rows": self._engine.max_batch,
+                    "max_nnz": self._engine.max_nnz,
+                    "fields": self._engine.uses_fields,
+                }
+            )
+            if granted == "binary":
+                # The ack is the LAST JSONL on this connection; everything
+                # after it is frames (serve() switches reader loops).
+                self._upgraded = True
+        elif op == "ping":
             self.send({"id": req_id, "ok": True, "op": "ping", **self._engine.health()})
         elif op == "stats":
             self.send(
@@ -158,7 +196,70 @@ class _Conn:
                 # submit_line raising (overload, parse, closed engine) —
                 # typed response, never a dropped line.
                 self.send(error_response(msg.get("id"), e))
+            if self._upgraded:
+                return self._serve_frames(buf)
         return False
+
+    def _answer_all(self, req_ids: np.ndarray, code: str) -> None:
+        """One SCORES frame failing every row of a frame with ``code`` —
+        how whole-frame errors (overload, closed engine, a died flush)
+        stay typed and per-request on the binary wire."""
+        n = int(req_ids.size)
+        self.send_bytes(
+            pack_scores_frame(
+                req_ids,
+                np.full(n, FRAME_STATUS_CODES.index(code), np.uint8),
+                np.zeros(n, np.float32),
+            )
+        )
+
+    def _serve_frames(self, buf) -> bool:
+        """Binary DATA loop (post-hello).  Torn input never hangs or
+        silently drops the socket: an undecodable PAYLOAD (header intact,
+        stream still synced) gets an ERROR frame and the loop continues;
+        a broken HEADER (framing lost — resync is impossible on a byte
+        stream) gets an ERROR frame and THEN the connection closes."""
+        while True:
+            try:
+                fr = read_frame(buf)
+            except BadRequest as e:
+                self.send_bytes(pack_error_frame("bad_request", str(e)))
+                return False
+            if fr is None:
+                return False  # clean EOF at a frame boundary
+            kind, flags, count, width, payload = fr
+            if kind != FRAME_KIND_REQUEST:
+                self.send_bytes(
+                    pack_error_frame("bad_request", f"unexpected frame kind {kind}")
+                )
+                continue
+            try:
+                d = unpack_request_frame(flags, count, width, payload)
+            except BadRequest as e:
+                self.send_bytes(pack_error_frame("bad_request", str(e)))
+                continue
+            req_ids = d["req_ids"]
+            try:
+                fut = self._engine.submit_block(
+                    d["ids"],
+                    d["vals"],
+                    d["fields"],
+                    deadlines_ms=d["deadlines_ms"],
+                    classes=d["classes"],
+                )
+            except Exception as e:
+                self._answer_all(req_ids, exc_code(e))
+                continue
+
+            def done(f, req_ids=req_ids):
+                exc = f.exception()
+                if exc is None:
+                    statuses, scores = f.result()
+                    self.send_bytes(pack_scores_frame(req_ids, statuses, scores))
+                else:
+                    self._answer_all(req_ids, exc_code(exc))
+
+            fut.add_done_callback(done)
 
 
 def run_replica(
@@ -209,7 +310,7 @@ def run_replica(
         # instead of reading as wedged.
         def serve_conn(conn):
             try:
-                if _Conn(conn, engine, log).serve():
+                if _Conn(conn, engine, log, wire=cfg.serve_wire).serve():
                     close_evt.set()
             finally:
                 try:

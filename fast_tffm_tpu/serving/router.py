@@ -388,6 +388,26 @@ class Router:
     def healthy_replicas(self) -> list[_Slot]:
         return [s for s in self.slots if s.state == "healthy"]
 
+    def assign(self) -> tuple[int, int]:
+        """Placement for an affinity-pinned DATA connection (ISSUE 16):
+        pick a healthy replica round-robin and return ``(index, port)``
+        — the client connects to the replica DIRECTLY and it answers
+        without a router hop.  The router keeps health/reload/placement/
+        failover: when the pinned replica dies the client comes back
+        here for a peer (its retry-once).  Raises Unavailable when no
+        replica is healthy, so the hello gets a typed answer instead of
+        a dangling connection."""
+        healthy = self.healthy_replicas()
+        if not healthy:
+            raise Unavailable(
+                "no healthy replica to pin (all starting/dead/failed)"
+            )
+        slot = healthy[next(self._rr) % len(healthy)]
+        port = getattr(slot.handle, "port", None)
+        if port is None:
+            raise Unavailable(f"replica {slot.index} has no port yet")
+        return slot.index, int(port)
+
     # -- submission / routing ---------------------------------------------
 
     def _send(self, slot: _Slot, obj: dict, ctrl: bool = False) -> None:

@@ -714,3 +714,296 @@ replicas = 2
             proc.wait(timeout=30)
         except subprocess.TimeoutExpired:
             proc.kill()
+
+
+# ---------------------------------------------------------------------------
+# binary DATA plane: negotiation, torn frames, block submits (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+
+class _StubBlockEngine:
+    """submit_block stand-in for _Conn wire tests: scores row i as
+    sum(vals[i]), statuses all ok — deterministic, no jax."""
+
+    max_batch = 64
+    max_nnz = 6
+    uses_fields = False
+
+    def submit_block(self, ids, vals, fields=None, *, deadlines_ms=None, classes=None):
+        import concurrent.futures
+
+        vals = np.asarray(vals, np.float32)
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        fut.set_result(
+            (np.zeros(len(vals), np.uint8), vals.sum(axis=1).astype(np.float32))
+        )
+        return fut
+
+
+def _conn_pair(engine, wire="binary"):
+    """A replica _Conn served on a thread over a socketpair; returns the
+    client socket + its buffered reader + the serve thread."""
+    from fast_tffm_tpu.serving.replica import _Conn
+
+    server, client = socket.socketpair()
+    conn = _Conn(server, engine, lambda *_: None, wire=wire)
+    t = threading.Thread(target=conn.serve, daemon=True)
+    t.start()
+    client.settimeout(30)
+    return client, client.makefile("rb"), t
+
+
+def test_conn_hello_upgrades_to_frames():
+    from fast_tffm_tpu.serving.protocol import (
+        FRAME_KIND_SCORES,
+        decode,
+        encode,
+        pack_request_frame,
+        read_frame,
+        unpack_scores_frame,
+    )
+
+    client, rf, _ = _conn_pair(_StubBlockEngine())
+    client.sendall(encode({"id": 1, "op": "hello", "wire": "binary"}))
+    ack = decode(rf.readline())
+    assert ack["wire"] == "binary"
+    assert ack["max_frame_rows"] == 64 and ack["max_nnz"] == 6
+    vals = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    client.sendall(
+        pack_request_frame(
+            np.array([7, 8], np.uint32), np.zeros((2, 2), np.int32), vals
+        )
+    )
+    kind, _, count, _, payload = read_frame(rf)
+    assert kind == FRAME_KIND_SCORES
+    req, st, sc = unpack_scores_frame(count, payload)
+    assert list(req) == [7, 8] and list(st) == [0, 0]
+    assert list(sc) == [3.0, 7.0]
+    client.close()
+
+
+def test_conn_jsonl_pin_refuses_upgrade():
+    """A server pinned serve_wire=jsonl acks the hello WITHOUT the
+    upgrade and the connection keeps speaking lines — the negotiated
+    fallback the client maps to WireRefused."""
+    from fast_tffm_tpu.serving.protocol import decode, encode
+
+    client, rf, _ = _conn_pair(_StubBlockEngine(), wire="jsonl")
+    client.sendall(encode({"id": 1, "op": "hello", "wire": "binary"}))
+    assert decode(rf.readline())["wire"] == "jsonl"
+    client.sendall(encode({"id": 2, "op": "close"}))  # still JSONL: op works
+    assert decode(rf.readline())["op"] == "close"
+    client.close()
+
+
+def test_conn_torn_frame_typed_error_never_hung():
+    """Payload-level tear (header intact): ERROR frame, stream continues.
+    Header-level tear (framing lost): ERROR frame, then the server
+    closes — never a hung socket, never a silent drop."""
+    from fast_tffm_tpu.serving.protocol import (
+        FRAME_HEADER,
+        FRAME_KIND_ERROR,
+        FRAME_KIND_REQUEST,
+        FRAME_KIND_SCORES,
+        FRAME_MAGIC,
+        FRAME_VERSION,
+        decode,
+        encode,
+        pack_request_frame,
+        read_frame,
+        unpack_error_frame,
+    )
+
+    client, rf, _ = _conn_pair(_StubBlockEngine())
+    client.sendall(encode({"id": 1, "op": "hello", "wire": "binary"}))
+    decode(rf.readline())
+    # Header says count=9 rows but the payload bytes can't hold them.
+    short = b"\x00" * 32
+    client.sendall(
+        FRAME_HEADER.pack(
+            FRAME_MAGIC, FRAME_VERSION, FRAME_KIND_REQUEST, 0, 9, 4, len(short)
+        )
+        + short
+    )
+    kind, _, _, _, payload = read_frame(rf)
+    assert kind == FRAME_KIND_ERROR
+    assert unpack_error_frame(payload)[0] == "bad_request"
+    # Stream still synced: a good frame after the bad payload scores.
+    client.sendall(
+        pack_request_frame(
+            np.array([5], np.uint32),
+            np.zeros((1, 2), np.int32),
+            np.ones((1, 2), np.float32),
+        )
+    )
+    kind, *_ = read_frame(rf)
+    assert kind == FRAME_KIND_SCORES
+    # Bad magic = framing lost: typed ERROR, then EOF (connection closed).
+    client.sendall(b"GARBAGE!" * 4)
+    kind, _, _, _, payload = read_frame(rf)
+    assert kind == FRAME_KIND_ERROR
+    assert unpack_error_frame(payload)[0] == "bad_request"
+    assert read_frame(rf) is None
+    client.close()
+
+
+def test_frame_connection_wire_refused_falls_back():
+    """A front end that won't grant binary+affinity raises WireRefused
+    (carrying the ack) instead of limping — the caller's cue to fall
+    back to the JSONL ServeConnection."""
+    from fast_tffm_tpu.serving.client import FrameConnection, WireRefused
+    from fast_tffm_tpu.serving.protocol import decode, encode
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    def frontend():
+        c, _ = srv.accept()
+        msg = decode(c.makefile("rb").readline())
+        c.sendall(
+            encode({"id": msg.get("id"), "ok": True, "op": "hello",
+                    "wire": "jsonl", "affinity": False})
+        )
+        c.close()
+
+    t = threading.Thread(target=frontend, daemon=True)
+    t.start()
+    with pytest.raises(WireRefused) as ei:
+        FrameConnection(port)
+    assert ei.value.ack["wire"] == "jsonl"
+    t.join(10)
+    srv.close()
+
+
+def test_submit_block_matches_per_row_submits(tmp_path):
+    """One coalesced block == n per-row submits, bitwise: same scores
+    for the same rows, with per-row bad ids isolated to their row
+    instead of poisoning the frame."""
+    from fast_tffm_tpu.data.libsvm import parse_lines
+    from fast_tffm_tpu.serving import ServingEngine
+    from fast_tffm_tpu.serving.protocol import FRAME_STATUS_CODES
+
+    cfg = _cfg(tmp_path)
+    _checkpoint(cfg)
+    eng = ServingEngine(cfg, log=lambda *_: None)
+    try:
+        lines = [f"1 {i + 1}:1.0 {i + 10}:0.5" for i in range(6)]
+        per_row = [eng.submit_line(ln).result(timeout=30) for ln in lines]
+        pb = parse_lines(lines, vocabulary_size=V, max_nnz=NNZ)
+        st, sc = eng.submit_block(
+            pb.ids, pb.vals, pb.fields if eng.uses_fields else None
+        ).result(timeout=30)
+        assert list(st) == [0] * 6
+        assert [float(s) for s in sc] == per_row  # bit-identical
+        # Row 2 carries an out-of-vocab id: ONLY that row fails, typed.
+        bad_ids = pb.ids.copy()
+        bad_ids[2, 0] = V + 99
+        st2, sc2 = eng.submit_block(bad_ids, pb.vals).result(timeout=30)
+        assert FRAME_STATUS_CODES[st2[2]] == "bad_request"
+        ok_rows = [i for i in range(6) if i != 2]
+        assert [int(st2[i]) for i in ok_rows] == [0] * 5
+    finally:
+        eng.close()
+
+
+def test_submit_block_bucket_after_coalesce(tmp_path):
+    """Two blocks queued within one flush window coalesce into ONE
+    bucket sized for their sum — the occupancy fix.  Per-bucket
+    padded_rows/occupancy land in the serving snapshot."""
+    from fast_tffm_tpu.serving import ServingEngine
+
+    cfg = _cfg(tmp_path, serve_flush_deadline_ms=200.0)
+    _checkpoint(cfg)
+    eng = ServingEngine(cfg, log=lambda *_: None)
+    try:
+        ids = np.arange(1, 7, dtype=np.int32).reshape(3, 2)
+        vals = np.ones((3, 2), np.float32)
+        f1 = eng.submit_block(ids, vals)
+        f2 = eng.submit_block(ids + 10, vals)
+        f1.result(timeout=30), f2.result(timeout=30)
+        snap = eng.metrics_snapshot()
+        # 6 rows in one 16-bucket flush — not two 4-bucket flushes.
+        assert snap["flushes"] == 1
+        assert snap["bucket_rows"] == {"16": 6}
+        assert snap["bucket_padded_rows"] == {"16": 10}
+        assert snap["bucket_occupancy"] == {"16": round(6 / 16, 4)}
+    finally:
+        eng.close()
+
+
+@pytest.mark.slow
+def test_e2e_affinity_failover_scores_bit_identical(tmp_path):
+    """The r16 data plane end to end: hello → replica pin → frames
+    answered directly by the replica; JSONL and frame scores bitwise
+    equal; SIGKILL of the pinned replica → client-driven retry-once-on-
+    peer → every re-driven row re-scored BIT-IDENTICALLY, zero hung."""
+    from fast_tffm_tpu.data.libsvm import parse_lines
+    from fast_tffm_tpu.serving.client import (
+        FrameConnection,
+        ServeConnection,
+        spawn_serve,
+    )
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cfg = _cfg(tmp_path, serve_replicas=2)
+    _checkpoint(cfg)
+    cfg_path = tmp_path / "run.cfg"
+    cfg_path.write_text(
+        f"""
+[General]
+model = fm
+factor_num = 4
+vocabulary_size = {V}
+model_file = {cfg.model_file}
+
+[Train]
+max_nnz = {NNZ}
+
+[Serving]
+buckets = 1 4 16
+flush_deadline_ms = 2
+replicas = 2
+"""
+    )
+    proc, port = spawn_serve(str(cfg_path), timeout_s=300)
+    fc = None
+    ops = None
+    try:
+        lines = [f"1 {i + 1}:1.0 {i + 10}:2.0" for i in range(12)]
+        ops = ServeConnection(port)
+        base = {
+            i: ops.request({"id": 1000 + i, "line": ln}, timeout=60)["score"]
+            for i, ln in enumerate(lines)
+        }
+        pb = parse_lines(lines, vocabulary_size=V, max_nnz=NNZ)
+        fc = FrameConnection(port)
+        assert fc.replica is not None and fc.replica_port  # affinity granted
+        fields = pb.fields if fc.uses_fields else None
+        fc.send_batch(np.arange(12, dtype=np.uint32), pb.ids, pb.vals, fields=fields)
+        assert not fc.wait_answered(range(12), 120)
+        for i in range(12):
+            assert fc.results[i] == ("ok", base[i]), i  # bitwise vs JSONL
+        # Kill the PINNED replica: the next frame's rows must all resolve
+        # via exactly one failover to the peer, scores unchanged.
+        stats = ops.request({"id": "s", "op": "stats"}, timeout=60)
+        os.kill(stats["replicas"][fc.replica]["pid"], signal.SIGKILL)
+        time.sleep(0.2)
+        fc.send_batch(
+            np.arange(100, 112, dtype=np.uint32), pb.ids, pb.vals, fields=fields
+        )
+        assert not fc.wait_answered(range(100, 112), 120)  # zero hung
+        assert fc.failovers == 1
+        for i in range(12):
+            assert fc.results[100 + i] == ("ok", base[i]), i
+    finally:
+        if fc is not None:
+            fc.close()
+        if ops is not None:
+            ops.close()
+        proc.terminate()
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()

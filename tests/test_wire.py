@@ -446,3 +446,132 @@ def test_config_wire_format_parse_and_validate(tmp_path):
     assert Config().wire_format == "packed"  # the default
     with pytest.raises(ValueError, match="wire_format"):
         Config(wire_format="gzip").validate()
+
+
+# ---------------------------------------------------------------------------
+# serving DATA frames (serving/protocol.py): the binary score plane
+# ---------------------------------------------------------------------------
+
+
+def test_serving_frame_request_roundtrip():
+    import io
+
+    from fast_tffm_tpu.serving import protocol as sp
+
+    rng = np.random.default_rng(7)
+    n, w = 5, 6
+    req = np.arange(100, 100 + n, dtype=np.uint32)
+    ids = rng.integers(0, 4096, (n, w)).astype(np.int32)
+    vals = rng.normal(size=(n, w)).astype(np.float32)
+    fields = rng.integers(0, 8, (n, w)).astype(np.int32)
+    dl = np.array([0, 50, 0, 12.5, 100], np.float32)
+    classes = ["gold", "std", "std", "", "gold"]
+    data = sp.pack_request_frame(
+        req, ids, vals, fields=fields, deadlines_ms=dl, classes=classes
+    )
+    kind, flags, count, width, payload = sp.read_frame(io.BytesIO(data))
+    assert (kind, count, width) == (sp.FRAME_KIND_REQUEST, n, w)
+    assert flags & sp.FRAME_FLAG_HAS_FIELDS
+    d = sp.unpack_request_frame(flags, count, width, payload)
+    np.testing.assert_array_equal(d["req_ids"], req)
+    np.testing.assert_array_equal(d["ids"], ids)
+    # Bit-exact floats: the frame is a memcpy, not a repr round-trip.
+    assert d["vals"].tobytes() == vals.tobytes()
+    assert d["deadlines_ms"].tobytes() == dl.tobytes()
+    np.testing.assert_array_equal(d["fields"], fields)
+    assert d["classes"] == classes
+    # The no-fields / no-classes path: flag off, fields None, default class.
+    data2 = sp.pack_request_frame(req, ids, vals)
+    kind2, flags2, c2, w2, payload2 = sp.read_frame(io.BytesIO(data2))
+    assert not (flags2 & sp.FRAME_FLAG_HAS_FIELDS)
+    d2 = sp.unpack_request_frame(flags2, c2, w2, payload2)
+    assert d2["fields"] is None
+    assert d2["classes"] == [""] * n
+
+
+def test_serving_frame_scores_and_error_roundtrip():
+    import io
+
+    from fast_tffm_tpu.serving import protocol as sp
+
+    req = np.array([3, 1, 2], np.uint32)
+    st = np.array([0, 2, 3], np.uint8)  # ok, deadline, bad_request
+    sc = np.array([0.25, 0.0, 0.0], np.float32)
+    kind, _, count, _, payload = sp.read_frame(
+        io.BytesIO(sp.pack_scores_frame(req, st, sc))
+    )
+    assert kind == sp.FRAME_KIND_SCORES
+    r, s, v = sp.unpack_scores_frame(count, payload)
+    np.testing.assert_array_equal(r, req)
+    np.testing.assert_array_equal(s, st)
+    assert v.tobytes() == sc.tobytes()
+    kind, _, _, _, payload = sp.read_frame(
+        io.BytesIO(sp.pack_error_frame("bad_request", "torn header"))
+    )
+    assert kind == sp.FRAME_KIND_ERROR
+    assert sp.unpack_error_frame(payload) == ("bad_request", "torn header")
+    # An unknown code index decodes as unavailable, never an IndexError.
+    assert sp.unpack_error_frame(bytes([250]) + b"\x00\x00")[0] == "unavailable"
+
+
+def test_serving_frame_torn_input_typed_never_hung():
+    """Every way a frame stream can tear maps to BadRequest (or clean
+    None at EOF) — the reader never blocks past the announced payload
+    and never raises an untyped exception."""
+    import io
+
+    from fast_tffm_tpu.serving import protocol as sp
+
+    good = sp.pack_request_frame(
+        np.array([1], np.uint32),
+        np.zeros((1, 2), np.int32),
+        np.ones((1, 2), np.float32),
+    )
+    assert sp.read_frame(io.BytesIO(b"")) is None  # clean EOF at boundary
+    for torn in (
+        good[:7],  # truncated header
+        b"XXXX" + good[4:],  # bad magic
+        good[:4] + b"\xff" + good[5:],  # unsupported version
+        good[: sp.FRAME_HEADER.size + 3],  # EOF mid-payload
+        sp.FRAME_HEADER.pack(
+            sp.FRAME_MAGIC, sp.FRAME_VERSION, sp.FRAME_KIND_REQUEST,
+            0, 1, 2, sp.FRAME_MAX_PAYLOAD + 1,
+        ),  # absurd payload length: must refuse, not await 16 MiB
+    ):
+        with pytest.raises(sp.BadRequest):
+            sp.read_frame(io.BytesIO(torn))
+    # A payload inconsistent with its header counts is typed too.
+    kind, flags, count, width, payload = sp.read_frame(io.BytesIO(good))
+    with pytest.raises(sp.BadRequest):
+        sp.unpack_request_frame(flags, count + 7, width, payload)
+    with pytest.raises(sp.BadRequest):
+        sp.unpack_scores_frame(3, b"\x00" * 5)
+
+
+def test_serving_frame_layout_pinned_in_lockfile():
+    """The committed formats.lock.json pins the frame constants: layout
+    drift (reordered status codes, resized header, new magic) fails HERE
+    before any cross-version peer sees a torn stream."""
+    import os
+
+    from fast_tffm_tpu.serving import protocol as sp
+
+    lock_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools", "analysis", "formats.lock.json",
+    )
+    with open(lock_path) as f:
+        lock = json.load(f)
+    wp = lock["sections"]["wire_protocol"]
+    assert wp["FRAME_STATUS_CODES"] == list(sp.FRAME_STATUS_CODES)
+    assert sp.FRAME_STATUS_CODES[1:] == sp.WIRE_CODES  # u8 0 is "ok"
+    frame = wp["frame"]
+    assert frame["FRAME_MAGIC"] == sp.FRAME_MAGIC.decode()
+    assert frame["FRAME_VERSION"] == sp.FRAME_VERSION
+    assert frame["FRAME_HEADER_FORMAT"] == sp.FRAME_HEADER_FORMAT
+    assert frame["FRAME_KIND_REQUEST"] == sp.FRAME_KIND_REQUEST
+    assert frame["FRAME_KIND_SCORES"] == sp.FRAME_KIND_SCORES
+    assert frame["FRAME_KIND_ERROR"] == sp.FRAME_KIND_ERROR
+    assert frame["FRAME_FLAG_HAS_FIELDS"] == sp.FRAME_FLAG_HAS_FIELDS
+    assert frame["FRAME_MAX_PAYLOAD"] == sp.FRAME_MAX_PAYLOAD
+    assert sp.FRAME_HEADER.size == 16  # u32-aligned; peers hardcode this

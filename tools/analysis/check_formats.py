@@ -169,15 +169,61 @@ def _extract_fms_header(tree) -> dict:
     return out
 
 
+def _const_int(node) -> int | None:
+    """Fold a constant int expression (handles ``1 << 24`` and friends) —
+    frame geometry constants are written as shifts for readability."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return node.value
+    if isinstance(node, ast.BinOp):
+        left, right = _const_int(node.left), _const_int(node.right)
+        if left is None or right is None:
+            return None
+        if isinstance(node.op, ast.LShift):
+            return left << right
+        if isinstance(node.op, ast.Add):
+            return left + right
+        if isinstance(node.op, ast.Mult):
+            return left * right
+    return None
+
+
+# Binary DATA frame constants pinned as one wire_protocol mapping: the
+# header layout and kind/flag values are bytes on the wire — a deployed
+# client decodes yesterday's values forever.
+_FRAME_SCALARS = (
+    "FRAME_MAGIC",
+    "FRAME_VERSION",
+    "FRAME_HEADER_FORMAT",
+    "FRAME_KIND_REQUEST",
+    "FRAME_KIND_SCORES",
+    "FRAME_KIND_ERROR",
+    "FRAME_FLAG_HAS_FIELDS",
+    "FRAME_MAX_PAYLOAD",
+)
+
+
 def _extract_wire_protocol(tree) -> dict:
     out = {}
     codes = {}
     prefixes = {}
+    frame = {}
     for name, value in _module_assigns(tree):
         if name == "WIRE_CODES":
             seq = _const_seq(value)
             if seq is not None:
                 out["WIRE_CODES"] = seq
+        elif name == "FRAME_STATUS_CODES":
+            seq = _const_seq(value)
+            if seq is not None:
+                out["FRAME_STATUS_CODES"] = seq
+        elif name in _FRAME_SCALARS:
+            if isinstance(value, ast.Constant):
+                v = value.value
+                frame[name] = v.decode("latin-1") if isinstance(v, bytes) else v
+            else:
+                folded = _const_int(value)
+                if folded is not None:
+                    frame[name] = folded
         elif name.endswith("_READY_PREFIX") and isinstance(value, ast.Constant):
             prefixes[name] = value.value
     for node in tree.body:
@@ -204,6 +250,8 @@ def _extract_wire_protocol(tree) -> dict:
         out["exception_codes"] = codes
     if prefixes:
         out["ready_prefixes"] = prefixes
+    if frame:
+        out["frame"] = frame
     return out
 
 
@@ -343,6 +391,7 @@ _ORDERED = {
     ("fault_kinds", "STREAM_FAULT_KINDS"),
     ("telemetry_schemas", "ENVELOPE_FIELDS"),
     ("wire_protocol", "WIRE_CODES"),
+    ("wire_protocol", "FRAME_STATUS_CODES"),
 }
 
 

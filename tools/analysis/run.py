@@ -94,6 +94,12 @@ _WHOLE_REPO_ANCHORS = (
     "fast_tffm_tpu/config.py",
     "sample.cfg",
     "DESIGN.md",
+    # Every formats.lock.json registry source: editing one (e.g. a new
+    # wire frame constant in serving/protocol.py) must trigger the
+    # formats rule, which a --changed-only subset would otherwise skip.
+    *sorted(set(check_formats.SECTIONS.values())),
+    "fast_tffm_tpu/training.py",  # checkpoint_members' cursor keys
+    "tools/analysis/" + check_formats.LOCK_BASENAME,
 )
 
 

@@ -34,7 +34,11 @@ stream pins the acceptance: ZERO hung or unanswered clients (every
 request gets a score or a typed code), every DELIVERED score
 bit-identical to a fault-free baseline run of the same request set,
 replica restart MTTR measured, and zero steady-state recompiles on
-every replica.  Writes PROBE_SERVE_CHAOS_r08.json.
+every replica.  Since PR 16 the DATA path rides the binary frame wire
+pinned to a replica (serving/client.py FrameConnection) — killing the
+pinned replica exercises the client's retry-once-on-peer failover —
+while ops stay JSONL through the front end.  Writes
+PROBE_SERVE_CHAOS_r16.json.
 
 Usage:
   python tools/chaos.py [--trials 3] [--seed 1106] [--sharded]
@@ -302,22 +306,31 @@ def _serve_lines(n: int, seed: int) -> list[str]:
 
 
 def _client(port):
-    """Pipelined connection keeping every response keyed by id, so the
-    probe can diff delivered scores against the baseline run (the shared
-    client's default routing does exactly that)."""
+    """JSONL CONTROL connection to the front end (stats/ping/slow) — ops
+    stay on the line protocol; only the DATA path rides frames."""
     from fast_tffm_tpu.serving.client import ServeConnection
 
     return ServeConnection(port)
 
 
-def _drive(client, lines, base: int, qps: float, events=None):
-    """Send every line (ids base+i) at ~qps; fire ``events`` (callables
-    keyed by send-index) along the way — the chaos schedule rides the
-    request stream, so faults land mid-traffic."""
+def _parse_serve_lines(lines):
+    from fast_tffm_tpu.data.libsvm import parse_lines
+
+    return parse_lines(lines, vocabulary_size=4096, max_nnz=6)
+
+
+def _drive_frames(fc, parsed, base: int, qps: float, events=None):
+    """Send every row (req_ids base+i) as a 1-row binary REQUEST frame
+    at ~qps; fire ``events`` (callables keyed by send-index) along the
+    way — the chaos schedule rides the request stream, so faults land
+    mid-traffic.  1-row frames keep the schedule at request granularity
+    AND exercise the failover resend path per request."""
+    import numpy as np
+
     events = events or {}
     interval = 1.0 / qps
     t_next = time.perf_counter()
-    for i, line in enumerate(lines):
+    for i in range(parsed.batch_size):
         if i in events:
             events[i]()
         now = time.perf_counter()
@@ -325,13 +338,19 @@ def _drive(client, lines, base: int, qps: float, events=None):
             time.sleep(t_next - now)
         t_next += interval
         klass = "gold" if i % 10 == 0 else "std"
-        client.send({"id": base + i, "line": line, "class": klass})
+        fc.send_batch(
+            np.array([base + i], np.uint32),
+            parsed.ids[i : i + 1],
+            parsed.vals[i : i + 1],
+            fields=parsed.fields[i : i + 1] if fc.uses_fields else None,
+            klass=klass,
+        )
 
 
 def _serve_chaos(args) -> int:
     from fast_tffm_tpu.resilience import FaultPlan
 
-    out_path = args.out or os.path.join(REPO, "PROBE_SERVE_CHAOS_r08.json")
+    out_path = args.out or os.path.join(REPO, "PROBE_SERVE_CHAOS_r16.json")
     plan = FaultPlan.parse(args.serve_plan, seed=args.seed)
     serving = plan.serving_events()
     if not serving:
@@ -360,21 +379,23 @@ def _serve_chaos(args) -> int:
         with open(model_file, "rb") as f:
             good_bytes = f.read()
 
-        from fast_tffm_tpu.serving.client import spawn_serve
+        from fast_tffm_tpu.serving.client import FrameConnection, spawn_serve
+
+        parsed = _parse_serve_lines(lines)
 
         # ---- baseline: fault-free, same request set --------------------
         proc, port = spawn_serve(cfg_path)
         try:
-            client = _client(port)
-            _drive(client, lines, base=0, qps=SERVE_QPS)
-            missing = client.wait_answered(range(len(lines)), timeout=60)
+            fc = FrameConnection(port)
+            _drive_frames(fc, parsed, base=0, qps=SERVE_QPS)
+            missing = fc.wait_answered(range(len(lines)), timeout=60)
             assert not missing, f"baseline left {len(missing)} unanswered"
-            with client.lock:
+            with fc.lock:
                 baseline = {
-                    i: client.responses[i].get("score")
+                    i: (fc.results[i][1] if fc.results[i][0] == "ok" else None)
                     for i in range(len(lines))
                 }
-            client.close()
+            fc.close()
         finally:
             proc.terminate()
             try:
@@ -392,7 +413,10 @@ def _serve_chaos(args) -> int:
         proc, port = spawn_serve(cfg_path)
         hard_fail = None
         try:
-            client = _client(port)
+            client = _client(port)  # CONTROL (JSONL): stats/ping/slow
+            fc = FrameConnection(port)  # DATA (binary, replica-pinned)
+            result["wire"] = "binary"
+            result["pinned_replica"] = fc.replica
             stats0 = client.request({"op": "stats"}, timeout=60)
             pids = {r["replica"]: r["pid"] for r in stats0["replicas"]}
             t_kill = [None]
@@ -428,10 +452,11 @@ def _serve_chaos(args) -> int:
                 SERVE_REQUESTS // 4 + k * step: (lambda e=e: fire(e))
                 for k, e in enumerate(serving)
             }
-            _drive(client, lines, base=10_000, qps=SERVE_QPS, events=events)
+            _drive_frames(fc, parsed, base=10_000, qps=SERVE_QPS, events=events)
             ids = [10_000 + i for i in range(len(lines))]
-            missing = client.wait_answered(ids, timeout=120)
+            missing = fc.wait_answered(ids, timeout=120)
             result["unanswered"] = len(missing)
+            result["client_failovers"] = fc.failovers
 
             # Heal the corrupt checkpoint: the watcher must pick the good
             # bytes back up (same content ⇒ same scores) — reload
@@ -441,21 +466,22 @@ def _serve_chaos(args) -> int:
                 with open(model_file, "wb") as f:
                     f.write(good_bytes)
 
-            with client.lock:
-                answered = dict(client.responses)
+            with fc.lock:
+                answered = dict(fc.results)
             scored = mismatched = typed = 0
             codes: dict[str, int] = {}
             for i in range(len(lines)):
                 r = answered.get(10_000 + i)
                 if r is None:
                     continue
-                if "score" in r:
+                status, score = r
+                if status == "ok":
                     scored += 1
-                    if r["score"] != baseline.get(i):
+                    if score != baseline.get(i):
                         mismatched += 1
                 else:
                     typed += 1
-                    codes[r.get("code", "?")] = codes.get(r.get("code", "?"), 0) + 1
+                    codes[status] = codes.get(status, 0) + 1
             result.update(
                 scored=scored,
                 typed_errors=typed,
@@ -497,6 +523,7 @@ def _serve_chaos(args) -> int:
             result["all_healthy_after"] = bool(
                 snap and all(r["state"] == "healthy" for r in snap["replicas"])
             )
+            fc.close()
             client.close()
         except Exception as e:  # the probe must always write its verdict
             hard_fail = repr(e)
@@ -547,7 +574,7 @@ def main(argv=None) -> int:
     ap.add_argument("--serve", action="store_true",
                     help="chaos the SERVING tier: a live 2-replica socket "
                     "front end under replica kill/slow/corrupt faults "
-                    "(writes PROBE_SERVE_CHAOS_r08.json)")
+                    "(writes PROBE_SERVE_CHAOS_r16.json)")
     ap.add_argument("--serve-plan",
                     default="replica_kill@0,replica_slow@1:150,reload_corrupt@0",
                     metavar="SPEC",

@@ -57,7 +57,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
-from fast_tffm_tpu.serving.client import ServeConnection, spawn_serve
+from fast_tffm_tpu.serving.client import (
+    FrameConnection,
+    ServeConnection,
+    WireRefused,
+    spawn_serve,
+)
+from fast_tffm_tpu.serving.protocol import FRAME_HEADER, pack_request_frame
 
 
 def synth_lines(cfg, n: int, max_nnz: int, seed: int) -> list[str]:
@@ -320,6 +326,267 @@ def run_open_socket(conns: list[ServeConnection], lines, args, mix, res: Results
         time.sleep(0.01)
 
 
+def build_frame_pool(lines, cfg, mix, rows, seed, uses_fields, deadline_ms,
+                     n_templates: int = 256):
+    """Pre-packed REQUEST frame templates (req_ids zeroed — the sender
+    patches a fresh range in per send, one bytes-concat).  Packing lives
+    HERE, outside the timed loop, so the measured client cost per frame
+    is one concat + one sendall.  One class per template (drawn from the
+    mix) so server-side per-class latency attribution stays exact."""
+    from fast_tffm_tpu.data.libsvm import parse_lines
+
+    pb = parse_lines(
+        lines,
+        vocabulary_size=cfg.vocabulary_size,
+        hash_feature_id_flag=cfg.hash_feature_id,
+        max_nnz=cfg.max_nnz if cfg.max_nnz > 0 else None,
+    )
+    rng = np.random.default_rng(seed)
+    dl = np.full(rows, deadline_ms, np.float32) if deadline_ms else None
+    pool = []
+    for _ in range(n_templates):
+        klass = draw_class(rng, mix)
+        sel = rng.integers(0, pb.batch_size, size=rows)
+        data = pack_request_frame(
+            np.zeros(rows, np.uint32),
+            pb.ids[sel],
+            pb.vals[sel],
+            fields=pb.fields[sel] if uses_fields else None,
+            deadlines_ms=dl,
+            classes=[klass] * rows if klass else None,
+        )
+        pool.append((data, rows, klass))
+    return pool
+
+
+def _frame_cb(meta: dict, res: Results):
+    """Per-connection on_result sink: meta maps req_id -> (t_send, klass);
+    runs on the connection's reader thread."""
+
+    def cb(rid, status, score):
+        m = meta.pop(rid, None)
+        if m is None:
+            return
+        t0, klass = m
+        if status == "ok":
+            res.ok(klass, time.perf_counter() - t0)
+        else:
+            res.err(status)
+
+    return cb
+
+
+def run_open_frames(conns, metas, pool, rows, args, res: Results):
+    """Open-loop over the binary wire: each pinned connection runs an
+    independent Poisson schedule of FRAMES at (qps/C)/rows — offered load
+    is still counted in requests (rows), so QPS math matches the JSONL
+    path."""
+    hdr = FRAME_HEADER.size
+    per_conn_fps = args.qps / len(conns) / rows
+    t_end = time.perf_counter() + args.duration
+    cap_frames = max(1, args.requests // (len(conns) * rows))
+
+    def sender(ci: int, conn: FrameConnection, meta: dict):
+        rng = np.random.default_rng(args.seed + ci)
+        rid = 1
+        ti = ci
+        sent = 0
+        t_next = time.perf_counter()
+        while time.perf_counter() < t_end and sent < cap_frames:
+            now = time.perf_counter()
+            if now < t_next:
+                time.sleep(min(t_next - now, 0.002))
+                continue
+            t_next += rng.exponential(1.0 / per_conn_fps)
+            data, n, klass = pool[ti % len(pool)]
+            rids = np.arange(rid, rid + n, dtype=np.uint32)
+            buf = data[:hdr] + rids.tobytes() + data[hdr + 4 * n:]
+            t0 = time.perf_counter()
+            for r in range(rid, rid + n):
+                meta[r] = (t0, klass)
+            try:
+                conn.send_packed(buf, rids)
+            except OSError:
+                for r in range(rid, rid + n):
+                    meta.pop(r, None)
+                    res.err("unavailable")
+            res.on_sent(n)
+            rid += n
+            sent += 1
+            ti += len(conns)
+
+    threads = [
+        # daemon: abandonable on SIGINT, same as the JSONL sender pool
+        threading.Thread(target=sender, args=(ci, c, m), daemon=True)
+        for ci, (c, m) in enumerate(zip(conns, metas))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and any(c.inflight() for c in conns):
+        time.sleep(0.01)
+
+
+def drive_open(host, port, lines, cfg, args, mix, res: Results, sync=None) -> dict:
+    """One process's open-loop drive: negotiate the wire (binary unless
+    refused or --wire jsonl), run the schedule, drain.  ``sync`` (worker
+    mode) is called after all pre-pack/connect setup and right before
+    the timed loop — the multi-process start barrier.  Returns the
+    transport facts + measured wall."""
+    wire = args.wire
+    conns: list[FrameConnection] = []
+    metas: list[dict] = []
+    if wire == "binary":
+        try:
+            for _ in range(args.connections):
+                meta: dict = {}
+                conns.append(
+                    FrameConnection(port, host=host, on_result=_frame_cb(meta, res))
+                )
+                metas.append(meta)
+        except WireRefused as e:
+            for c in conns:
+                c.close()
+            conns, metas = [], []
+            wire = "jsonl"
+            print(f"loadgen: {e}; falling back to JSONL", file=sys.stderr)
+    if wire == "binary":
+        try:
+            rows = max(1, min(args.frame_rows, min(c.max_frame_rows for c in conns)))
+            pool = build_frame_pool(
+                lines, cfg, mix, rows, args.seed, conns[0].uses_fields,
+                args.deadline_ms,
+            )
+            if sync is not None:
+                sync()
+            t0 = time.perf_counter()
+            run_open_frames(conns, metas, pool, rows, args, res)
+            wall = time.perf_counter() - t0
+            return {
+                "wire": "binary",
+                "frame_rows": rows,
+                "client_failovers": sum(c.failovers for c in conns),
+                "unanswered": sum(c.inflight() for c in conns),
+                "wall": wall,
+            }
+        finally:
+            for c in conns:
+                c.close()
+    jconns = [bench_connection(port, host, res) for _ in range(args.connections)]
+    try:
+        if sync is not None:
+            sync()
+        t0 = time.perf_counter()
+        run_open_socket(jconns, lines, args, mix, res)
+        wall = time.perf_counter() - t0
+        return {
+            "wire": "jsonl",
+            "unanswered": sum(c.inflight() for c in jconns),
+            "wall": wall,
+        }
+    finally:
+        for c in jconns:
+            c.close()
+
+
+def run_worker(args, cfg, lines, mix) -> int:
+    """Hidden --worker mode for --processes: drive qps/N against a LIVE
+    front end, then print ONE JSON line of raw results (per-class
+    latency lists in seconds) for the parent to merge.  Start barrier:
+    prints WORKER_READY after setup, blocks on a stdin line."""
+    host, _, port = args.connect.rpartition(":")
+    host, port = host or "127.0.0.1", int(port)
+    res = Results()
+
+    def sync():
+        print("WORKER_READY", flush=True)
+        sys.stdin.readline()
+
+    extra = drive_open(host, port, lines, cfg, args, mix, res, sync=sync)
+    with res._lock:
+        out = {
+            "sent": res.sent,
+            "codes": res.codes,
+            "lat": {k: [round(x, 6) for x in v]
+                    for k, v in res.lat_by_class.items()},
+            **extra,
+        }
+    print(json.dumps(out, separators=(",", ":")))
+    return 0
+
+
+def run_multiprocess(args, host, port, res: Results) -> dict:
+    """Fan the open-loop schedule across N worker PROCESSES (qps/N each)
+    — one Python process tops out near ~25k offered QPS on send-side
+    CPU alone; 50k+ needs real parallelism, which the GIL won't give
+    threads.  Workers pre-pack, barrier on WORKER_READY/GO, then drive;
+    the parent merges raw per-class latencies so percentiles are
+    computed over the UNION, not averaged."""
+    n = args.processes
+    cmd_base = [
+        sys.executable, os.path.abspath(__file__), args.config,
+        "--worker", "--connect", f"{host}:{port}",
+        "--mode", "open",
+        "--qps", str(args.qps / n),
+        "--duration", str(args.duration),
+        "--connections", str(args.connections),
+        "--wire", args.wire,
+        "--frame-rows", str(args.frame_rows),
+        "--deadline-ms", str(args.deadline_ms),
+        "--requests", str(max(1, args.requests // n)),
+    ]
+    if args.classes:
+        cmd_base += ["--classes", args.classes]
+    if args.input:
+        cmd_base += ["--input", args.input]
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            cmd_base + ["--seed", str(args.seed + 1000 * k)],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True, env=env,
+        )
+        for k in range(n)
+    ]
+    try:
+        for p in procs:  # barrier: every worker finished pre-packing
+            line = p.stdout.readline()
+            if not line.startswith("WORKER_READY"):
+                raise RuntimeError(f"worker died during setup: {line!r}")
+        for p in procs:  # fire together
+            p.stdin.write("GO\n")
+            p.stdin.flush()
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=args.duration + 300)
+            if p.returncode != 0:
+                raise RuntimeError(f"worker exited rc={p.returncode}")
+            outs.append(json.loads(out.strip().splitlines()[-1]))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for o in outs:
+        res.sent += o["sent"]
+        for code, c in o["codes"].items():
+            res.codes[code] = res.codes.get(code, 0) + c
+        for klass, lat in o["lat"].items():
+            res.lat.extend(lat)
+            res.lat_by_class.setdefault(klass, []).extend(lat)
+    return {
+        "processes": n,
+        "wire": outs[0].get("wire"),
+        "frame_rows": outs[0].get("frame_rows"),
+        "client_failovers": sum(o.get("client_failovers", 0) for o in outs),
+        "unanswered": sum(o["unanswered"] for o in outs),
+        "wall": max(o["wall"] for o in outs),
+    }
+
+
 def run_closed_socket(port, host, lines, args, mix, res: Results):
     stop = time.perf_counter() + args.duration
     lock = threading.Lock()
@@ -513,6 +780,24 @@ def main(argv=None) -> int:
         "(the multi-connection sender that makes 10k+ QPS drivable)",
     )
     ap.add_argument(
+        "--wire", choices=("binary", "jsonl"), default="binary",
+        help="DATA wire for the socket open loop: binary frames pinned to "
+        "a replica (negotiated — falls back to JSONL when the server "
+        "refuses), or force the per-line JSONL path",
+    )
+    ap.add_argument(
+        "--frame-rows", type=int, default=32, metavar="R",
+        help="rows coalesced per binary REQUEST frame (clamped to the "
+        "replica's negotiated max_frame_rows)",
+    )
+    ap.add_argument(
+        "--processes", type=int, default=1, metavar="N",
+        help="open-loop socket mode: fan the schedule across N worker "
+        "processes at qps/N each (one Python sender tops out ~25k offered; "
+        "50k+ needs processes, not threads)",
+    )
+    ap.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument(
         "--classes", default=None, metavar="MIX",
         help="client-class traffic mix, e.g. gold:0.1,std:0.9 (tiers come "
         "from the server's serve_classes)",
@@ -566,6 +851,16 @@ def main(argv=None) -> int:
         ap.error("--connections must be >= 1")
     if args.connect and args.spawn:
         ap.error("--connect and --spawn are mutually exclusive")
+    if args.frame_rows < 1:
+        ap.error("--frame-rows must be >= 1")
+    if args.processes < 1:
+        ap.error("--processes must be >= 1")
+    if args.processes > 1 and not (args.connect or args.spawn):
+        ap.error("--processes requires the socket transport (--connect/--spawn)")
+    if args.processes > 1 and args.mode != "open":
+        ap.error("--processes is an open-loop fan-out (use --mode open)")
+    if args.worker and not args.connect:
+        ap.error("--worker requires --connect (the parent owns the tier)")
     mix = parse_class_mix(args.classes) if args.classes else None
 
     from fast_tffm_tpu.config import build_model, load_config
@@ -631,6 +926,9 @@ def main(argv=None) -> int:
         lines = synth_lines(cfg, 4096, width, args.seed)
         print(f"loadgen: synthesized {len(lines)} request lines", file=sys.stderr)
 
+    if args.worker:
+        return run_worker(args, cfg, lines, mix)
+
     res = Results()
     result: dict = {
         "bench": "BENCH_SERVE",
@@ -654,29 +952,25 @@ def main(argv=None) -> int:
             host, port = host or "127.0.0.1", int(port)
             warmup_s = 0.0
         try:
-            t0 = time.perf_counter()
             if args.mode == "open":
-                conns = [
-                    bench_connection(port, host, res)
-                    for _ in range(args.connections)
-                ]
-                try:
-                    run_open_socket(conns, lines, args, mix, res)
-                    # The no-hung-client pin: anything STILL unresolved
-                    # after the drain window never got its one response.
-                    result["unanswered"] = sum(c.inflight() for c in conns)
-                    stats = conns[0].request({"op": "stats"}, timeout=60)
-                finally:
-                    for c in conns:
-                        c.close()
+                if args.processes > 1:
+                    extra = run_multiprocess(args, host, port, res)
+                else:
+                    extra = drive_open(host, port, lines, cfg, args, mix, res)
+                wall = extra.pop("wall")
+                # The no-hung-client pin: anything STILL unresolved after
+                # the drain window never got its one response.
+                result["unanswered"] = extra.pop("unanswered")
+                result.update(extra)
             else:
+                t0 = time.perf_counter()
                 run_closed_socket(port, host, lines, args, mix, res)
-                c = ServeConnection(port, host=host)
-                try:
-                    stats = c.request({"op": "stats"}, timeout=60)
-                finally:
-                    c.close()
-            wall = time.perf_counter() - t0
+                wall = time.perf_counter() - t0
+            c = ServeConnection(port, host=host)
+            try:
+                stats = c.request({"op": "stats"}, timeout=60)
+            finally:
+                c.close()
             engines = stats.get("engines", {})
             steady = [
                 e.get("steady_compiles")

@@ -60,6 +60,25 @@ def _fmt(v, nd=1) -> str:
     return f"{v:,}"
 
 
+def _fmt_bucket_occupancy(sv: dict) -> str:
+    """``64: 0.81 (5917/7296 rows)`` per bucket, smallest bucket first.
+
+    Occupancy per bucket, not just the blended mean: the blend hides a
+    single oversized bucket absorbing every coalesced flush (the 0.286
+    pathology) behind healthy-looking small-bucket numbers."""
+    occ = sv.get("bucket_occupancy") or {}
+    rows = sv.get("bucket_rows") or {}
+    padded = sv.get("bucket_padded_rows") or {}
+    parts = []
+    for k in sorted(occ, key=lambda x: int(x)):
+        r = rows.get(k)
+        p = padded.get(k)
+        total = (r + p) if isinstance(r, int) and isinstance(p, int) else None
+        detail = f" ({r}/{total} rows)" if total is not None else ""
+        parts.append(f"{k}: {occ[k]}{detail}")
+    return ", ".join(parts) or "–"
+
+
 def _fmt_bytes(v) -> str:
     if v is None:
         return "–"
@@ -481,6 +500,22 @@ def summarize(records: list[dict]) -> dict:
                 class_p99[k] = max(class_p99.get(k, 0.0), p99)
     s["sheds_by_class"] = sheds
     s["class_p99_ms"] = class_p99
+    # Per-bucket padding waste, summed across replicas (the occupancy
+    # fix's observability: bucket chosen AFTER the coalescing flush).
+    b_rows: dict[str, int] = {}
+    b_padded: dict[str, int] = {}
+    for r in snaps:
+        for k, v in (r.get("bucket_rows") or {}).items():
+            b_rows[k] = b_rows.get(k, 0) + (v or 0)
+        for k, v in (r.get("bucket_padded_rows") or {}).items():
+            b_padded[k] = b_padded.get(k, 0) + (v or 0)
+    s["bucket_rows"] = b_rows
+    s["bucket_padded_rows"] = b_padded
+    s["bucket_occupancy"] = {
+        k: round(r / (r + b_padded.get(k, 0)), 4)
+        for k, r in sorted(b_rows.items(), key=lambda kv: int(kv[0]))
+        if r + b_padded.get(k, 0) > 0
+    }
     s["replica_faults"] = sum(
         1 for r in faults if isinstance(r.get("replica"), int)
     )
@@ -850,6 +885,8 @@ def render(s: dict, title: str = "run") -> str:
                 f"- {stage}: p50 {h.get('p50')}, p95 {h.get('p95')}, "
                 f"p99 {h.get('p99')}, max {h.get('max')}"
             )
+        if sv.get("bucket_occupancy"):
+            L.append("- per-bucket occupancy: " + _fmt_bucket_occupancy(sv))
         L.append("")
     if s.get("serving_replicas") or s.get("replica_faults"):
         L += ["## Serving resilience (replicated tier)", ""]
@@ -883,6 +920,17 @@ def render(s: dict, title: str = "run") -> str:
                 "- per-class p99 (worst replica): "
                 + ", ".join(
                     f"{k}={v}ms" for k, v in sorted(s["class_p99_ms"].items())
+                )
+            )
+        if s.get("bucket_occupancy"):
+            L.append(
+                "- per-bucket occupancy (all replicas): "
+                + _fmt_bucket_occupancy(
+                    {
+                        "bucket_rows": s.get("bucket_rows"),
+                        "bucket_padded_rows": s.get("bucket_padded_rows"),
+                        "bucket_occupancy": s["bucket_occupancy"],
+                    }
                 )
             )
         L.append(
@@ -1118,6 +1166,112 @@ def compare(run: dict, base: dict, threshold: float, strict: bool = False):
         L.append(f"OK — no regression beyond the {threshold * 100:.0f}% threshold.")
     L.append("")
     return "\n".join(L), regressions
+
+
+# -- serving bench (loadgen artifacts) ------------------------------------
+
+
+def load_bench_serve(path: str) -> dict:
+    """A ``tools/loadgen.py --out`` artifact (BENCH_SERVE_rNN.json);
+    raises ValueError on anything else."""
+    with open(path) as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or data.get("bench") != "BENCH_SERVE":
+        raise ValueError(
+            f"{path}: not a BENCH_SERVE artifact (tools/loadgen.py --out)"
+        )
+    return data
+
+
+def render_bench_serve(b: dict, base: dict | None = None) -> str:
+    """The "Serving bench" section: offered vs scored QPS, per-class
+    client latency, and typed shed counts from a loadgen artifact (with
+    ``base``, side by side against the previous round's)."""
+    L = ["## Serving bench (loadgen)", ""]
+    rows = [
+        ("offered QPS", "qps_target"),
+        ("scored QPS", "qps_achieved"),
+        ("requests sent", "requests_sent"),
+        ("requests scored", "requests_scored"),
+        ("unanswered", "unanswered"),
+        ("wire", "wire"),
+        ("sender processes", "processes"),
+        ("connections", "connections"),
+        ("client failovers", "client_failovers"),
+        ("deadline (ms)", "deadline_ms"),
+    ]
+    if base is None:
+        L += ["| metric | run |", "|---|---:|"]
+        for label, key in rows:
+            L.append(f"| {label} | {_fmt(b.get(key)) if not isinstance(b.get(key), str) else b[key]} |")
+    else:
+        L += ["| metric | base | run |", "|---|---:|---:|"]
+        for label, key in rows:
+            bv, rv = base.get(key), b.get(key)
+            bs = bv if isinstance(bv, str) else _fmt(bv)
+            rs = rv if isinstance(rv, str) else _fmt(rv)
+            L.append(f"| {label} | {bs} | {rs} |")
+    for klass, h in sorted((b.get("client_ms_by_class") or {}).items()):
+        bh = ((base or {}).get("client_ms_by_class") or {}).get(klass) or {}
+        vs = f" (base p99 {bh.get('p99')})" if bh else ""
+        L.append(
+            f"- class {klass!r}: client p50 {h.get('p50')}ms, "
+            f"p99 {h.get('p99')}ms over {_fmt(h.get('count'))} scored{vs}"
+        )
+    codes = b.get("shed_codes") or {}
+    if codes:
+        L.append(
+            "- typed sheds: "
+            + ", ".join(f"{k}={v}" for k, v in sorted(codes.items()))
+        )
+    L.append("")
+    return "\n".join(L)
+
+
+def compare_bench_serve(run_b: dict, base_b: dict, threshold: float) -> list[str]:
+    """Strict-gate regressions between two BENCH_SERVE artifacts: scored
+    QPS down past the threshold, any per-class CLIENT p99 up past it,
+    and unanswered requests where the base had none.  Client-side
+    latency, not engine-side: the queueing a saturated data plane hides
+    from engine histograms is exactly what the client clock sees."""
+    regressions = []
+    rq, bq = run_b.get("qps_achieved"), base_b.get("qps_achieved")
+    if (
+        isinstance(rq, (int, float))
+        and isinstance(bq, (int, float))
+        and bq > 0
+        and rq < bq * (1 - threshold)
+    ):
+        regressions.append(
+            f"serving scored QPS regressed {(bq - rq) / bq * 100:.1f}% "
+            f"(> {threshold * 100:.0f}%): {bq} -> {rq}"
+        )
+    elif rq is None and isinstance(bq, (int, float)) and bq > 0:
+        regressions.append(
+            f"run bench has no qps_achieved (base scored {bq}) — "
+            "loadgen died before writing results?"
+        )
+    for klass, bh in sorted((base_b.get("client_ms_by_class") or {}).items()):
+        bp = (bh or {}).get("p99")
+        rp = ((run_b.get("client_ms_by_class") or {}).get(klass) or {}).get("p99")
+        if (
+            isinstance(rp, (int, float))
+            and isinstance(bp, (int, float))
+            and bp > 0
+            and rp > bp * (1 + threshold)
+        ):
+            regressions.append(
+                f"serving bench class {klass!r} client p99 regressed "
+                f"{(rp - bp) / bp * 100:.1f}% (> {threshold * 100:.0f}%): "
+                f"{bp}ms -> {rp}ms"
+            )
+    if (run_b.get("unanswered") or 0) > (base_b.get("unanswered") or 0):
+        regressions.append(
+            f"serving bench unanswered requests: "
+            f"{base_b.get('unanswered') or 0} -> {run_b.get('unanswered') or 0} "
+            "(every admitted request must resolve to a score or a typed shed)"
+        )
+    return regressions
 
 
 # -- static analysis ------------------------------------------------------
@@ -1359,6 +1513,19 @@ def main(argv=None) -> int:
         metavar="JSON",
         help="baseline run's analysis JSON for the debt-growth gate",
     )
+    ap.add_argument(
+        "--bench-serve",
+        metavar="JSON",
+        help="serving bench artifact (tools/loadgen.py --out, "
+        "BENCH_SERVE_rNN.json): render a Serving bench section; with "
+        "--strict and --bench-serve-base, gate on scored-QPS and "
+        "per-class client-p99 regressions past --threshold",
+    )
+    ap.add_argument(
+        "--bench-serve-base",
+        metavar="JSON",
+        help="baseline round's serving bench artifact for the QPS/p99 gate",
+    )
     args = ap.parse_args(argv)
 
     def _load_many(paths):
@@ -1384,6 +1551,23 @@ def main(argv=None) -> int:
             file=sys.stderr,
         )
         return 2
+    if args.bench_serve_base and not args.bench_serve:
+        print(
+            "report: --bench-serve-base requires --bench-serve (the run's "
+            "own bench artifact) — QPS/p99 gate would be silently skipped",
+            file=sys.stderr,
+        )
+        return 2
+    bench_run = bench_base = None
+    if args.bench_serve:
+        try:
+            bench_run = load_bench_serve(args.bench_serve)
+            if args.bench_serve_base:
+                bench_base = load_bench_serve(args.bench_serve_base)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"report: {e}", file=sys.stderr)
+            return 2
+        text = text + "\n" + render_bench_serve(bench_run, bench_base)
     run_analysis = base_analysis = None
     if args.analysis:
         try:
@@ -1424,6 +1608,25 @@ def main(argv=None) -> int:
         text = text + "\n" + cmp_text
         if regressions:
             rc = 1
+    # The serving-bench gate rides on --strict alone (no --compare
+    # needed): CI keeps only the BENCH_SERVE artifacts between rounds,
+    # not the raw telemetry JSONLs.
+    if args.strict and bench_run is not None:
+        if bench_base is None:
+            print(
+                "report: note: --bench-serve given without "
+                "--bench-serve-base — serving bench gate skipped",
+                file=sys.stderr,
+            )
+        else:
+            extra = compare_bench_serve(bench_run, bench_base, args.threshold)
+            if extra:
+                text += (
+                    "\n**SERVING BENCH REGRESSED:**\n"
+                    + "\n".join(f"- {r}" for r in extra)
+                    + "\n"
+                )
+                rc = 1
     if args.out:
         # tmp + os.replace, inline (this tool stays stdlib-only): a
         # regenerated report must never be readable half-written.
