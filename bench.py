@@ -197,6 +197,36 @@ def modeled_fused_step_bytes(ids_batches, d, vocab, cap, batch_scale=1):
     return parts, int(sum(parts.values())), uniq
 
 
+def modeled_pallas_tail_step_bytes(ids_batches, d, vocab, cap, batch_scale=1):
+    """LOWER-BOUND HBM bytes/step for the fused layout under the PALLAS
+    one-pass tail (ops/pallas_tail.py): same forward as the XLA fused
+    program (ids read, wide tile-row gather, per-occurrence grad rows),
+    but the tail's ``gbuild_scatter_rw`` and ``bitmap_cumsum_rw`` terms
+    are GONE — the kernel dedups at logical granularity ([M, D] grads
+    through the sort/segment-sum pipeline, whose [M]-key passes are
+    excluded by the same convention as modeled_step_bytes) and then moves
+    each touched row's D+1-lane slot exactly twice: ONE gather read and
+    ONE scatter write over the merged table+accumulator columns, instead
+    of the grad-build/bitmap/cumsum/RMW-gather/RMW-scatter chain."""
+    m = ids_batches[0].shape[0] * ids_batches[0].shape[1] * batch_scale
+    uniq = (
+        float(np.mean([np.unique(np.asarray(b)).size for b in ids_batches]))
+        * batch_scale  # upper bound at batch_scale > 1 (unions overlap)
+    )
+    k_rows = min(cap if cap > 0 else m, m, int(uniq) or m)
+    row_b = 128 * 4
+    slot_b = (d + 1) * 4  # the row's merged params+accumulator lanes
+    parts = {
+        "ids_read": m * 4,
+        "fwd_gather_read": m * row_b,
+        "grad_rows_write": m * d * 4,
+        "segsum_write": m * d * 4,
+        "tail_gather_read": int(k_rows * slot_b),
+        "tail_scatter_write": int(k_rows * slot_b),
+    }
+    return parts, int(sum(parts.values())), uniq
+
+
 def scale_state(vocab, k):
     """TrainState with a [V, 1+k] table + ROW-mode accumulator, built
     in-place on device (init_state's bias/factor concat would peak at 2×
@@ -756,6 +786,110 @@ def main():
         # device nominally has — a flag to audit, not hide (see DESIGN
         # §6 roofline entry for the reconciliation on this box).
         results["scale_implied_over_nominal"] = round(implied / nominal, 2)
+
+    # --- sparse-tail A/B: XLA program chain vs one-pass Pallas kernel ---
+    # BENCH_TAIL_MODES (default "xla,pallas") selects which tails run at
+    # the rung's B=16384 operating point.  Each mode records ex/s plus
+    # bytes/example BOTH ways — measured (Lowered.cost_analysis via
+    # profiling.program_cost, no second backend compile) and modeled
+    # (the per-tail lower-bound formula) — so tools/report.py can render
+    # the two tails side by side against the HBM roof.  Off-TPU the
+    # kernel would run interpreted, which measures the interpreter, not
+    # the tail, so the pallas leg is SKIPPED (recorded in
+    # scale_fallbacks) and only its modeled bytes are emitted.
+    from fast_tffm_tpu.ops.pallas_common import default_interpret
+    from fast_tffm_tpu.profiling import program_cost
+
+    tail_modes = [
+        m.strip()
+        for m in os.environ.get("BENCH_TAIL_MODES", "xla,pallas").split(",")
+        if m.strip()
+    ]
+    ab = {"batch": BATCH, "modes": {}}
+    ids_16k = [b.ids for b in batches]
+    px_parts, px_total, _ = modeled_fused_step_bytes(
+        ids_16k, 1 + SCALE_K, vocab, SCALE_CAP
+    )
+    pp_parts, pp_total, _ = modeled_pallas_tail_step_bytes(
+        ids_16k, 1 + SCALE_K, vocab, SCALE_CAP
+    )
+
+    def _measured_bpe(fn):
+        cost = program_cost(fn, (state, batches[0]))
+        if cost and cost.get("bytes_accessed"):
+            return round(cost["bytes_accessed"] / BATCH, 1)
+        return None
+
+    for mode in tail_modes:
+        if mode == "xla":
+            ab["modes"]["xla"] = {
+                "value": results["scale_b16384_value"],
+                "modeled_bytes_per_example": round(px_total / BATCH, 1),
+                "modeled_parts": px_parts,
+                "measured_bytes_per_example": _measured_bpe(step),
+            }
+        elif mode == "pallas":
+            entry = {
+                "modeled_bytes_per_example": round(pp_total / BATCH, 1),
+                "modeled_parts": pp_parts,
+            }
+            if default_interpret():
+                entry["skipped"] = "no TPU backend (kernel would interpret)"
+                results.setdefault("scale_fallbacks", []).append(
+                    "tail=pallas A/B skipped: no TPU backend — the kernel "
+                    "would run interpreted, measuring the interpreter"
+                )
+            else:
+                try:
+                    pstep = make_packed_train_step(
+                        model, learning_rate=0.01, compact_cap=SCALE_CAP,
+                        tail="pallas",
+                    )
+                    state, p_rate = measure(pstep, state, batches, iters=20)
+                    entry["value"] = round(p_rate / jax.device_count(), 1)
+                    entry["measured_bytes_per_example"] = _measured_bpe(pstep)
+                    # B=65536 under the NEW program shape: the XLA chain's
+                    # B=65536 compile failure at the 268M rung (BENCH_r05)
+                    # may not reproduce once the tail is one kernel.
+                    # Outcome recorded either way.
+                    try:
+                        big = [
+                            make_batch(
+                                zipf_ids(rng, (SCALE_BATCH_BIG, NNZ), vocab),
+                                200 + i,
+                            )
+                            for i in range(4)
+                        ]
+                        state, pb_rate = measure(
+                            pstep, state, big, iters=8,
+                            batch_size=SCALE_BATCH_BIG,
+                        )
+                        entry["b65536_value"] = round(
+                            pb_rate / jax.device_count(), 1
+                        )
+                        results.setdefault("scale_fallbacks", []).append(
+                            f"tail=pallas: B={SCALE_BATCH_BIG} compiled and "
+                            f"ran at vocab={vocab}"
+                        )
+                        del big
+                    except Exception as e:
+                        entry["b65536_error"] = str(e)[:120]
+                        results.setdefault("scale_fallbacks", []).append(
+                            f"tail=pallas: B={SCALE_BATCH_BIG} failed at "
+                            f"vocab={vocab}: {str(e)[:80]}"
+                        )
+                    if vocab != 1 << 28:
+                        results.setdefault("scale_fallbacks", []).append(
+                            "tail=pallas: 268M-rung B=65536 recheck not "
+                            f"reachable (picked rung vocab={vocab})"
+                        )
+                except Exception as e:
+                    entry["error"] = str(e)[:120]
+                    results.setdefault("scale_fallbacks", []).append(
+                        f"tail=pallas A/B failed: {str(e)[:80]}"
+                    )
+            ab["modes"]["pallas"] = entry
+    results["tail_ab"] = ab
 
     # Uniform ids over the same giant table: the true cold-gather worst
     # case (Zipf's hot head concentrates most gathers on a few cached

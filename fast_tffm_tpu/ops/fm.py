@@ -215,13 +215,14 @@ def fm_score(
         use_pallas = jax.default_backend() == "tpu"
     if use_pallas:
         from fast_tffm_tpu.ops.pallas_anova import anova_inter
+        from fast_tffm_tpu.ops.pallas_common import default_interpret
 
         # Only the DP carries a hand-written (kernel) VJP; the linear term
         # and z = v·x are cheap elementwise ops XLA autodiff handles best.
-        # Off-TPU the kernel runs in the Pallas interpreter, keeping this
-        # public path testable on the CPU mesh.
-        interpret = jax.default_backend() != "tpu"
+        # Off-TPU the kernel runs in the Pallas interpreter
+        # (ops.pallas_common), keeping this public path testable on the
+        # CPU mesh.
         linear = jnp.sum(rows[..., 0] * vals, axis=-1)
         z = rows[..., 1:] * vals[..., None]
-        return linear + anova_inter(z, order, interpret)
+        return linear + anova_inter(z, order, default_interpret())
     return _fm_score_anova(rows, vals, order)

@@ -1,0 +1,46 @@
+"""Shared Pallas kernel plumbing: interpret-mode resolution.
+
+Every Pallas kernel in this package takes an ``interpret`` flag so the
+CPU tier-1 suite can run it in the Pallas interpreter.  The detection
+used to be duplicated at each call site (``jax.default_backend() !=
+"tpu"``); it lives here once so (a) production modules never spell
+``interpret=True`` (the static-analysis suite flags the literal outside
+this module — a compiled path silently running interpreted is a
+throughput bug, not an error), and (b) tests need no per-test plumbing:
+off-TPU the kernels interpret themselves automatically.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["default_interpret", "resolve_interpret", "resolve_tail"]
+
+
+def default_interpret() -> bool:
+    """True off-TPU: run Pallas kernels in the interpreter (CPU tests)."""
+    return jax.default_backend() != "tpu"
+
+
+def resolve_interpret(interpret: bool | None) -> bool:
+    """``None`` (the wrapper default) → auto-detect; a bool is explicit.
+
+    Tests pass ``interpret=True`` explicitly; production call sites pass
+    ``None`` and inherit the backend detection — the one CPU branch the
+    analysis suite sanctions.
+    """
+    return default_interpret() if interpret is None else bool(interpret)
+
+
+def resolve_tail(tail: str) -> str:
+    """``[Train] tail`` → effective sparse-tail implementation.
+
+    ``auto`` picks the Pallas tail on TPU and the XLA tail elsewhere —
+    off-TPU the kernel would run interpreted (orders of magnitude slower
+    than compiled XLA), so auto never selects it there.  An explicit
+    ``pallas`` is honored anywhere (off-TPU it interprets — that is what
+    the tier-1 parity tests run).
+    """
+    if tail == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "xla"
+    return tail

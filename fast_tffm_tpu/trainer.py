@@ -34,6 +34,7 @@ __all__ = [
     "make_train_step",
     "make_decayed_body",
     "make_dedup_body",
+    "make_pallas_tail_body",
     "make_accum_restart",
     "make_scanned_train_step",
     "make_predict_step",
@@ -146,6 +147,57 @@ def make_decayed_body(decay: float):
 
     def body(model, learning_rate, state, batch):
         return train_step_body(model, learning_rate, state, batch, decay)
+
+    return body
+
+
+def make_pallas_tail_body(decay: float = 1.0, interpret: bool | None = None):
+    """``train_step_body`` with the sparse Adagrad tail swapped for the
+    one-pass Pallas kernel (``ops.pallas_tail.rows_tail_adagrad_update``):
+    same gather → fused scorer → loss → dedup front, but the deduped rows
+    move through ONE double-buffered DMA gather→update→scatter pass
+    instead of the XLA gather program + scatter program pair.
+
+    Same ``(model, lr, state, batch)`` body contract as the scanned /
+    device-cache / tiered factories, so it plugs into
+    ``make_train_step(body=...)``, ``make_scanned_train_step(body=...)``,
+    and the tiered paramstore's ``wrap_step`` unchanged — the tiered
+    compact ``[C, D]`` staging table is exactly the operand shape the
+    kernel takes.  γ threads through like ``make_decayed_body`` (γ=1.0
+    is a trace-time branch to the classic expressions — bit-identical,
+    test-pinned).  ``interpret=None`` auto-resolves off the backend
+    (ops.pallas_common); tests pass ``interpret=True`` explicitly."""
+
+    def body(model, learning_rate, state: TrainState, batch: Batch):
+        from fast_tffm_tpu.ops.pallas_tail import rows_tail_adagrad_update
+
+        rows = state.table[batch.ids]
+        grad_fn = jax.value_and_grad(
+            partial(batch_loss, model), argnums=(0, 1), has_aux=True
+        )
+        (_, data_loss), (g_rows, g_dense) = grad_fn(rows, state.dense, batch)
+
+        table, accum = rows_tail_adagrad_update(
+            state.table,
+            state.table_opt.accum,
+            batch.ids,
+            g_rows,
+            learning_rate,
+            decay=decay,
+            interpret=interpret,
+        )
+        dense, dense_opt = state.dense, state.dense_opt
+        if jax.tree.leaves(state.dense):
+            dense, dense_opt = dense_adagrad_update(
+                state.dense, state.dense_opt, g_dense, learning_rate,
+                decay=decay,
+            )
+        return (
+            TrainState(
+                table, AdagradState(accum), dense, dense_opt, state.step + 1
+            ),
+            data_loss,
+        )
 
     return body
 
@@ -333,7 +385,7 @@ def init_packed_state(
 
 def packed_train_step_body(
     model, learning_rate: float, state: TrainState, batch: Batch,
-    update: str = "auto", compact_cap: int = 0,
+    update: str = "auto", compact_cap: int = 0, tail: str = "xla",
 ):
     """train_step_body on a lane-packed table: identical math, tile-row
     physical movement (the narrow-scatter cliff fix — DESIGN §6).
@@ -344,7 +396,12 @@ def packed_train_step_body(
     a dense Adagrad sweep (measured 3.5× the sorted path at vocab 2^24);
     ``compact`` — sort-free touched-row compaction, O(M) buffers (the
     giant-vocab path); ``sorted`` — sort/segment-sum/RMW (bit-parity
-    reference); ``auto`` — dense under DENSE_G_MAX_BYTES, else compact."""
+    reference); ``auto`` — dense under DENSE_G_MAX_BYTES, else compact.
+
+    ``tail = "pallas"`` (fused layout only — config.validate enforces it)
+    replaces the whole XLA update chain with the one-pass Pallas kernel
+    (ops.pallas_tail.fused_tail_adagrad_update); ``update`` is then moot
+    and ``compact_cap`` becomes the kernel's deduped-row cap."""
     from fast_tffm_tpu.ops.packed_table import (
         FUSED_UPDATE_FNS,
         PACKED_UPDATE_FNS,
@@ -368,12 +425,21 @@ def packed_train_step_body(
     (_, data_loss), (g_rows, g_dense) = grad_fn(rows, state.dense, batch)
 
     if fused:
-        from fast_tffm_tpu.ops.packed_table import apply_fused_update
+        if tail == "pallas":
+            from fast_tffm_tpu.ops.pallas_tail import fused_tail_adagrad_update
 
-        mode = resolve_fused_update(update, state.table.shape[0])
-        table = apply_fused_update(
-            state.table, batch.ids, g_rows, learning_rate, mode, compact_cap
-        )
+            table = fused_tail_adagrad_update(
+                state.table, batch.ids, g_rows, learning_rate,
+                k_cap=compact_cap,
+            )
+        else:
+            from fast_tffm_tpu.ops.packed_table import apply_fused_update
+
+            mode = resolve_fused_update(update, state.table.shape[0])
+            table = apply_fused_update(
+                state.table, batch.ids, g_rows, learning_rate, mode,
+                compact_cap,
+            )
         accum = acc
     else:
         mode = resolve_packed_update(update, state.table.shape[0], acc.shape[-1])
@@ -393,16 +459,19 @@ def packed_train_step_body(
 
 
 def make_packed_train_step(
-    model, learning_rate: float, update: str = "auto", compact_cap: int = 0
+    model, learning_rate: float, update: str = "auto", compact_cap: int = 0,
+    tail: str = "xla",
 ):
     """``compact_cap`` (fused compact tail only): cap the compacted-row
     buffer below the exact worst case, with an exact-capacity lax.cond
-    fallback when a batch touches more rows (config: packed_compact_cap)."""
+    fallback when a batch touches more rows (config: packed_compact_cap).
+    ``tail``: resolved ``[Train] tail`` — ``pallas`` routes the fused
+    layout through the one-pass Pallas kernel."""
 
     @partial(jax.jit, donate_argnums=(0,))
     def step(state: TrainState, batch: Batch):
         return packed_train_step_body(
-            model, learning_rate, state, batch, update, compact_cap
+            model, learning_rate, state, batch, update, compact_cap, tail
         )
 
     return step

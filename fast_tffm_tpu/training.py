@@ -1268,14 +1268,27 @@ def train(cfg: Config, *, resume: bool = False, log=print, step_hook=None):
         state = init_state(
             model, jax.random.key(0), cfg.init_accumulator_value, cfg.adagrad_accumulator
         )
+    # [Train] tail: resolve auto → pallas-on-TPU / xla-elsewhere ONCE, up
+    # front, so every step factory below (packed, rows, scanned, device
+    # cache) sees the same resolved choice.  The Pallas tail applies to
+    # the fused packed layout and the rows layout; auto quietly keeps xla
+    # where the kernel has no contract (split packed accumulators,
+    # dedup_gather_rows) — an EXPLICIT pallas there is a config error.
+    from fast_tffm_tpu.ops.pallas_common import resolve_tail
+
+    tail = resolve_tail(cfg.tail)
     if packed:
         predict_step = make_packed_predict_step(model, fused=fused)
+        packed_tail = tail if fused else "xla"
+        if packed_tail == "pallas":
+            log("sparse tail: pallas (fused one-pass gather→Adagrad→scatter)")
         step_body = lambda mdl, lr, st, b: packed_train_step_body(
-            mdl, lr, st, b, cfg.packed_update, cfg.packed_compact_cap
+            mdl, lr, st, b, cfg.packed_update, cfg.packed_compact_cap,
+            packed_tail,
         )
         step_fn = make_packed_train_step(
             model, cfg.learning_rate, cfg.packed_update,
-            compact_cap=cfg.packed_compact_cap,
+            compact_cap=cfg.packed_compact_cap, tail=packed_tail,
         )
     else:
         predict_step = make_predict_step(model)
@@ -1284,7 +1297,11 @@ def train(cfg: Config, *, resume: bool = False, log=print, step_hook=None):
         # bit-identity the online tests pin).  Packed layouts reject
         # γ < 1 at config.validate, so the packed bodies stay untouched.
         decay = float(cfg.online_adagrad_decay)
-        from fast_tffm_tpu.trainer import make_decayed_body, make_dedup_body
+        from fast_tffm_tpu.trainer import (
+            make_decayed_body,
+            make_dedup_body,
+            make_pallas_tail_body,
+        )
 
         if cfg.dedup_gather_rows > 0:
             # Device-side dedup-before-gather (ROADMAP item 2(a)): the
@@ -1292,6 +1309,9 @@ def train(cfg: Config, *, resume: bool = False, log=print, step_hook=None):
             # host-side guard (_dedup_cap_guard) pins the cap.  Values —
             # and therefore losses — are bit-identical (test-pinned).
             step_body = make_dedup_body(cfg.dedup_gather_rows, decay)
+        elif tail == "pallas":
+            step_body = make_pallas_tail_body(decay)
+            log("sparse tail: pallas (rows one-pass gather→Adagrad→scatter)")
         elif decay != 1.0:
             step_body = make_decayed_body(decay)
         else:
@@ -1416,9 +1436,11 @@ def _tiered_train(cfg: Config, *, resume: bool, log=print, step_hook=None):
     "what fits in HBM" to "what fits on the host": 2^30+ rows on one
     chip, bit-identical to the resident path at overlapping vocab."""
     from fast_tffm_tpu.data.wire import make_spec
+    from fast_tffm_tpu.ops.pallas_common import resolve_tail
     from fast_tffm_tpu.paramstore import TieredConverter, open_tiered_run
     from fast_tffm_tpu.trainer import (
         make_decayed_body,
+        make_pallas_tail_body,
         make_scanned_train_step,
         make_train_step,
     )
@@ -1429,11 +1451,18 @@ def _tiered_train(cfg: Config, *, resume: bool, log=print, step_hook=None):
         cfg, model, max_nnz, resume=resume, log=log
     )
     decay = float(cfg.online_adagrad_decay)
-    body = make_decayed_body(decay) if decay != 1.0 else None
+    if resolve_tail(cfg.tail) == "pallas":
+        # The tiered inner step already runs over the compact [C, D]
+        # staging table with remapped slot ids — exactly the rows-layout
+        # operands the kernel takes, so the SAME body serves both tiers.
+        body = make_pallas_tail_body(decay)
+        log("sparse tail: pallas (one-pass kernel over the compact tier)")
+    else:
+        body = make_decayed_body(decay) if decay != 1.0 else None
     if cfg.steps_per_call > 1:
         inner = make_scanned_train_step(model, cfg.learning_rate, body=body)
     else:
-        inner = make_train_step(model, cfg.learning_rate, decay=decay)
+        inner = make_train_step(model, cfg.learning_rate, decay=decay, body=body)
     step_fn = server.wrap_step(inner)
     # The wire spec lives at the COMPACT capacity: ids narrow to the
     # local slot range (e.g. 3 bytes for a 2^30 logical vocab whose
@@ -1642,6 +1671,16 @@ def dist_train(cfg: Config, *, resume: bool = False, log=print, mesh=None, step_
 
     if not cfg.train_files:
         raise ValueError("no train_files configured")
+    if cfg.tail == "pallas":
+        # Loud, not silent: a run that pins the Pallas tail but launches
+        # the sharded driver would measure the XLA tail and call it
+        # pallas.  (``auto`` resolves to xla here — the sharded step's
+        # collective tail is not the kernel's contract yet.)
+        raise ValueError(
+            "tail = pallas is not supported by dist_train yet (the "
+            "sharded step keeps the XLA sparse tail); use tail = auto "
+            "or xla for distributed runs"
+        )
     if cfg.weight_files and len(cfg.weight_files) != len(cfg.train_files):
         # Checked here, not in Config.validate: a shared config must still
         # LOAD on predict-only machines where train-file globs match

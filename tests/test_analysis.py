@@ -202,6 +202,41 @@ def norm(cfg):
     return cfg.model.lower()           # zero-arg str.lower, not jax
 '''
 
+PALLAS_IN_LOOP = '''
+from jax.experimental import pallas as pl
+
+def sweep(xs, kernel, spec):
+    out = []
+    for x in xs:
+        f = pl.pallas_call(kernel, grid_spec=spec, out_shape=x)
+        out.append(f(x))               # fresh Mosaic compile per iteration
+    return out
+'''
+
+PALLAS_CONSTRUCT_INVOKE_OK = '''
+from jax.experimental import pallas as pl
+
+def _fwd_impl(z, kernel, spec, shape):
+    # construct-and-invoke inside a (jitted) function: traces once per
+    # program — the normal Pallas idiom, NOT a hazard
+    return pl.pallas_call(kernel, grid_spec=spec, out_shape=shape)(z)
+'''
+
+INTERPRET_LITERAL = '''
+from jax.experimental import pallas as pl
+
+def run(kernel, spec, shape, z):
+    return pl.pallas_call(kernel, grid_spec=spec, out_shape=shape,
+                          interpret=True)(z)
+'''
+
+INTERPRET_NONE_OK = '''
+from fast_tffm_tpu.ops.pallas_common import resolve_interpret
+
+def run(fn, z, interpret=None):
+    return fn(z, interpret=resolve_interpret(interpret))
+'''
+
 
 @pytest.mark.parametrize(
     "src,expect,ctx_kind",
@@ -213,10 +248,16 @@ def norm(cfg):
         (RECOMPILE_SCALAR, True, "scalar:k"),
         (RECOMPILE_LOWER, True, "lower"),
         (RECOMPILE_STR_LOWER_OK, False, None),
+        (PALLAS_IN_LOOP, True, "pallas-in-loop"),
+        (PALLAS_CONSTRUCT_INVOKE_OK, False, None),
+        (INTERPRET_LITERAL, True, "interpret-literal"),
+        (INTERPRET_NONE_OK, False, None),
     ],
     ids=[
         "pr7-fresh-jit-per-save", "pr7-fixed", "jit-in-loop", "factory-ok",
         "loop-scalar", "out-of-ledger-lower", "str-lower-ok",
+        "pallas-in-loop", "pallas-construct-invoke-ok",
+        "interpret-literal", "interpret-resolve-ok",
     ],
 )
 def test_recompile_fixtures(tmp_path, src, expect, ctx_kind):
@@ -228,6 +269,17 @@ def test_recompile_fixtures(tmp_path, src, expect, ctx_kind):
         assert any(ctx_kind in f.context for f in findings), [
             f.context for f in findings
         ]
+
+
+def test_interpret_literal_scoping(tmp_path):
+    # The shared helper owns the backend branch; test files are outside
+    # the package prefix — both stay quiet.
+    ctx = ctx_of(
+        tmp_path, {"fast_tffm_tpu/ops/pallas_common.py": INTERPRET_LITERAL}
+    )
+    assert not RecompileChecker().run(ctx)
+    ctx = ctx_of(tmp_path, {"tests/test_mod.py": INTERPRET_LITERAL})
+    assert not RecompileChecker().run(ctx)
 
 
 # -- lock-discipline / lock-order ------------------------------------------

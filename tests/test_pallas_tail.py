@@ -1,0 +1,349 @@
+"""Fused Pallas sparse tail (ISSUE 18 tentpole) vs its XLA oracles.
+
+Runs the kernels in the Pallas interpreter on the CPU mesh (resolve
+auto-detects the backend, so no per-test plumbing); real-TPU compilation
+of the same kernels is exercised by bench.py / the driver.
+
+Parity contract (acceptance criteria):
+  * γ=1.0 — BIT-IDENTICAL to the classic XLA program (same
+    optim.dedup_rows front + same update expressions, compared inside
+    jax.jit exactly as training runs them);
+  * γ<1 — row accumulator stays bitwise, element accumulator is
+    rtol-pinned (XLA fuses the decayed expressions into different FMA
+    clusters — 1-ULP table drift);
+  * fused layout vs the scatter-add-built XLA fused tails — allclose
+    (summation order), and BITWISE vs the rows-classic program on the
+    unpacked logical arrays (the structural oracle);
+  * k_cap overflow takes the exact lax.cond fallback, remainder blocks
+    and K-step scans are exact, and the tiered / device-cache / streamed
+    drivers log identical losses end to end.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fast_tffm_tpu.config import Config
+from fast_tffm_tpu.models import Batch, FMModel
+from fast_tffm_tpu.ops.packed_table import (
+    apply_fused_update,
+    pack_fused,
+    unpack_fused,
+)
+from fast_tffm_tpu.ops.pallas_tail import (
+    fused_tail_adagrad_update,
+    rows_tail_adagrad_update,
+)
+from fast_tffm_tpu.optim import AdagradState, sparse_adagrad_update
+from fast_tffm_tpu import trainer as tr
+
+V, D = 64, 7  # D+1 = 8 divides the 128-lane tile: p = 16 rows per tile row
+
+
+def _operands(seed=0, m=40, v=V, d=D):
+    rng = np.random.default_rng(seed)
+    return (
+        jnp.asarray(rng.integers(0, v, size=(m,)), jnp.int32),
+        jnp.asarray(rng.standard_normal((m, d)), jnp.float32),
+        jnp.asarray(rng.standard_normal((v, d)), jnp.float32),
+        jnp.asarray(rng.uniform(0.05, 2.0, (v, 1)), jnp.float32),
+        jnp.asarray(rng.uniform(0.05, 2.0, (v, d)), jnp.float32),
+    )
+
+
+def _classic(table, accum, ids, g, lr, decay=1.0):
+    return jax.jit(
+        lambda t, a: sparse_adagrad_update(
+            t, AdagradState(a), ids, g, lr, decay=decay
+        )
+    )(table, accum)
+
+
+def _kernel(table, accum, ids, g, lr, decay=1.0, **kw):
+    return jax.jit(
+        lambda t, a: rows_tail_adagrad_update(
+            t, a, ids, g, lr, decay=decay, **kw
+        )
+    )(table, accum)
+
+
+def test_rows_tail_matches_numpy_oracle():
+    ids, g, table, accum_row, accum_elem = _operands()
+    lr = 0.13
+    for acc in (accum_row, accum_elem):
+        t2, a2 = _kernel(table, acc, ids, g, lr)
+        dense_g = np.zeros((V, D), np.float64)
+        np.add.at(dense_g, np.asarray(ids), np.asarray(g, np.float64))
+        if acc.shape[-1] == 1:
+            sq = (dense_g**2).sum(-1, keepdims=True)
+        else:
+            sq = dense_g**2
+        accn = np.asarray(acc, np.float64) + sq
+        want = np.asarray(table, np.float64) - lr * dense_g / np.sqrt(accn)
+        touched = np.zeros(V, bool)
+        touched[np.unique(np.asarray(ids))] = True
+        np.testing.assert_allclose(
+            np.asarray(t2)[touched], want[touched], rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(a2)[touched], accn[touched], rtol=1e-5
+        )
+        # Untouched rows never enter the kernel — preserved bitwise.
+        np.testing.assert_array_equal(
+            np.asarray(t2)[~touched], np.asarray(table)[~touched]
+        )
+
+
+@pytest.mark.parametrize("acc_kind", ["row", "element"])
+def test_rows_tail_bit_identical_to_classic(acc_kind):
+    ids, g, table, accum_row, accum_elem = _operands(1)
+    acc = accum_row if acc_kind == "row" else accum_elem
+    rt, rs = _classic(table, acc, ids, g, 0.13)
+    kt, ka = _kernel(table, acc, ids, g, 0.13)
+    assert jnp.all(kt == rt) and jnp.all(ka == rs.accum)
+
+
+@pytest.mark.parametrize("acc_kind", ["row", "element"])
+def test_rows_tail_decay_parity(acc_kind):
+    ids, g, table, accum_row, accum_elem = _operands(2)
+    acc = accum_row if acc_kind == "row" else accum_elem
+    rt, rs = _classic(table, acc, ids, g, 0.13, decay=0.9)
+    kt, ka = _kernel(table, acc, ids, g, 0.13, decay=0.9)
+    if acc_kind == "row":
+        # Row mode keeps bitwise even under decay.
+        assert jnp.all(kt == rt) and jnp.all(ka == rs.accum)
+    else:
+        # Element mode: decayed expressions land in different XLA fusion
+        # clusters (FMA contraction) — 1-ULP table drift, rtol-pinned
+        # (atol floors the near-zero entries where 1 ULP is a big ratio).
+        np.testing.assert_allclose(kt, rt, rtol=1e-5, atol=1e-7)
+        np.testing.assert_allclose(ka, rs.accum, rtol=1e-5, atol=1e-7)
+
+
+def test_zero_grad_rows_are_exact_fixed_points():
+    ids, g, table, accum_row, _ = _operands(3)
+    z = jnp.zeros_like(g)
+    kt, ka = _kernel(table, accum_row, ids, z, 0.13)
+    # acc + 0 = acc and w − lr·0/√acc = w: the zero-grad identity that
+    # lets untouched rows skip the kernel entirely.
+    assert jnp.all(kt == table) and jnp.all(ka == accum_row)
+
+
+def test_fused_tail_bit_identical_to_rows_classic():
+    ids, g, table, accum_row, _ = _operands(4)
+    fused = pack_fused(table, accum_row, 0.1)
+    rt, rs = _classic(table, accum_row, ids, g, 0.13)
+    f2 = jax.jit(
+        lambda f: fused_tail_adagrad_update(f, ids, g, 0.13)
+    )(fused)
+    tu, au = unpack_fused(f2, V, D)
+    assert jnp.all(tu == rt) and jnp.all(au == rs.accum)
+    # Untouched logical rows (and pad slots) preserved bitwise in the
+    # fused array itself.
+    f3 = jnp.asarray(f2)
+    touched_phys = np.unique(np.asarray(ids) // (128 // (D + 1)))
+    mask = np.ones(fused.shape[0], bool)
+    mask[touched_phys] = False
+    np.testing.assert_array_equal(
+        np.asarray(f3)[mask], np.asarray(fused)[mask]
+    )
+
+
+@pytest.mark.parametrize("mode", ["dense", "compact"])
+def test_fused_tail_allclose_to_xla_fused(mode):
+    ids, g, table, accum_row, _ = _operands(5)
+    fused = pack_fused(table, accum_row, 0.1)
+    ref = jax.jit(
+        lambda f: apply_fused_update(f, ids, g, 0.13, mode, 0)
+    )(fused)
+    got = jax.jit(
+        lambda f: fused_tail_adagrad_update(f, ids, g, 0.13)
+    )(fused)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("k_cap", [4, 1000])
+def test_fused_k_cap_edge(k_cap):
+    # k_cap=4 < unique-row count forces the exact lax.cond full-span
+    # fallback; k_cap=1000 > M is a no-op cap.  Both stay exact.
+    ids, g, table, accum_row, _ = _operands(6)
+    fused = pack_fused(table, accum_row, 0.1)
+    rt, rs = _classic(table, accum_row, ids, g, 0.13)
+    f2 = jax.jit(
+        lambda f: fused_tail_adagrad_update(f, ids, g, 0.13, k_cap=k_cap)
+    )(fused)
+    tu, au = unpack_fused(f2, V, D)
+    assert jnp.all(tu == rt) and jnp.all(au == rs.accum)
+
+
+def test_remainder_tail_small_blocks():
+    # block_rows=8 over 40 occurrences: multiple grid blocks plus a
+    # partially-valid remainder block (predicated DMA rows).
+    ids, g, table, accum_row, _ = _operands(7)
+    fused = pack_fused(table, accum_row, 0.1)
+    rt, rs = _classic(table, accum_row, ids, g, 0.13)
+    f2 = jax.jit(
+        lambda f: fused_tail_adagrad_update(f, ids, g, 0.13, block_rows=8)
+    )(fused)
+    tu, au = unpack_fused(f2, V, D)
+    assert jnp.all(tu == rt) and jnp.all(au == rs.accum)
+    t2, a2 = _kernel(table, accum_row, ids, g, 0.13, block_rows=8)
+    assert jnp.all(t2 == rt) and jnp.all(a2 == rs.accum)
+
+
+# -- trainer-level wiring -------------------------------------------------
+
+
+def _batches(n=3, B=16, N=6, v=100, seed=1):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        out.append(
+            Batch(
+                labels=jnp.asarray((rng.random(B) < 0.5).astype(np.float32)),
+                ids=jnp.asarray(rng.integers(0, v, (B, N)).astype(np.int32)),
+                vals=jnp.asarray(
+                    np.abs(rng.normal(size=(B, N)).astype(np.float32))
+                ),
+                fields=jnp.zeros((B, N), jnp.int32),
+                weights=jnp.ones((B,), jnp.float32),
+            )
+        )
+    return out
+
+
+def test_train_step_pallas_body_bit_identical():
+    model = FMModel(vocabulary_size=100, factor_num=4, order=2)
+    s0 = tr.init_state(model, jax.random.key(0), 0.1, "element")
+    s1 = tr.init_state(model, jax.random.key(0), 0.1, "element")
+    step_x = tr.make_train_step(model, 0.05)
+    step_p = tr.make_train_step(model, 0.05, body=tr.make_pallas_tail_body())
+    for b in _batches():
+        s0, l0 = step_x(s0, b)
+        s1, l1 = step_p(s1, b)
+        assert l0 == l1
+    assert jnp.all(s0.table == s1.table)
+    assert jnp.all(s0.table_opt.accum == s1.table_opt.accum)
+
+
+def test_packed_fused_step_tail_pallas():
+    model = FMModel(vocabulary_size=100, factor_num=4, order=2)
+    s0 = tr.init_packed_state(model, jax.random.key(0), 0.1, "fused")
+    s1 = tr.init_packed_state(model, jax.random.key(0), 0.1, "fused")
+    step_x = tr.make_packed_train_step(model, 0.05, "auto")
+    step_p = tr.make_packed_train_step(model, 0.05, tail="pallas")
+    for b in _batches():
+        s0, l0 = step_x(s0, b)
+        s1, l1 = step_p(s1, b)
+        np.testing.assert_allclose(l1, l0, rtol=1e-6)
+    np.testing.assert_allclose(s1.table, s0.table, rtol=1e-5, atol=1e-6)
+
+
+def test_scanned_pallas_body_matches_sequential():
+    model = FMModel(vocabulary_size=100, factor_num=4, order=2)
+    batches = _batches()
+    s1 = tr.init_state(model, jax.random.key(0), 0.1, "element")
+    step_p = tr.make_train_step(model, 0.05, body=tr.make_pallas_tail_body())
+    for b in batches:
+        s1, _ = step_p(s1, b)
+    stack = lambda f: jnp.stack([getattr(b, f) for b in batches])
+    sb = Batch(
+        labels=stack("labels"), ids=stack("ids"), vals=stack("vals"),
+        fields=stack("fields"), weights=stack("weights"),
+    )
+    s4 = tr.init_state(model, jax.random.key(0), 0.1, "element")
+    scan_p = tr.make_scanned_train_step(
+        model, 0.05, body=tr.make_pallas_tail_body()
+    )
+    s4, _losses = scan_p(s4, sb)
+    assert jnp.all(s4.table == s1.table)
+    assert jnp.all(s4.table_opt.accum == s1.table_opt.accum)
+
+
+# -- end-to-end drivers (streamed / device-cache / tiered) ----------------
+
+
+def _write_dataset(path, n=120, vocab=200, nnz=5, seed=0):
+    rng = np.random.default_rng(seed)
+    with open(path, "w") as f:
+        for _ in range(n):
+            ids = rng.choice(vocab, size=nnz, replace=False)
+            vals = np.round(np.abs(rng.normal(size=nnz)) + 0.1, 4)
+            y = int(rng.random() < 0.5)
+            f.write(
+                f"{y} " + " ".join(f"{i}:{v}" for i, v in zip(ids, vals)) + "\n"
+            )
+
+
+def _cfg(tmp_path, name, **kw):
+    c = Config()
+    c.model = "fm"
+    c.factor_num = 4
+    c.vocabulary_size = 200
+    c.train_files = (str(tmp_path / "train.libsvm"),)
+    c.epoch_num = 1
+    c.batch_size = 32
+    c.learning_rate = 0.1
+    c.log_every = 1
+    c.model_file = str(tmp_path / f"{name}.ckpt")
+    for k, v in kw.items():
+        setattr(c, k, v)
+    return c.validate()
+
+
+def _losses(logs):
+    return [float(l.split("loss ")[1].split()[0]) for l in logs if "loss " in l]
+
+
+def _run(cfg):
+    from fast_tffm_tpu.training import train
+
+    logs = []
+    state = train(cfg, log=lambda *a: logs.append(" ".join(map(str, a))))
+    return state, logs
+
+
+def test_drivers_pallas_tail_bit_identical(tmp_path):
+    """Streamed, device-cached, and tiered drivers under tail=pallas all
+    log the XLA tail's loss sequence bit for bit (rows layout, γ=1)."""
+    _write_dataset(str(tmp_path / "train.libsvm"))
+    _s, xla_logs = _run(_cfg(tmp_path, "xla", tail="xla"))
+    _s, pal_logs = _run(_cfg(tmp_path, "pallas", tail="pallas"))
+    assert _losses(xla_logs) == _losses(pal_logs)
+    _s, cache_logs = _run(
+        _cfg(tmp_path, "cache", tail="pallas", device_cache=True,
+             binary_cache=True)
+    )
+    assert _losses(xla_logs) == _losses(cache_logs)
+    _s, tier_logs = _run(
+        _cfg(tmp_path, "tier", tail="pallas", paramstore=True,
+             paramstore_hot_rows=48)
+    )
+    assert _losses(xla_logs) == _losses(tier_logs)
+
+
+# -- config surface -------------------------------------------------------
+
+
+def test_config_tail_validation(tmp_path):
+    _write_dataset(str(tmp_path / "train.libsvm"))
+    with pytest.raises(ValueError, match="unknown tail"):
+        _cfg(tmp_path, "bad", tail="fast")
+    with pytest.raises(ValueError, match="adagrad_accumulator = fused"):
+        _cfg(tmp_path, "bad", tail="pallas", table_layout="packed")
+    with pytest.raises(ValueError, match="dedup_gather_rows"):
+        _cfg(tmp_path, "bad", tail="pallas", dedup_gather_rows=64)
+    # auto + packed element layout is fine: auto falls back to xla there.
+    _cfg(tmp_path, "ok", tail="auto", table_layout="packed")
+    _cfg(tmp_path, "ok2", tail="pallas", table_layout="packed",
+         adagrad_accumulator="fused")
+
+
+def test_dist_train_rejects_explicit_pallas(tmp_path):
+    from fast_tffm_tpu.training import dist_train
+
+    _write_dataset(str(tmp_path / "train.libsvm"))
+    cfg = _cfg(tmp_path, "dist", tail="pallas")
+    with pytest.raises(ValueError, match="dist_train"):
+        dist_train(cfg)

@@ -20,6 +20,18 @@ This checker catches the constructions statically:
     profiling.py: re-lowering is how the CostLedger measures cost
     WITHOUT a second backend compile, and it owns the one sanctioned
     call site; anywhere else risks paying compile twice.
+  * **pallas-in-loop** — ``pl.pallas_call(...)`` lexically inside a
+    for/while body: the same fresh-jit bug class as jit-in-loop (every
+    iteration builds a fresh kernel callable → a fresh Mosaic compile).
+    Only the loop-lexical form is flagged: construct-and-invoke inside a
+    jitted function body (ops/pallas_*.py) traces once per program and
+    caches with it — that is the normal Pallas idiom, not a hazard.
+  * **interpret literal** — ``interpret=True`` written in a non-test
+    module under ``fast_tffm_tpu/`` outside the shared helper
+    (ops/pallas_common.py): a compiled path silently running kernels in
+    the Pallas interpreter is an orders-of-magnitude throughput bug that
+    no correctness test catches.  Production call sites pass
+    ``interpret=None`` and let the helper resolve the backend.
 """
 
 from __future__ import annotations
@@ -45,12 +57,23 @@ RULE = "recompile-hazard"
 # §"Profiling & data statistics").
 LOWER_ALLOWED = {"fast_tffm_tpu/profiling.py"}
 
+# The one production module allowed to spell ``interpret=True``: the
+# shared helper whose whole job is resolving the flag off the backend.
+INTERPRET_ALLOWED = {"fast_tffm_tpu/ops/pallas_common.py"}
+
 
 def _is_jit(call: ast.Call, aliases) -> bool:
     name = call_name(call)
     return name is not None and (
         resolves_to(name, "jax.jit", aliases)
         or resolves_to(name, "jax.pjit", aliases)
+    )
+
+
+def _is_pallas_call(call: ast.Call, aliases) -> bool:
+    name = call_name(call)
+    return name is not None and resolves_to(
+        name, "jax.experimental.pallas.pallas_call", aliases
     )
 
 
@@ -140,6 +163,10 @@ class RecompileChecker:
                     findings.extend(
                         self._check_jit_site(sf, node, parents)
                     )
+                if isinstance(node, ast.Call) and _is_pallas_call(node, aliases):
+                    findings.extend(
+                        self._check_pallas_site(sf, node, parents)
+                    )
                 if isinstance(node, ast.Call):
                     findings.extend(
                         self._check_traced_scalar(
@@ -147,6 +174,9 @@ class RecompileChecker:
                         )
                     )
                     findings.extend(self._check_lower(sf, node, parents))
+                    findings.extend(
+                        self._check_interpret_literal(sf, node, parents)
+                    )
         return findings
 
     # -- jit construction sites ----------------------------------------
@@ -280,6 +310,82 @@ class RecompileChecker:
                         if isinstance(sub, ast.Name) and sub.id == name:
                             return True
         return False
+
+    # -- pallas_call construction sites --------------------------------
+
+    def _check_pallas_site(self, sf, call: ast.Call, parents):
+        """Loop-lexical check ONLY: ``pl.pallas_call(kernel, ...)(x)``
+        construct-and-invoke inside a (jitted) function is the normal
+        Pallas idiom — the trace caches with the enclosing program — so
+        the uncached-sink analysis that applies to jax.jit would be all
+        false positives here.  A pallas_call lexically inside a loop,
+        though, is a fresh kernel (and a fresh Mosaic compile) per
+        iteration: the same bug class as jit-in-loop."""
+        func_anchor = enclosing_function(call, parents)
+        for anc in _loop_ancestors(call, parents):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                break
+            if isinstance(anc, (ast.For, ast.While, ast.AsyncFor)):
+                return [
+                    Finding(
+                        rule=RULE,
+                        path=sf.rel,
+                        line=call.lineno,
+                        message=(
+                            "pl.pallas_call constructed inside a loop — a "
+                            "fresh kernel callable (and a fresh Mosaic "
+                            "compile) per iteration, the jit-in-loop bug "
+                            "class"
+                        ),
+                        context=f"{func_anchor}:pallas-in-loop",
+                        fix_hint=(
+                            "hoist the pallas_call construction out of the "
+                            "loop (grid/BlockSpec carry the per-iteration "
+                            "variation), or wrap it in a cached factory"
+                        ),
+                    )
+                ]
+        return []
+
+    # -- interpret=True literals ---------------------------------------
+
+    def _check_interpret_literal(self, sf, call: ast.Call, parents):
+        """``interpret=True`` in a production module silently swaps a
+        compiled kernel for the Pallas interpreter — an orders-of-
+        magnitude throughput bug no correctness test catches.  Only the
+        shared helper (ops/pallas_common.py) may branch on the backend;
+        production call sites pass ``interpret=None``."""
+        if sf.rel in INTERPRET_ALLOWED or not sf.rel.startswith("fast_tffm_tpu/"):
+            return []
+        for kw in call.keywords:
+            if (
+                kw.arg == "interpret"
+                and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True
+            ):
+                return [
+                    Finding(
+                        rule=RULE,
+                        path=sf.rel,
+                        line=call.lineno,
+                        message=(
+                            "interpret=True in a production module — this "
+                            "path runs the kernel in the Pallas interpreter "
+                            "even on TPU (a silent orders-of-magnitude "
+                            "throughput bug)"
+                        ),
+                        context=(
+                            f"{enclosing_function(call, parents)}:"
+                            "interpret-literal"
+                        ),
+                        fix_hint=(
+                            "pass interpret=None and let "
+                            "ops.pallas_common.resolve_interpret pick the "
+                            "backend; only tests spell interpret=True"
+                        ),
+                    )
+                ]
+        return []
 
     # -- traced Python scalars -----------------------------------------
 
